@@ -1,0 +1,21 @@
+"""paddle_tpu — a TPU-native deep-learning framework with the capability
+surface of PaddlePaddle ~v2.0 (reference: /root/reference), rebuilt on
+JAX/XLA/Pallas: ops lower to HLO, parallelism is GSPMD/shard_map over
+device meshes, autograd is jax.vjp (eager tape) / jax.grad (compiled).
+"""
+from __future__ import annotations
+
+from .framework import (
+    CPUPlace, CUDAPlace, DType, Parameter, Place, TPUPlace, Tensor,
+    bfloat16, bool_, complex128, complex64, enable_grad, float16, float32,
+    float64, get_device, get_flags, grad, int16, int32, int64, int8,
+    is_grad_enabled, no_grad, seed, set_device, set_flags, to_tensor, uint8,
+)
+from .framework.place import (device_count, is_compiled_with_cuda,
+                              is_compiled_with_tpu)
+
+from .ops import *  # noqa: F401,F403  (tensor/math/… API at top level)
+from .ops import creation, linalg, logic, manipulation, math, reduction, search
+from .ops import random_ops as random  # paddle.rand etc already exported
+
+__version__ = "0.1.0"

@@ -1,0 +1,11 @@
+from . import autograd, dtype, flags, place, random
+from .autograd import (backward, enable_grad, grad, in_trace_mode,
+                       is_grad_enabled, no_grad, trace_mode)
+from .dtype import (DType, convert_dtype, to_jax_dtype, bool_, uint8, int8,
+                    int16, int32, int64, float16, bfloat16, float32, float64,
+                    complex64, complex128)
+from .place import (CPUPlace, CUDAPlace, Place, TPUPlace, get_device,
+                    set_device, default_place, device_for)
+from .flags import get_flags, set_flags
+from .random import seed, get_rng_key, rng_scope
+from .tensor import Parameter, Tensor, apply_op, defop, to_tensor

@@ -1,0 +1,252 @@
+"""Imperative (dygraph) autograd engine.
+
+Reference design: `paddle/fluid/imperative/` — `Tracer::TraceOp` records a
+grad node per op (`dygraph_grad_maker.h`) and `BasicEngine::Execute`
+(`basic_engine.cc:265`) walks the graph with a GradientAccumulator.
+
+TPU-native redesign: instead of per-op C++ grad kernels, each recorded op
+holds the `jax.vjp` pullback of its (already XLA-lowered) forward. Forward
+runs eagerly on device; residuals stay on device inside the pullback. The
+backward walk is pure Python graph traversal — every numeric step is an XLA
+computation. The *fast* path (to_static / Model.fit / fleet) never uses this
+engine: it differentiates whole programs with jax.grad, so the per-op tape
+only pays off developer ergonomics, exactly like dygraph vs static in the
+reference.
+"""
+from __future__ import annotations
+
+import contextlib
+import itertools
+import threading
+import weakref
+from typing import Any, List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+
+__all__ = [
+    "TapeNode", "no_grad", "enable_grad", "is_grad_enabled", "backward",
+    "grad", "in_trace_mode", "trace_mode",
+]
+
+_node_counter = itertools.count()
+
+
+class _State(threading.local):
+    def __init__(self):
+        self.grad_enabled = True
+        self.trace_depth = 0  # >0 ⇒ functional capture; tape disabled
+
+
+_state = _State()
+
+
+def is_grad_enabled() -> bool:
+    return _state.grad_enabled and _state.trace_depth == 0
+
+
+@contextlib.contextmanager
+def no_grad():
+    prev = _state.grad_enabled
+    _state.grad_enabled = False
+    try:
+        yield
+    finally:
+        _state.grad_enabled = prev
+
+
+@contextlib.contextmanager
+def enable_grad():
+    prev = _state.grad_enabled
+    _state.grad_enabled = True
+    try:
+        yield
+    finally:
+        _state.grad_enabled = prev
+
+
+def in_trace_mode() -> bool:
+    return _state.trace_depth > 0
+
+
+@contextlib.contextmanager
+def trace_mode():
+    """Inside: ops run raw (no tape); arrays may be jax tracers."""
+    _state.trace_depth += 1
+    try:
+        yield
+    finally:
+        _state.trace_depth -= 1
+
+
+class TapeNode:
+    """One recorded op: pullback + graph edges.
+
+    inputs:   Tensors the vjp produces cotangents for (in vjp order).
+    out_refs: weakrefs to output Tensors (index-aligned with the flat
+              output structure); avals remembered for zero cotangents.
+    """
+
+    __slots__ = ("id", "name", "vjp_fn", "inputs", "out_refs", "out_avals",
+                 "__weakref__")
+
+    def __init__(self, name: str, vjp_fn, inputs: Sequence[Any],
+                 out_tensors: Sequence[Any]):
+        self.id = next(_node_counter)
+        self.name = name
+        self.vjp_fn = vjp_fn
+        self.inputs = list(inputs)
+        self.out_refs = [weakref.ref(t) for t in out_tensors]
+        self.out_avals = [(t._value.shape, t._value.dtype)
+                          for t in out_tensors]
+
+    def __repr__(self):
+        return f"TapeNode<{self.name}#{self.id}>"
+
+
+def _toposort_from(root: TapeNode) -> List[TapeNode]:
+    seen = {id(root)}
+    stack = [root]
+    nodes = [root]
+    while stack:
+        n = stack.pop()
+        for t in n.inputs:
+            prev = t._node
+            if prev is not None and id(prev) not in seen:
+                seen.add(id(prev))
+                nodes.append(prev)
+                stack.append(prev)
+    nodes.sort(key=lambda n: n.id, reverse=True)
+    return nodes
+
+
+def backward(tensor, grad_tensor=None, retain_graph: bool = False) -> None:
+    """Tensor.backward(): reference `basic_engine.cc:265` Execute.
+
+    Accumulates `.grad` on every reachable Tensor with stop_gradient=False
+    (reference GradientAccumulator semantics: += across backward calls).
+    """
+    from .tensor import Tensor  # local import, cycle-free at runtime
+
+    if tensor._node is None:
+        if not tensor.stop_gradient:
+            g = (grad_tensor._value if isinstance(grad_tensor, Tensor)
+                 else jnp.ones_like(tensor._value))
+            tensor._accumulate_grad(g)
+        return
+
+    if grad_tensor is None:
+        init = jnp.ones_like(tensor._value)
+    else:
+        init = (grad_tensor._value if isinstance(grad_tensor, Tensor)
+                else jnp.asarray(grad_tensor))
+
+    # cotangent store keyed by Tensor identity
+    cots: dict[int, Any] = {id(tensor): init}
+    keep_alive: dict[int, Any] = {id(tensor): tensor}
+
+    nodes = _toposort_from(tensor._node)
+    for node in nodes:
+        if node.vjp_fn is None:
+            raise RuntimeError(
+                f"backward through {node.name} a second time: the graph was "
+                "freed; pass retain_graph=True to the first backward call")
+        outs = []
+        any_grad = False
+        for ref, (shape, dt) in zip(node.out_refs, node.out_avals):
+            t = ref()
+            g = cots.get(id(t)) if t is not None else None
+            if g is None:
+                g = jnp.zeros(shape, dt)
+            else:
+                any_grad = True
+            outs.append(g)
+        if not any_grad:
+            continue
+        in_grads = node.vjp_fn(tuple(outs) if len(outs) > 1 else outs[0])
+        for t, g in zip(node.inputs, in_grads):
+            if g is None:
+                continue
+            k = id(t)
+            if k in cots:
+                cots[k] = cots[k] + g
+            else:
+                cots[k] = g
+                keep_alive[k] = t
+        if not retain_graph:
+            node.vjp_fn = None
+
+    for k, t in keep_alive.items():
+        if not t.stop_gradient:
+            t._accumulate_grad(cots[k])
+
+
+def grad(outputs, inputs, grad_outputs=None, retain_graph=None,
+         create_graph=False, only_inputs=True, allow_unused=False,
+         no_grad_vars=None):
+    """paddle.grad — reference `imperative/partial_grad_engine.cc`.
+
+    Returns grads of `outputs` w.r.t. `inputs` without touching `.grad`.
+    create_graph (double grad) is not supported by the eager tape yet; use
+    the functional API (paddle_tpu.incubate.functional) for higher-order.
+    """
+    from .tensor import Tensor
+
+    if create_graph:
+        raise NotImplementedError(
+            "create_graph=True: use jax-level functional transforms")
+    outputs = outputs if isinstance(outputs, (list, tuple)) else [outputs]
+    inputs = inputs if isinstance(inputs, (list, tuple)) else [inputs]
+    if grad_outputs is None:
+        grad_outputs = [None] * len(outputs)
+    elif not isinstance(grad_outputs, (list, tuple)):
+        grad_outputs = [grad_outputs]
+
+    cots: dict[int, Any] = {}
+    roots: list[TapeNode] = []
+    for o, go in zip(outputs, grad_outputs):
+        g = (go._value if isinstance(go, Tensor)
+             else jnp.ones_like(o._value) if go is None else jnp.asarray(go))
+        cots[id(o)] = cots.get(id(o), 0) + g
+        if o._node is not None:
+            roots.append(o._node)
+
+    seen, nodes = set(), []
+    for r in roots:
+        for n in _toposort_from(r):
+            if id(n) not in seen:
+                seen.add(id(n))
+                nodes.append(n)
+    nodes.sort(key=lambda n: n.id, reverse=True)
+
+    retain = True if retain_graph is None else retain_graph
+    for node in nodes:
+        outs = []
+        any_grad = False
+        for ref, (shape, dt) in zip(node.out_refs, node.out_avals):
+            t = ref()
+            g = cots.get(id(t)) if t is not None else None
+            if g is None:
+                g = jnp.zeros(shape, dt)
+            else:
+                any_grad = True
+            outs.append(g)
+        if not any_grad or node.vjp_fn is None:
+            continue
+        in_grads = node.vjp_fn(tuple(outs) if len(outs) > 1 else outs[0])
+        for t, g in zip(node.inputs, in_grads):
+            if g is None:
+                continue
+            k = id(t)
+            cots[k] = cots[k] + g if k in cots else g
+        if not retain:
+            node.vjp_fn = None
+
+    results = []
+    for t in inputs:
+        g = cots.get(id(t))
+        if g is None and not allow_unused:
+            raise ValueError("an input Tensor is unused in the graph "
+                             "(pass allow_unused=True to get None)")
+        results.append(None if g is None else Tensor(g, stop_gradient=True))
+    return results

@@ -1,0 +1,105 @@
+"""Dtype registry.
+
+Mirrors the reference dtype table (`paddle/fluid/framework/data_type.h`,
+`framework.proto` VarType.Type) but is natively a mapping onto XLA element
+types via numpy/jax dtypes. bfloat16 is first-class (TPU native), float16
+kept for API parity.
+"""
+from __future__ import annotations
+
+import numpy as np
+import jax.numpy as jnp
+
+__all__ = [
+    "DType", "convert_dtype", "to_jax_dtype", "to_paddle_dtype_name",
+    "is_floating_point_dtype", "is_integer_dtype", "default_float_dtype",
+]
+
+
+class DType:
+    """A framework dtype: thin named wrapper over a jax/numpy dtype."""
+
+    __slots__ = ("name", "np_dtype")
+
+    def __init__(self, name: str, np_dtype):
+        self.name = name
+        self.np_dtype = jnp.dtype(np_dtype)
+
+    def __repr__(self):
+        return f"paddle_tpu.{self.name}"
+
+    def __eq__(self, other):
+        if isinstance(other, DType):
+            return self.name == other.name
+        try:
+            return self.np_dtype == jnp.dtype(_canon(other))
+        except TypeError:
+            return NotImplemented
+
+    def __hash__(self):
+        return hash(self.name)
+
+
+def _canon(d):
+    if isinstance(d, DType):
+        return d.np_dtype
+    if isinstance(d, str):
+        alias = _STR_ALIASES.get(d)
+        if alias is not None:
+            return alias
+    return d
+
+
+bool_ = DType("bool", np.bool_)
+uint8 = DType("uint8", np.uint8)
+int8 = DType("int8", np.int8)
+int16 = DType("int16", np.int16)
+int32 = DType("int32", np.int32)
+int64 = DType("int64", np.int64)
+float16 = DType("float16", np.float16)
+bfloat16 = DType("bfloat16", jnp.bfloat16)
+float32 = DType("float32", np.float32)
+float64 = DType("float64", np.float64)
+complex64 = DType("complex64", np.complex64)
+complex128 = DType("complex128", np.complex128)
+
+_ALL = [bool_, uint8, int8, int16, int32, int64, float16, bfloat16,
+        float32, float64, complex64, complex128]
+_BY_NAME = {d.name: d for d in _ALL}
+_BY_NAME["bool"] = bool_
+_STR_ALIASES = {"bool": np.bool_, "bfloat16": jnp.bfloat16}
+
+
+def convert_dtype(dtype) -> DType:
+    """Normalize any dtype spec (str / numpy / jax / DType) to a DType."""
+    if dtype is None:
+        return float32
+    if isinstance(dtype, DType):
+        return dtype
+    if isinstance(dtype, str) and dtype in _BY_NAME:
+        return _BY_NAME[dtype]
+    jd = jnp.dtype(_canon(dtype))
+    name = jd.name
+    if name in _BY_NAME:
+        return _BY_NAME[name]
+    raise TypeError(f"Unsupported dtype: {dtype!r}")
+
+
+def to_jax_dtype(dtype):
+    return convert_dtype(dtype).np_dtype
+
+
+def to_paddle_dtype_name(dtype) -> str:
+    return convert_dtype(dtype).name
+
+
+def is_floating_point_dtype(dtype) -> bool:
+    return jnp.issubdtype(to_jax_dtype(dtype), jnp.floating)
+
+
+def is_integer_dtype(dtype) -> bool:
+    return jnp.issubdtype(to_jax_dtype(dtype), jnp.integer)
+
+
+def default_float_dtype() -> DType:
+    return float32

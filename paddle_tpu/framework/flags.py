@@ -1,0 +1,59 @@
+"""Global flags registry.
+
+Reference: gflags table in `paddle/fluid/platform/flags.cc` +
+`pybind/global_value_getter_setter.cc` (paddle.set_flags/get_flags).
+Here flags are a plain validated dict; a few map onto jax.config.
+"""
+from __future__ import annotations
+
+import os
+from typing import Any, Dict, Iterable
+
+__all__ = ["set_flags", "get_flags", "register_flag", "flag"]
+
+_FLAGS: Dict[str, Any] = {}
+
+
+def register_flag(name: str, default: Any, doc: str = "") -> None:
+    env = os.environ.get(name)
+    if env is not None:
+        if isinstance(default, bool):
+            default = env.lower() in ("1", "true", "yes")
+        elif isinstance(default, int):
+            default = int(env)
+        elif isinstance(default, float):
+            default = float(env)
+        else:
+            default = env
+    _FLAGS[name] = default
+
+
+# Subset of the reference's 32 flags that are meaningful on TPU, plus ours.
+register_flag("FLAGS_check_nan_inf", False,
+              "scan op outputs for nan/inf (reference platform/flags.cc:44)")
+register_flag("FLAGS_eager_op_jit", True,
+              "compile eager ops through a cached jit rather than op-by-op")
+register_flag("FLAGS_allocator_strategy", "xla",
+              "kept for parity; XLA owns allocation on TPU")
+register_flag("FLAGS_fraction_of_gpu_memory_to_use", 0.92, "parity no-op")
+register_flag("FLAGS_cudnn_deterministic", False, "parity: deterministic ops")
+register_flag("FLAGS_benchmark", False, "sync after every op for timing")
+register_flag("FLAGS_use_flash_attention", True,
+              "use the Pallas flash-attention kernel on TPU when applicable")
+
+
+def set_flags(flags: Dict[str, Any]) -> None:
+    for k, v in flags.items():
+        if k not in _FLAGS:
+            raise KeyError(f"Unknown flag {k!r}")
+        _FLAGS[k] = v
+
+
+def get_flags(names: Iterable[str] | str) -> Dict[str, Any]:
+    if isinstance(names, str):
+        names = [names]
+    return {n: _FLAGS[n] for n in names}
+
+
+def flag(name: str) -> Any:
+    return _FLAGS[name]

@@ -1,0 +1,149 @@
+"""Places and device selection.
+
+Reference: `paddle/fluid/platform/place.h` (CPUPlace/CUDAPlace variants) and
+`paddle.set_device`. TPU-native redesign: a Place names a jax device; the
+default place drives `jax.default_device` so eager ops run where the user
+asked without per-op copies.
+"""
+from __future__ import annotations
+
+import threading
+
+import jax
+
+__all__ = [
+    "Place", "CPUPlace", "TPUPlace", "CUDAPlace", "set_device", "get_device",
+    "default_place", "device_for", "is_compiled_with_cuda",
+    "is_compiled_with_tpu", "device_count",
+]
+
+
+class Place:
+    """Names a device. `device()` resolves to the live jax.Device."""
+
+    kind = "cpu"
+
+    def __init__(self, device_id: int = 0):
+        self.device_id = int(device_id)
+
+    def device(self) -> jax.Device:
+        devs = [d for d in jax.devices() if d.platform == self._platform()]
+        if not devs:
+            # Graceful fallback: asked-for platform absent (e.g. TPUPlace in a
+            # CPU test env) → first available device.
+            devs = jax.devices()
+        return devs[min(self.device_id, len(devs) - 1)]
+
+    def _platform(self) -> str:
+        return self.kind
+
+    def __eq__(self, other):
+        return (type(self) is type(other)
+                and self.device_id == other.device_id)
+
+    def __hash__(self):
+        return hash((type(self).__name__, self.device_id))
+
+    def __repr__(self):
+        return f"{type(self).__name__}({self.device_id})"
+
+
+class CPUPlace(Place):
+    kind = "cpu"
+
+    def __init__(self):
+        super().__init__(0)
+
+    def __repr__(self):
+        return "CPUPlace"
+
+
+class TPUPlace(Place):
+    kind = "tpu"
+
+    def _platform(self):
+        # Under the axon tunnel the platform may report as 'axon'/'tpu'.
+        plats = {d.platform for d in jax.devices()}
+        for p in ("tpu", "axon"):
+            if p in plats:
+                return p
+        return "cpu"
+
+
+class CUDAPlace(Place):
+    """API-parity alias: maps onto the accelerator place (there is no CUDA
+    in this framework; kept so reference code using CUDAPlace keeps working)."""
+    kind = "gpu"
+
+    def _platform(self):
+        plats = {d.platform for d in jax.devices()}
+        for p in ("gpu", "tpu", "axon"):
+            if p in plats:
+                return p
+        return "cpu"
+
+
+class _State(threading.local):
+    def __init__(self):
+        self.place: Place | None = None
+
+
+_state = _State()
+
+
+def _auto_place() -> Place:
+    plats = {d.platform for d in jax.devices()}
+    if "tpu" in plats or "axon" in plats:
+        return TPUPlace(0)
+    if "gpu" in plats:
+        return CUDAPlace(0)
+    return CPUPlace()
+
+
+def default_place() -> Place:
+    if _state.place is None:
+        _state.place = _auto_place()
+    return _state.place
+
+
+def device_for(place: Place | None = None) -> jax.Device:
+    return (place or default_place()).device()
+
+
+def set_device(device: str) -> Place:
+    """paddle.set_device('cpu' | 'tpu' | 'tpu:0' | 'gpu:0')."""
+    if isinstance(device, Place):
+        _state.place = device
+        return device
+    name, _, idx = device.partition(":")
+    idx = int(idx) if idx else 0
+    name = name.lower()
+    if name == "cpu":
+        place: Place = CPUPlace()
+    elif name in ("tpu", "xpu", "npu", "axon"):
+        place = TPUPlace(idx)
+    elif name in ("gpu", "cuda"):
+        place = CUDAPlace(idx)
+    else:
+        raise ValueError(f"Unknown device {device!r}")
+    _state.place = place
+    return place
+
+
+def get_device() -> str:
+    p = default_place()
+    if isinstance(p, CPUPlace):
+        return "cpu"
+    return f"{p.kind}:{p.device_id}"
+
+
+def is_compiled_with_cuda() -> bool:
+    return False
+
+
+def is_compiled_with_tpu() -> bool:
+    return any(d.platform in ("tpu", "axon") for d in jax.devices())
+
+
+def device_count() -> int:
+    return len(jax.devices())

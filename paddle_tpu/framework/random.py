@@ -1,0 +1,60 @@
+"""Seed / PRNG management.
+
+The reference uses stateful per-device generators (`paddle.seed`,
+`framework/generator.cc`). JAX PRNG is functional; this module bridges the
+two: a stateful *scope stack* of PRNG keys. Eager code uses the global
+scope (mutating split per draw — same UX as paddle.seed); functionalized
+code (jit / to_static / Model.fit) pushes a scope seeded from an explicit
+key so random ops stay trace-safe (the number of splits is static per trace).
+"""
+from __future__ import annotations
+
+import contextlib
+import threading
+
+import jax
+
+__all__ = ["seed", "get_rng_key", "rng_scope", "default_seed"]
+
+default_seed = 0
+
+
+class _RngScope:
+    __slots__ = ("key",)
+
+    def __init__(self, key):
+        self.key = key
+
+    def next_key(self):
+        self.key, sub = jax.random.split(self.key)
+        return sub
+
+
+class _State(threading.local):
+    def __init__(self):
+        self.stack = [_RngScope(jax.random.PRNGKey(default_seed))]
+
+
+_state = _State()
+
+
+def seed(s: int):
+    """paddle.seed — reset the global generator."""
+    _state.stack[0] = _RngScope(jax.random.PRNGKey(int(s)))
+    return _state.stack[0]
+
+
+def get_rng_key():
+    """Draw a fresh subkey from the innermost scope (stateful split)."""
+    return _state.stack[-1].next_key()
+
+
+@contextlib.contextmanager
+def rng_scope(key):
+    """Run a block with an explicit PRNG key (used by functional capture)."""
+    scope = _RngScope(key)
+    _state.stack.append(scope)
+    try:
+        yield scope
+    finally:
+        _state.stack.pop()

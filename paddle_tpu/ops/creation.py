@@ -1,0 +1,157 @@
+"""Creation ops (reference `python/paddle/tensor/creation.py`)."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..framework.dtype import to_jax_dtype
+from ..framework.tensor import Tensor, to_tensor
+
+__all__ = [
+    "to_tensor", "ones", "zeros", "full", "ones_like", "zeros_like",
+    "full_like", "arange", "linspace", "logspace", "eye", "empty",
+    "empty_like", "meshgrid", "diag", "diagflat", "tril", "triu", "assign",
+    "clone", "numel", "tril_indices", "triu_indices",
+]
+
+
+def _shape(shape):
+    if isinstance(shape, Tensor):
+        return tuple(int(x) for x in np.asarray(shape._value))
+    if isinstance(shape, (int, np.integer)):
+        return (int(shape),)
+    return tuple(int(x) for x in shape)
+
+
+def ones(shape, dtype=None, name=None):
+    return Tensor(jnp.ones(_shape(shape), to_jax_dtype(dtype)))
+
+
+def zeros(shape, dtype=None, name=None):
+    return Tensor(jnp.zeros(_shape(shape), to_jax_dtype(dtype)))
+
+
+def full(shape, fill_value, dtype=None, name=None):
+    if isinstance(fill_value, Tensor):
+        fill_value = fill_value.item()
+    if dtype is None:
+        return Tensor(jnp.full(_shape(shape), fill_value))
+    return Tensor(jnp.full(_shape(shape), fill_value, to_jax_dtype(dtype)))
+
+
+def _like(x):
+    return x._value if isinstance(x, Tensor) else jnp.asarray(x)
+
+
+def ones_like(x, dtype=None, name=None):
+    v = jnp.ones_like(_like(x))
+    return Tensor(v if dtype is None else v.astype(to_jax_dtype(dtype)))
+
+
+def zeros_like(x, dtype=None, name=None):
+    v = jnp.zeros_like(_like(x))
+    return Tensor(v if dtype is None else v.astype(to_jax_dtype(dtype)))
+
+
+def full_like(x, fill_value, dtype=None, name=None):
+    v = jnp.full_like(_like(x), fill_value)
+    return Tensor(v if dtype is None else v.astype(to_jax_dtype(dtype)))
+
+
+def empty(shape, dtype=None, name=None):
+    return zeros(shape, dtype)
+
+
+def empty_like(x, dtype=None, name=None):
+    return zeros_like(x, dtype)
+
+
+def arange(start=0, end=None, step=1, dtype=None, name=None):
+    def _v(x):
+        return x.item() if isinstance(x, Tensor) else x
+    start, end, step = _v(start), _v(end), _v(step)
+    if end is None:
+        start, end = 0, start
+    dt = None if dtype is None else to_jax_dtype(dtype)
+    if dt is None and all(isinstance(v, (int, np.integer))
+                          for v in (start, end, step)):
+        dt = jnp.int64 if False else jnp.dtype("int64")
+    return Tensor(jnp.arange(start, end, step, dt))
+
+
+def linspace(start, stop, num, dtype=None, name=None):
+    def _v(x):
+        return x.item() if isinstance(x, Tensor) else x
+    return Tensor(jnp.linspace(_v(start), _v(stop), int(_v(num)),
+                               dtype=to_jax_dtype(dtype)))
+
+
+def logspace(start, stop, num, base=10.0, dtype=None, name=None):
+    return Tensor(jnp.logspace(start, stop, int(num), base=base,
+                               dtype=to_jax_dtype(dtype)))
+
+
+def eye(num_rows, num_columns=None, dtype=None, name=None):
+    return Tensor(jnp.eye(int(num_rows),
+                          None if num_columns is None else int(num_columns),
+                          dtype=to_jax_dtype(dtype)))
+
+
+def meshgrid(*args, **kwargs):
+    arrs = [a._value if isinstance(a, Tensor) else jnp.asarray(a)
+            for a in (args[0] if len(args) == 1 and isinstance(args[0], (list, tuple)) else args)]
+    return [Tensor(v) for v in jnp.meshgrid(*arrs, indexing="ij")]
+
+
+from ..framework.tensor import apply_op
+
+
+def diag(x, offset=0, padding_value=0, name=None):
+    def impl(v):
+        if v.ndim == 1 and padding_value != 0:
+            n = v.shape[0] + abs(offset)
+            base = jnp.full((n, n), padding_value, v.dtype)
+            return base + jnp.diag(v, k=offset) - jnp.diag(
+                jnp.full((v.shape[0],), padding_value, v.dtype), k=offset)
+        return jnp.diag(v, k=offset)
+    return apply_op("diag", impl, (x,), {})
+
+
+def diagflat(x, offset=0, name=None):
+    return apply_op("diagflat", lambda v: jnp.diagflat(v, k=offset), (x,), {})
+
+
+def tril(x, diagonal=0, name=None):
+    return apply_op("tril", lambda v: jnp.tril(v, k=diagonal), (x,), {})
+
+
+def triu(x, diagonal=0, name=None):
+    return apply_op("triu", lambda v: jnp.triu(v, k=diagonal), (x,), {})
+
+
+def tril_indices(row, col=None, offset=0, dtype="int64"):
+    r, c = jnp.tril_indices(row, k=offset, m=col)
+    return Tensor(jnp.stack([r, c]).astype(to_jax_dtype(dtype)))
+
+
+def triu_indices(row, col=None, offset=0, dtype="int64"):
+    r, c = jnp.triu_indices(row, k=offset, m=col)
+    return Tensor(jnp.stack([r, c]).astype(to_jax_dtype(dtype)))
+
+
+def assign(x, output=None):
+    v = x._value if isinstance(x, Tensor) else jnp.asarray(np.asarray(x))
+    if output is not None:
+        output.set_value(v)
+        return output
+    return Tensor(v)
+
+
+def clone(x, name=None):
+    return apply_op("clone", lambda v: v + jnp.zeros_like(v), (x,), {})
+
+
+def numel(x, name=None):
+    return Tensor(jnp.asarray(int(np.prod(x.shape)) if x.shape else 1,
+                              dtype="int64"))

@@ -1,0 +1,208 @@
+"""Linear algebra ops (reference `python/paddle/tensor/linalg.py`,
+`operators/matmul_v2_op.*`). matmul is THE MXU op — everything routes to
+jnp.matmul/einsum so XLA tiles it onto the systolic array."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..framework.tensor import Tensor, apply_op
+
+__all__ = ["matmul", "mm", "bmm", "dot", "mv", "norm", "dist", "cross",
+           "cholesky", "inverse", "det", "slogdet", "matrix_power", "svd",
+           "qr", "eigh", "eigvalsh", "solve", "triangular_solve", "pinv",
+           "lstsq", "einsum", "multi_dot", "matrix_rank", "histogram",
+           "bincount", "cov", "corrcoef"]
+
+
+def matmul(x, y, transpose_x=False, transpose_y=False, name=None):
+    def impl(a, b):
+        if transpose_x:
+            a = jnp.swapaxes(a, -1, -2) if a.ndim > 1 else a
+        if transpose_y:
+            b = jnp.swapaxes(b, -1, -2) if b.ndim > 1 else b
+        return jnp.matmul(a, b)
+    return apply_op("matmul", impl, (x, y), {})
+
+
+def mm(input, mat2, name=None):
+    return apply_op("mm", jnp.matmul, (input, mat2), {})
+
+
+def bmm(x, y, name=None):
+    return apply_op("bmm", jnp.matmul, (x, y), {})
+
+
+def dot(x, y, name=None):
+    def impl(a, b):
+        if a.ndim == 1:
+            return jnp.dot(a, b)
+        return jnp.sum(a * b, axis=-1)
+    return apply_op("dot", impl, (x, y), {})
+
+
+def mv(x, vec, name=None):
+    return apply_op("mv", jnp.matmul, (x, vec), {})
+
+
+def norm(x, p="fro", axis=None, keepdim=False, name=None):
+    def impl(v):
+        if p == "fro" and axis is None:
+            return jnp.sqrt(jnp.sum(v * v))
+        if axis is None:
+            flat = v.reshape(-1)
+            return jnp.linalg.norm(flat, ord=p)
+        if isinstance(axis, (list, tuple)):
+            return jnp.linalg.norm(v, ord="fro" if p == "fro" else p,
+                                   axis=tuple(axis), keepdims=keepdim)
+        if p == jnp.inf or p == float("inf"):
+            return jnp.max(jnp.abs(v), axis=axis, keepdims=keepdim)
+        if p == -jnp.inf or p == float("-inf"):
+            return jnp.min(jnp.abs(v), axis=axis, keepdims=keepdim)
+        if p == 0:
+            return jnp.sum((v != 0).astype(v.dtype), axis=axis,
+                           keepdims=keepdim)
+        return jnp.sum(jnp.abs(v) ** p, axis=axis,
+                       keepdims=keepdim) ** (1.0 / p)
+    return apply_op("norm", impl, (x,), {})
+
+
+def dist(x, y, p=2, name=None):
+    def impl(a, b):
+        d = a - b
+        if p == 0:
+            return jnp.sum((d != 0).astype(d.dtype)).astype(d.dtype)
+        if p == float("inf"):
+            return jnp.max(jnp.abs(d))
+        if p == float("-inf"):
+            return jnp.min(jnp.abs(d))
+        return jnp.sum(jnp.abs(d) ** p) ** (1.0 / p)
+    return apply_op("dist", impl, (x, y), {})
+
+
+def cross(x, y, axis=9, name=None):
+    def impl(a, b):
+        ax = axis
+        if ax == 9:
+            ax = next(i for i, s in enumerate(a.shape) if s == 3)
+        return jnp.cross(a, b, axis=ax)
+    return apply_op("cross", impl, (x, y), {})
+
+
+def cholesky(x, upper=False, name=None):
+    def impl(v):
+        L = jnp.linalg.cholesky(v)
+        return jnp.swapaxes(L, -1, -2) if upper else L
+    return apply_op("cholesky", impl, (x,), {})
+
+
+def inverse(x, name=None):
+    return apply_op("inverse", jnp.linalg.inv, (x,), {})
+
+
+def det(x, name=None):
+    return apply_op("det", jnp.linalg.det, (x,), {})
+
+
+def slogdet(x, name=None):
+    def impl(v):
+        sign, logdet = jnp.linalg.slogdet(v)
+        return jnp.stack([sign, logdet])
+    return apply_op("slogdet", impl, (x,), {})
+
+
+def matrix_power(x, n, name=None):
+    return apply_op("matrix_power",
+                    lambda v: jnp.linalg.matrix_power(v, n), (x,), {})
+
+
+def svd(x, full_matrices=False, name=None):
+    return apply_op("svd",
+                    lambda v: jnp.linalg.svd(v, full_matrices=full_matrices),
+                    (x,), {})
+
+
+def qr(x, mode="reduced", name=None):
+    return apply_op("qr", lambda v: jnp.linalg.qr(v, mode=mode), (x,), {})
+
+
+def eigh(x, UPLO="L", name=None):
+    return apply_op("eigh", lambda v: jnp.linalg.eigh(v, UPLO=UPLO), (x,), {})
+
+
+def eigvalsh(x, UPLO="L", name=None):
+    return apply_op("eigvalsh", lambda v: jnp.linalg.eigvalsh(v, UPLO=UPLO),
+                    (x,), {})
+
+
+def solve(x, y, name=None):
+    return apply_op("solve", jnp.linalg.solve, (x, y), {})
+
+
+def triangular_solve(x, y, upper=True, transpose=False, unitriangular=False,
+                     name=None):
+    return apply_op(
+        "triangular_solve",
+        lambda a, b: jax.scipy.linalg.solve_triangular(
+            a, b, lower=not upper, trans=1 if transpose else 0,
+            unit_diagonal=unitriangular), (x, y), {})
+
+
+def pinv(x, rcond=1e-15, hermitian=False, name=None):
+    return apply_op("pinv",
+                    lambda v: jnp.linalg.pinv(v, rtol=rcond,
+                                              hermitian=hermitian), (x,), {})
+
+
+def lstsq(x, y, rcond=None, driver=None, name=None):
+    def impl(a, b):
+        sol, res, rank, sv = jnp.linalg.lstsq(a, b, rcond=rcond)
+        return sol, res, rank, sv
+    return apply_op("lstsq", impl, (x, y), {})
+
+
+def einsum(equation, *operands):
+    return apply_op(
+        "einsum",
+        lambda *vs: jnp.einsum(equation, *vs, precision=jax.lax.Precision.HIGHEST),
+        tuple(operands), {})
+
+
+def multi_dot(x, name=None):
+    return apply_op("multi_dot", lambda *vs: jnp.linalg.multi_dot(vs),
+                    tuple(x), {})
+
+
+def matrix_rank(x, tol=None, hermitian=False, name=None):
+    return apply_op("matrix_rank",
+                    lambda v: jnp.linalg.matrix_rank(v, rtol=tol), (x,), {})
+
+
+def histogram(input, bins=100, min=0, max=0, name=None):
+    def impl(v):
+        lo, hi = (min, max) if (min != 0 or max != 0) else (v.min(), v.max())
+        h, _ = jnp.histogram(v, bins=bins, range=(lo, hi))
+        return h.astype("int64")
+    return apply_op("histogram", impl, (input,), {})
+
+
+def bincount(x, weights=None, minlength=0, name=None):
+    if weights is None:
+        return apply_op("bincount",
+                        lambda v: jnp.bincount(v, minlength=minlength,
+                                               length=None), (x,), {})
+    return apply_op("bincount",
+                    lambda v, w: jnp.bincount(v, weights=w,
+                                              minlength=minlength), (x, weights),
+                    {})
+
+
+def cov(x, rowvar=True, ddof=True, fweights=None, aweights=None, name=None):
+    return apply_op("cov",
+                    lambda v: jnp.cov(v, rowvar=rowvar,
+                                      ddof=1 if ddof else 0), (x,), {})
+
+
+def corrcoef(x, rowvar=True, name=None):
+    return apply_op("corrcoef", lambda v: jnp.corrcoef(v, rowvar=rowvar),
+                    (x,), {})

@@ -1,0 +1,68 @@
+"""Comparison / logical / bitwise ops (reference
+`paddle/fluid/operators/controlflow/compare_op.cc`, `logical_op.cc`)."""
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..framework.tensor import Tensor, apply_op
+
+__all__ = ["equal", "not_equal", "less_than", "less_equal", "greater_than",
+           "greater_equal", "logical_and", "logical_or", "logical_xor",
+           "logical_not", "equal_all", "allclose", "isclose", "is_empty",
+           "bitwise_and", "bitwise_or", "bitwise_xor", "bitwise_not",
+           "is_tensor"]
+
+
+def _cmp(name, fn):
+    def op(x, y, name=None):
+        return apply_op(name, fn, (x, y), {})
+    op.__name__ = name
+    return op
+
+
+equal = _cmp("equal", jnp.equal)
+not_equal = _cmp("not_equal", jnp.not_equal)
+less_than = _cmp("less_than", jnp.less)
+less_equal = _cmp("less_equal", jnp.less_equal)
+greater_than = _cmp("greater_than", jnp.greater)
+greater_equal = _cmp("greater_equal", jnp.greater_equal)
+logical_and = _cmp("logical_and", jnp.logical_and)
+logical_or = _cmp("logical_or", jnp.logical_or)
+logical_xor = _cmp("logical_xor", jnp.logical_xor)
+bitwise_and = _cmp("bitwise_and", jnp.bitwise_and)
+bitwise_or = _cmp("bitwise_or", jnp.bitwise_or)
+bitwise_xor = _cmp("bitwise_xor", jnp.bitwise_xor)
+
+
+def logical_not(x, name=None):
+    return apply_op("logical_not", jnp.logical_not, (x,), {})
+
+
+def bitwise_not(x, name=None):
+    return apply_op("bitwise_not", jnp.bitwise_not, (x,), {})
+
+
+def equal_all(x, y, name=None):
+    return apply_op("equal_all",
+                    lambda a, b: jnp.array_equal(a, b), (x, y), {})
+
+
+def allclose(x, y, rtol=1e-05, atol=1e-08, equal_nan=False, name=None):
+    return apply_op("allclose",
+                    lambda a, b: jnp.allclose(a, b, rtol=rtol, atol=atol,
+                                              equal_nan=equal_nan), (x, y), {})
+
+
+def isclose(x, y, rtol=1e-05, atol=1e-08, equal_nan=False, name=None):
+    return apply_op("isclose",
+                    lambda a, b: jnp.isclose(a, b, rtol=rtol, atol=atol,
+                                             equal_nan=equal_nan), (x, y), {})
+
+
+def is_empty(x, name=None):
+    return Tensor(jnp.asarray(int(np.prod(x.shape)) == 0))
+
+
+def is_tensor(x):
+    return isinstance(x, Tensor)

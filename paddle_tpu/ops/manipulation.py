@@ -1,0 +1,417 @@
+"""Shape/layout manipulation ops (reference
+`python/paddle/tensor/manipulation.py`, kernels across
+`paddle/fluid/operators/`). All static-shape friendly ⇒ jit/pjit-safe,
+except the documented dynamic-shape ops (nonzero/unique/masked_select)
+which are eager-only, mirroring the reference's LoD-style dynamism."""
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ..framework.dtype import to_jax_dtype
+from ..framework.tensor import Tensor, apply_op
+
+__all__ = [
+    "reshape", "flatten", "transpose", "squeeze", "unsqueeze", "concat",
+    "stack", "split", "chunk", "tile", "expand", "expand_as", "broadcast_to",
+    "gather", "gather_nd", "scatter", "scatter_nd_add", "index_select",
+    "masked_select", "where", "roll", "flip", "cast", "t", "moveaxis",
+    "unbind", "repeat_interleave", "take_along_axis", "put_along_axis",
+    "slice", "strided_slice", "unique", "nonzero", "pad", "flip", "rot90",
+    "unstack", "crop", "shard_index", "broadcast_tensors", "atleast_1d",
+    "as_real", "as_complex", "tensordot", "masked_fill", "index_put",
+    "index_add", "diagonal", "one_hot",
+]
+
+
+def _ints(v):
+    if isinstance(v, Tensor):
+        return tuple(int(x) for x in np.asarray(v._value))
+    if isinstance(v, (int, np.integer)):
+        return (int(v),)
+    return tuple(int(x.item() if isinstance(x, Tensor) else x) for x in v)
+
+
+def reshape(x, shape, name=None):
+    return apply_op("reshape", lambda v: jnp.reshape(v, _ints(shape)), (x,), {})
+
+
+def flatten(x, start_axis=0, stop_axis=-1, name=None):
+    def impl(v):
+        nd = v.ndim
+        s = start_axis % nd if nd else 0
+        e = stop_axis % nd if nd else 0
+        new = v.shape[:s] + (-1,) + v.shape[e + 1:]
+        return jnp.reshape(v, new)
+    return apply_op("flatten", impl, (x,), {})
+
+
+def transpose(x, perm, name=None):
+    return apply_op("transpose", lambda v: jnp.transpose(v, _ints(perm)),
+                    (x,), {})
+
+
+def t(x, name=None):
+    def impl(v):
+        if v.ndim < 2:
+            return v
+        return jnp.swapaxes(v, -1, -2)
+    return apply_op("t", impl, (x,), {})
+
+
+def moveaxis(x, source, destination, name=None):
+    return apply_op("moveaxis",
+                    lambda v: jnp.moveaxis(v, source, destination), (x,), {})
+
+
+def squeeze(x, axis=None, name=None):
+    def impl(v):
+        if axis is None:
+            return jnp.squeeze(v)
+        axes = _ints(axis)
+        axes = tuple(a % v.ndim for a in axes)
+        axes = tuple(a for a in axes if v.shape[a] == 1)
+        return jnp.squeeze(v, axis=axes) if axes else v
+    return apply_op("squeeze", impl, (x,), {})
+
+
+def unsqueeze(x, axis, name=None):
+    def impl(v):
+        out = v
+        for a in sorted(_ints(axis)):
+            out = jnp.expand_dims(out, a)
+        return out
+    return apply_op("unsqueeze", impl, (x,), {})
+
+
+def concat(x, axis=0, name=None):
+    if isinstance(axis, Tensor):
+        axis = int(axis.item())
+    tensors = list(x)
+    return apply_op("concat",
+                    lambda *vs: jnp.concatenate(vs, axis=axis), tuple(tensors),
+                    {})
+
+
+def stack(x, axis=0, name=None):
+    return apply_op("stack", lambda *vs: jnp.stack(vs, axis=axis),
+                    tuple(x), {})
+
+
+def unbind(x, axis=0, name=None):
+    n = x.shape[axis]
+    return list(apply_op(
+        "unbind",
+        lambda v: tuple(jnp.moveaxis(v, axis, 0)[i] for i in range(n)),
+        (x,), {}))
+
+
+def unstack(x, axis=0, num=None, name=None):
+    n = num if num is not None else x.shape[axis]
+    return apply_op("unstack",
+                    lambda v: tuple(jnp.moveaxis(v, axis, 0)[i]
+                                    for i in range(n)), (x,), {})
+
+
+def split(x, num_or_sections, axis=0, name=None):
+    if isinstance(axis, Tensor):
+        axis = int(axis.item())
+
+    def impl(v):
+        dim = v.shape[axis]
+        if isinstance(num_or_sections, int):
+            return tuple(jnp.split(v, num_or_sections, axis=axis))
+        secs = [s.item() if isinstance(s, Tensor) else s
+                for s in num_or_sections]
+        known = [s for s in secs if s != -1]
+        secs = [s if s != -1 else dim - int(np.sum(known)) for s in secs]
+        idx = np.cumsum(secs)[:-1].tolist()
+        return tuple(jnp.split(v, idx, axis=axis))
+    return apply_op("split", impl, (x,), {})
+
+
+def chunk(x, chunks, axis=0, name=None):
+    return split(x, chunks, axis)
+
+
+def tile(x, repeat_times, name=None):
+    return apply_op("tile", lambda v: jnp.tile(v, _ints(repeat_times)),
+                    (x,), {})
+
+
+def broadcast_to(x, shape, name=None):
+    return apply_op("broadcast_to",
+                    lambda v: jnp.broadcast_to(v, _ints(shape)), (x,), {})
+
+
+def expand(x, shape, name=None):
+    def impl(v):
+        target = list(_ints(shape))
+        # paddle expand: -1 keeps original dim
+        nd = len(target)
+        vshape = (1,) * (nd - v.ndim) + v.shape
+        target = [vs if t == -1 else t for t, vs in zip(target, vshape)]
+        return jnp.broadcast_to(jnp.reshape(v, vshape), target)
+    return apply_op("expand", impl, (x,), {})
+
+
+def expand_as(x, y, name=None):
+    return apply_op("expand_as",
+                    lambda v, w: jnp.broadcast_to(v, w.shape), (x, y), {})
+
+
+def cast(x, dtype):
+    dt = to_jax_dtype(dtype)
+    return apply_op("cast", lambda v: v.astype(dt), (x,), {})
+
+
+def gather(x, index, axis=0, name=None):
+    if isinstance(axis, Tensor):
+        axis = int(axis.item())
+    return apply_op("gather",
+                    lambda v, i: jnp.take(v, i.reshape(-1) if i.ndim > 1 else i,
+                                          axis=axis), (x, index), {})
+
+
+def gather_nd(x, index, name=None):
+    def impl(v, idx):
+        # reference operators/gather_nd_op: idx last dim indexes leading dims
+        return v[tuple(jnp.moveaxis(idx, -1, 0))]
+    return apply_op("gather_nd", impl, (x, index), {})
+
+
+def scatter(x, index, updates, overwrite=True, name=None):
+    def impl(v, i, u):
+        i = i.reshape(-1)
+        if overwrite:
+            # paddle semantics: later rows win; .set gives that
+            return v.at[i].set(u)
+        base = v.at[i].set(jnp.zeros_like(u))
+        return base.at[i].add(u)
+    return apply_op("scatter", impl, (x, index, updates), {})
+
+
+def scatter_nd_add(x, index, updates, name=None):
+    def impl(v, i, u):
+        return v.at[tuple(jnp.moveaxis(i, -1, 0))].add(u)
+    return apply_op("scatter_nd_add", impl, (x, index, updates), {})
+
+
+def index_select(x, index, axis=0, name=None):
+    return apply_op("index_select",
+                    lambda v, i: jnp.take(v, i, axis=axis), (x, index), {})
+
+
+def index_add(x, index, axis, value, name=None):
+    def impl(v, i, u):
+        return jnp.apply_along_axis  # placeholder never hit
+    def impl2(v, i, u):
+        vm = jnp.moveaxis(v, axis, 0)
+        um = jnp.moveaxis(u, axis, 0)
+        out = vm.at[i].add(um)
+        return jnp.moveaxis(out, 0, axis)
+    return apply_op("index_add", impl2, (x, index, value), {})
+
+
+def index_put(x, indices, value, accumulate=False, name=None):
+    def impl(v, u, *idx):
+        ref = v.at[tuple(idx)]
+        return ref.add(u) if accumulate else ref.set(u)
+    return apply_op("index_put", impl, (x, value, *indices), {})
+
+
+def masked_select(x, mask, name=None):
+    # dynamic output shape ⇒ eager-only (documented)
+    v = np.asarray(x._value)
+    m = np.asarray(mask._value if isinstance(mask, Tensor) else mask)
+    return Tensor(jnp.asarray(v[m]))
+
+
+def masked_fill(x, mask, value, name=None):
+    val = value._value if isinstance(value, Tensor) else value
+    return apply_op("masked_fill",
+                    lambda v, m: jnp.where(m, jnp.asarray(val, v.dtype), v),
+                    (x, mask), {})
+
+
+def where(condition, x=None, y=None, name=None):
+    if x is None and y is None:
+        return nonzero(condition, as_tuple=False)
+    return apply_op("where",
+                    lambda c, a, b: jnp.where(c, a, b), (condition, x, y), {})
+
+
+def nonzero(x, as_tuple=False):
+    v = np.asarray(x._value if isinstance(x, Tensor) else x)
+    nz = np.nonzero(v)
+    if as_tuple:
+        return tuple(Tensor(jnp.asarray(i.reshape(-1, 1))) for i in nz)
+    return Tensor(jnp.asarray(np.stack(nz, axis=1).astype(np.int64)))
+
+
+def unique(x, return_index=False, return_inverse=False, return_counts=False,
+           axis=None, dtype="int64", name=None):
+    v = np.asarray(x._value)
+    res = np.unique(v, return_index=return_index,
+                    return_inverse=return_inverse,
+                    return_counts=return_counts, axis=axis)
+    if not isinstance(res, tuple):
+        return Tensor(jnp.asarray(res))
+    out = [Tensor(jnp.asarray(r)) for r in res]
+    return tuple(out)
+
+
+def roll(x, shifts, axis=None, name=None):
+    return apply_op("roll", lambda v: jnp.roll(v, shifts, axis=axis), (x,), {})
+
+
+def flip(x, axis, name=None):
+    ax = _ints(axis) if not isinstance(axis, int) else (axis,)
+    return apply_op("flip", lambda v: jnp.flip(v, axis=ax), (x,), {})
+
+
+def rot90(x, k=1, axes=(0, 1), name=None):
+    return apply_op("rot90", lambda v: jnp.rot90(v, k=k, axes=tuple(axes)),
+                    (x,), {})
+
+
+def repeat_interleave(x, repeats, axis=None, name=None):
+    r = repeats._value if isinstance(repeats, Tensor) else repeats
+    return apply_op("repeat_interleave",
+                    lambda v: jnp.repeat(v, r, axis=axis), (x,), {})
+
+
+def take_along_axis(arr, indices, axis, broadcast=True, name=None):
+    return apply_op("take_along_axis",
+                    lambda v, i: jnp.take_along_axis(v, i, axis=axis),
+                    (arr, indices), {})
+
+
+def put_along_axis(arr, indices, values, axis, reduce="assign", name=None):
+    def impl(v, i, u):
+        u = jnp.broadcast_to(jnp.asarray(u, v.dtype), i.shape)
+        vm = jnp.moveaxis(v, axis, 0)
+        im = jnp.moveaxis(i, axis, 0)
+        um = jnp.moveaxis(u, axis, 0)
+        grid = jnp.indices(im.shape)[1:]
+        ref = vm.at[(im, *grid)]
+        out = ref.add(um) if reduce == "add" else (
+            ref.multiply(um) if reduce == "mul" else ref.set(um))
+        return jnp.moveaxis(out, 0, axis)
+    vals = values if isinstance(values, Tensor) else Tensor(jnp.asarray(values))
+    return apply_op("put_along_axis", impl, (arr, indices, vals), {})
+
+
+import builtins
+
+builtins_slice = builtins.slice
+
+
+def slice(input, axes, starts, ends, name=None):
+    axes = _ints(axes)
+    starts = _ints(starts)
+    ends = _ints(ends)
+
+    def impl(v):
+        idx = [builtins_slice(None)] * v.ndim
+        for a, s, e in zip(axes, starts, ends):
+            idx[a] = builtins_slice(s, e)
+        return v[tuple(idx)]
+    return apply_op("slice", impl, (input,), {})
+
+
+def strided_slice(x, axes, starts, ends, strides, name=None):
+    axes, starts, ends, strides = map(_ints, (axes, starts, ends, strides))
+
+    def impl(v):
+        idx = [builtins_slice(None)] * v.ndim
+        for a, s, e, st in zip(axes, starts, ends, strides):
+            idx[a] = builtins_slice(s, e, st)
+        return v[tuple(idx)]
+    return apply_op("strided_slice", impl, (x,), {})
+
+
+def pad(x, pad, mode="constant", value=0.0, data_format="NCHW", name=None):
+    pads = _ints(pad)
+
+    def impl(v):
+        nd = v.ndim
+        if len(pads) == 2 * nd:
+            width = [(pads[2 * i], pads[2 * i + 1]) for i in range(nd)]
+        else:
+            # paddle: pad applies to last len(pads)//2 spatial dims (NCHW/NHWC)
+            width = [(0, 0)] * nd
+            spatial = len(pads) // 2
+            if data_format.endswith("C"):  # NHWC / NLC / NDHWC
+                dims = list(range(1, 1 + spatial))
+            else:
+                dims = list(range(nd - spatial, nd))
+            for j, d in enumerate(dims):
+                width[d] = (pads[2 * j], pads[2 * j + 1])
+        jmode = {"constant": "constant", "reflect": "reflect",
+                 "replicate": "edge", "circular": "wrap"}[mode]
+        if jmode == "constant":
+            return jnp.pad(v, width, mode="constant", constant_values=value)
+        return jnp.pad(v, width, mode=jmode)
+    return apply_op("pad", impl, (x,), {})
+
+
+def crop(x, shape=None, offsets=None, name=None):
+    shp = _ints(shape)
+    offs = _ints(offsets) if offsets is not None else (0,) * len(shp)
+
+    def impl(v):
+        idx = tuple(builtins_slice(o, o + s) for o, s in zip(offs, shp))
+        return v[idx]
+    return apply_op("crop", impl, (x,), {})
+
+
+def shard_index(input, index_num, nshards, shard_id, ignore_value=-1):
+    """reference `operators/shard_index_op` (used by parallel embedding)."""
+    def impl(i):
+        shard_size = (index_num + nshards - 1) // nshards
+        lo = shard_id * shard_size
+        hi = lo + shard_size
+        in_shard = (i >= lo) & (i < hi)
+        return jnp.where(in_shard, i - lo, ignore_value)
+    return apply_op("shard_index", impl, (input,), {})
+
+
+def broadcast_tensors(inputs, name=None):
+    shapes = [tuple(t.shape) for t in inputs]
+    target = np.broadcast_shapes(*shapes)
+    return [broadcast_to(t, target) for t in inputs]
+
+
+def atleast_1d(*inputs):
+    outs = [apply_op("atleast_1d", jnp.atleast_1d, (x,), {}) for x in inputs]
+    return outs[0] if len(outs) == 1 else outs
+
+
+def as_real(x, name=None):
+    return apply_op("as_real",
+                    lambda v: jnp.stack([jnp.real(v), jnp.imag(v)], -1),
+                    (x,), {})
+
+
+def as_complex(x, name=None):
+    return apply_op("as_complex",
+                    lambda v: jax.lax.complex(v[..., 0], v[..., 1]), (x,), {})
+
+
+def tensordot(x, y, axes=2, name=None):
+    return apply_op("tensordot", lambda a, b: jnp.tensordot(a, b, axes=axes),
+                    (x, y), {})
+
+
+def diagonal(x, offset=0, axis1=0, axis2=1, name=None):
+    return apply_op("diagonal",
+                    lambda v: jnp.diagonal(v, offset=offset, axis1=axis1,
+                                           axis2=axis2), (x,), {})
+
+
+def one_hot(x, num_classes, name=None):
+    return apply_op("one_hot",
+                    lambda v: jax.nn.one_hot(v, num_classes, dtype="float32"),
+                    (x,), {})

@@ -1,0 +1,232 @@
+"""Math ops (reference `python/paddle/tensor/math.py`; kernels in
+`paddle/fluid/operators/elementwise/`, `activation_op.*`). All lower to XLA
+elementwise HLO — fusion is the compiler's job (no hand-written CUDA)."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..framework.dtype import to_jax_dtype
+from ..framework.tensor import Tensor, apply_op
+
+__all__ = []
+
+
+def _raw(x):
+    return x._value if isinstance(x, Tensor) else x
+
+
+def _unary(name, fn):
+    def op(x, name=None):
+        return apply_op(name, fn, (x,), {})
+    op.__name__ = name
+    globals()[name] = op
+    __all__.append(name)
+    return op
+
+
+def _binary(name, fn):
+    def op(x, y, name=None):
+        return apply_op(name, fn, (x, y), {})
+    op.__name__ = name
+    globals()[name] = op
+    __all__.append(name)
+    return op
+
+
+_unary("exp", jnp.exp)
+_unary("expm1", jnp.expm1)
+_unary("log", jnp.log)
+_unary("log2", jnp.log2)
+_unary("log10", jnp.log10)
+_unary("log1p", jnp.log1p)
+_unary("sqrt", jnp.sqrt)
+_unary("rsqrt", jax.lax.rsqrt)
+_unary("square", jnp.square)
+_unary("abs", jnp.abs)
+_unary("neg", jnp.negative)
+_unary("sign", jnp.sign)
+_unary("floor", jnp.floor)
+_unary("ceil", jnp.ceil)
+_unary("round", jnp.round)
+_unary("trunc", jnp.trunc)
+_unary("frac", lambda v: v - jnp.trunc(v))
+_unary("sin", jnp.sin)
+_unary("cos", jnp.cos)
+_unary("tan", jnp.tan)
+_unary("asin", jnp.arcsin)
+_unary("acos", jnp.arccos)
+_unary("atan", jnp.arctan)
+_unary("sinh", jnp.sinh)
+_unary("cosh", jnp.cosh)
+_unary("tanh", jnp.tanh)
+_unary("asinh", jnp.arcsinh)
+_unary("acosh", jnp.arccosh)
+_unary("atanh", jnp.arctanh)
+_unary("reciprocal", jnp.reciprocal)
+_unary("erf", jax.lax.erf)
+_unary("erfinv", jax.lax.erf_inv)
+_unary("sigmoid", jax.nn.sigmoid)
+_unary("digamma", jax.scipy.special.digamma)
+_unary("lgamma", jax.scipy.special.gammaln)
+_unary("angle", jnp.angle)
+_unary("conj", jnp.conj)
+_unary("real", jnp.real)
+_unary("imag", jnp.imag)
+
+_binary("add", jnp.add)
+_binary("subtract", jnp.subtract)
+_binary("multiply", jnp.multiply)
+_binary("divide", jnp.divide)
+_binary("floor_divide", jnp.floor_divide)
+_binary("mod", jnp.mod)
+_binary("remainder", jnp.mod)
+_binary("floor_mod", jnp.mod)
+_binary("pow_", jnp.power)
+_binary("maximum", jnp.maximum)
+_binary("minimum", jnp.minimum)
+_binary("fmax", jnp.fmax)
+_binary("fmin", jnp.fmin)
+_binary("atan2", jnp.arctan2)
+_binary("logaddexp", jnp.logaddexp)
+_binary("heaviside", jnp.heaviside)
+_binary("kron", jnp.kron)
+_binary("outer", jnp.outer)
+_binary("inner", jnp.inner)
+_binary("gcd", lambda a, b: jnp.gcd(a, b))
+_binary("lcm", lambda a, b: jnp.lcm(a, b))
+
+
+def pow(x, y, name=None):
+    return apply_op("pow", jnp.power, (x, y), {})
+
+
+__all__.append("pow")
+
+
+def elementwise_add(x, y, axis=-1, name=None):
+    return add(x, y)
+
+
+def elementwise_mul(x, y, axis=-1, name=None):
+    return multiply(x, y)
+
+
+def elementwise_sub(x, y, axis=-1, name=None):
+    return subtract(x, y)
+
+
+def elementwise_div(x, y, axis=-1, name=None):
+    return divide(x, y)
+
+
+__all__ += ["elementwise_add", "elementwise_mul", "elementwise_sub",
+            "elementwise_div"]
+
+
+def scale(x, scale=1.0, bias=0.0, bias_after_scale=True, act=None, name=None):
+    """reference `operators/scale_op.cc`."""
+    def impl(v, s, b):
+        out = v * s + b if bias_after_scale else (v + b) * s
+        return out
+    s = _raw(scale) if isinstance(scale, Tensor) else scale
+    return apply_op("scale", lambda v: impl(v, s, bias), (x,), {})
+
+
+def clip(x, min=None, max=None, name=None):
+    mn = _raw(min) if isinstance(min, Tensor) else min
+    mx = _raw(max) if isinstance(max, Tensor) else max
+    return apply_op("clip", lambda v: jnp.clip(v, mn, mx), (x,), {})
+
+
+def lerp(x, y, weight, name=None):
+    if isinstance(weight, Tensor):
+        return apply_op("lerp", lambda a, b, w: a + w * (b - a),
+                        (x, y, weight), {})
+    return apply_op("lerp", lambda a, b: a + weight * (b - a), (x, y), {})
+
+
+def stanh(x, scale_a=0.67, scale_b=1.7159, name=None):
+    return apply_op("stanh", lambda v: scale_b * jnp.tanh(scale_a * v), (x,), {})
+
+
+def logit(x, eps=None, name=None):
+    def impl(v):
+        u = v if eps is None else jnp.clip(v, eps, 1 - eps)
+        return jnp.log(u / (1 - u))
+    return apply_op("logit", impl, (x,), {})
+
+
+def multiplex(inputs, index, name=None):
+    def impl(idx, *xs):
+        stacked = jnp.stack(xs, 0)
+        return jnp.take_along_axis(
+            stacked, idx.reshape(1, -1, *([1] * (stacked.ndim - 2))), axis=0)[0]
+    return apply_op("multiplex", lambda *xs: impl(xs[-1], *xs[:-1]),
+                    (*inputs, index), {})
+
+
+def cumsum(x, axis=None, dtype=None, name=None):
+    dt = None if dtype is None else to_jax_dtype(dtype)
+    return apply_op("cumsum", lambda v: jnp.cumsum(v, axis=axis, dtype=dt),
+                    (x,), {})
+
+
+def cumprod(x, dim=None, dtype=None, name=None):
+    dt = None if dtype is None else to_jax_dtype(dtype)
+    return apply_op("cumprod", lambda v: jnp.cumprod(v, axis=dim, dtype=dt),
+                    (x,), {})
+
+
+def cummax(x, axis=None, dtype="int64", name=None):
+    def impl(v):
+        a = 0 if axis is None else axis
+        vv = v.reshape(-1) if axis is None else v
+        vals = jax.lax.associative_scan(jnp.maximum, vv, axis=a)
+        return vals
+    return apply_op("cummax", impl, (x,), {})
+
+
+def isnan(x, name=None):
+    return apply_op("isnan", jnp.isnan, (x,), {})
+
+
+def isinf(x, name=None):
+    return apply_op("isinf", jnp.isinf, (x,), {})
+
+
+def isfinite(x, name=None):
+    return apply_op("isfinite", jnp.isfinite, (x,), {})
+
+
+def nan_to_num(x, nan=0.0, posinf=None, neginf=None, name=None):
+    return apply_op("nan_to_num",
+                    lambda v: jnp.nan_to_num(v, nan=nan, posinf=posinf,
+                                             neginf=neginf), (x,), {})
+
+
+def increment(x, value=1.0, name=None):
+    out = apply_op("increment", lambda v: v + value, (x,), {})
+    x.set_value(out._value)
+    return x
+
+
+def addmm(input, x, y, beta=1.0, alpha=1.0, name=None):
+    return apply_op("addmm",
+                    lambda i, a, b: beta * i + alpha * (a @ b),
+                    (input, x, y), {})
+
+
+def trace(x, offset=0, axis1=0, axis2=1, name=None):
+    return apply_op("trace",
+                    lambda v: jnp.trace(v, offset=offset, axis1=axis1,
+                                        axis2=axis2), (x,), {})
+
+
+def diff(x, n=1, axis=-1, prepend=None, append=None, name=None):
+    return apply_op("diff", lambda v: jnp.diff(v, n=n, axis=axis), (x,), {})
+
+
+__all__ += ["scale", "clip", "lerp", "stanh", "logit", "multiplex", "cumsum",
+            "cumprod", "cummax", "isnan", "isinf", "isfinite", "nan_to_num",
+            "increment", "addmm", "trace", "diff"]

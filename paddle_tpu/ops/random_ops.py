@@ -1,0 +1,123 @@
+"""Random ops (reference `python/paddle/tensor/random.py`,
+`operators/gaussian_random_op` etc). Keys come from the PRNG scope stack
+(`framework/random.py`): stateful UX eagerly, trace-safe under capture."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..framework.dtype import to_jax_dtype
+from ..framework.random import get_rng_key
+from ..framework.tensor import Tensor, apply_op
+
+__all__ = ["rand", "randn", "randint", "randint_like", "uniform", "normal",
+           "standard_normal", "bernoulli", "multinomial", "randperm",
+           "poisson", "uniform_", "normal_", "shuffle"]
+
+
+def _shape(shape):
+    if isinstance(shape, Tensor):
+        return tuple(int(x) for x in shape.tolist())
+    if isinstance(shape, int):
+        return (shape,)
+    return tuple(int(s.item() if isinstance(s, Tensor) else s) for s in shape)
+
+
+def rand(shape, dtype=None, name=None):
+    return Tensor(jax.random.uniform(get_rng_key(), _shape(shape),
+                                     to_jax_dtype(dtype)))
+
+
+def randn(shape, dtype=None, name=None):
+    return Tensor(jax.random.normal(get_rng_key(), _shape(shape),
+                                    to_jax_dtype(dtype)))
+
+
+standard_normal = randn
+
+
+def randint(low=0, high=None, shape=(1,), dtype="int64", name=None):
+    if high is None:
+        low, high = 0, low
+    return Tensor(jax.random.randint(get_rng_key(), _shape(shape), low, high,
+                                     to_jax_dtype(dtype)))
+
+
+def randint_like(x, low=0, high=None, dtype=None, name=None):
+    if high is None:
+        low, high = 0, low
+    dt = x._value.dtype if dtype is None else to_jax_dtype(dtype)
+    return Tensor(jax.random.randint(get_rng_key(), x._value.shape, low, high,
+                                     dt))
+
+
+def uniform(shape, dtype="float32", min=-1.0, max=1.0, seed=0, name=None):
+    key = jax.random.PRNGKey(seed) if seed else get_rng_key()
+    return Tensor(jax.random.uniform(key, _shape(shape), to_jax_dtype(dtype),
+                                     minval=min, maxval=max))
+
+
+def normal(mean=0.0, std=1.0, shape=None, name=None):
+    if isinstance(mean, Tensor) or isinstance(std, Tensor):
+        m = mean._value if isinstance(mean, Tensor) else mean
+        s = std._value if isinstance(std, Tensor) else std
+        shp = jnp.broadcast_shapes(jnp.shape(m), jnp.shape(s))
+        return Tensor(m + s * jax.random.normal(get_rng_key(), shp))
+    shp = _shape(shape) if shape is not None else ()
+    return Tensor(mean + std * jax.random.normal(get_rng_key(), shp))
+
+
+def bernoulli(x, name=None):
+    key = get_rng_key()
+    return apply_op("bernoulli",
+                    lambda v: jax.random.bernoulli(key, v).astype(v.dtype),
+                    (x,), {})
+
+
+def poisson(x, name=None):
+    key = get_rng_key()
+    return apply_op("poisson",
+                    lambda v: jax.random.poisson(key, v).astype(v.dtype),
+                    (x,), {})
+
+
+def multinomial(x, num_samples=1, replacement=False, name=None):
+    key = get_rng_key()
+
+    def impl(v):
+        logits = jnp.log(jnp.clip(v, 1e-30, None))
+        if replacement:
+            return jax.random.categorical(
+                key, logits, axis=-1,
+                shape=(*v.shape[:-1], num_samples)).astype("int64")
+        # without replacement: gumbel top-k trick
+        g = jax.random.gumbel(key, v.shape)
+        _, idx = jax.lax.top_k(logits + g, num_samples)
+        return idx.astype("int64")
+    return apply_op("multinomial", impl, (x,), {})
+
+
+def randperm(n, dtype="int64", name=None):
+    return Tensor(jax.random.permutation(get_rng_key(),
+                                         n).astype(to_jax_dtype(dtype)))
+
+
+def shuffle(x, axis=0, name=None):
+    key = get_rng_key()
+    return apply_op("shuffle",
+                    lambda v: jax.random.permutation(key, v, axis=axis,
+                                                     independent=False),
+                    (x,), {})
+
+
+# in-place variants (dygraph convenience)
+def uniform_(x, min=-1.0, max=1.0, name=None):
+    x.set_value(jax.random.uniform(get_rng_key(), x._value.shape,
+                                   x._value.dtype, minval=min, maxval=max))
+    return x
+
+
+def normal_(x, mean=0.0, std=1.0, name=None):
+    x.set_value(mean + std * jax.random.normal(get_rng_key(), x._value.shape,
+                                               x._value.dtype))
+    return x

@@ -1,0 +1,126 @@
+"""Reduction ops (reference `paddle/fluid/operators/reduce_ops/`)."""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..framework.dtype import to_jax_dtype
+from ..framework.tensor import Tensor, apply_op
+
+__all__ = ["sum", "mean", "max", "min", "prod", "all", "any", "logsumexp",
+           "std", "var", "amax", "amin", "nansum", "nanmean", "count_nonzero",
+           "median", "nanmedian", "quantile"]
+
+
+def _axis(axis):
+    if isinstance(axis, Tensor):
+        axis = axis.tolist()
+    if isinstance(axis, (list, tuple)):
+        return tuple(int(a) for a in axis)
+    return axis
+
+
+def sum(x, axis=None, dtype=None, keepdim=False, name=None):
+    dt = None if dtype is None else to_jax_dtype(dtype)
+    return apply_op("reduce_sum",
+                    lambda v: jnp.sum(v, axis=_axis(axis), dtype=dt,
+                                      keepdims=keepdim), (x,), {})
+
+
+def mean(x, axis=None, keepdim=False, name=None):
+    return apply_op("reduce_mean",
+                    lambda v: jnp.mean(v, axis=_axis(axis), keepdims=keepdim),
+                    (x,), {})
+
+
+def max(x, axis=None, keepdim=False, name=None):
+    return apply_op("reduce_max",
+                    lambda v: jnp.max(v, axis=_axis(axis), keepdims=keepdim),
+                    (x,), {})
+
+
+def min(x, axis=None, keepdim=False, name=None):
+    return apply_op("reduce_min",
+                    lambda v: jnp.min(v, axis=_axis(axis), keepdims=keepdim),
+                    (x,), {})
+
+
+amax = max
+amin = min
+
+
+def prod(x, axis=None, keepdim=False, dtype=None, name=None):
+    dt = None if dtype is None else to_jax_dtype(dtype)
+    return apply_op("reduce_prod",
+                    lambda v: jnp.prod(v, axis=_axis(axis), dtype=dt,
+                                       keepdims=keepdim), (x,), {})
+
+
+def all(x, axis=None, keepdim=False, name=None):
+    return apply_op("reduce_all",
+                    lambda v: jnp.all(v, axis=_axis(axis), keepdims=keepdim),
+                    (x,), {})
+
+
+def any(x, axis=None, keepdim=False, name=None):
+    return apply_op("reduce_any",
+                    lambda v: jnp.any(v, axis=_axis(axis), keepdims=keepdim),
+                    (x,), {})
+
+
+def logsumexp(x, axis=None, keepdim=False, name=None):
+    import jax
+    return apply_op("logsumexp",
+                    lambda v: jax.scipy.special.logsumexp(
+                        v, axis=_axis(axis), keepdims=keepdim), (x,), {})
+
+
+def std(x, axis=None, unbiased=True, keepdim=False, name=None):
+    return apply_op("std",
+                    lambda v: jnp.std(v, axis=_axis(axis),
+                                      ddof=1 if unbiased else 0,
+                                      keepdims=keepdim), (x,), {})
+
+
+def var(x, axis=None, unbiased=True, keepdim=False, name=None):
+    return apply_op("var",
+                    lambda v: jnp.var(v, axis=_axis(axis),
+                                      ddof=1 if unbiased else 0,
+                                      keepdims=keepdim), (x,), {})
+
+
+def nansum(x, axis=None, dtype=None, keepdim=False, name=None):
+    dt = None if dtype is None else to_jax_dtype(dtype)
+    return apply_op("nansum",
+                    lambda v: jnp.nansum(v, axis=_axis(axis), dtype=dt,
+                                         keepdims=keepdim), (x,), {})
+
+
+def nanmean(x, axis=None, keepdim=False, name=None):
+    return apply_op("nanmean",
+                    lambda v: jnp.nanmean(v, axis=_axis(axis),
+                                          keepdims=keepdim), (x,), {})
+
+
+def count_nonzero(x, axis=None, keepdim=False, name=None):
+    return apply_op("count_nonzero",
+                    lambda v: jnp.count_nonzero(v, axis=_axis(axis),
+                                                keepdims=keepdim).astype("int64"),
+                    (x,), {})
+
+
+def median(x, axis=None, keepdim=False, name=None):
+    return apply_op("median",
+                    lambda v: jnp.median(v, axis=_axis(axis),
+                                         keepdims=keepdim), (x,), {})
+
+
+def nanmedian(x, axis=None, keepdim=False, name=None):
+    return apply_op("nanmedian",
+                    lambda v: jnp.nanmedian(v, axis=_axis(axis),
+                                            keepdims=keepdim), (x,), {})
+
+
+def quantile(x, q, axis=None, keepdim=False, name=None):
+    return apply_op("quantile",
+                    lambda v: jnp.quantile(v, q, axis=_axis(axis),
+                                           keepdims=keepdim), (x,), {})

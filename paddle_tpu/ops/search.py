@@ -1,0 +1,113 @@
+"""Search/sort ops (reference `python/paddle/tensor/search.py`,
+`operators/arg_max_op`, `top_k_v2_op`, `argsort_op`)."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..framework.dtype import to_jax_dtype
+from ..framework.tensor import Tensor, apply_op
+
+__all__ = ["argmax", "argmin", "argsort", "sort", "topk", "searchsorted",
+           "kthvalue", "mode", "index_sample"]
+
+
+def argmax(x, axis=None, keepdim=False, dtype="int64", name=None):
+    dt = to_jax_dtype(dtype)
+    return apply_op("argmax",
+                    lambda v: jnp.argmax(v, axis=axis,
+                                         keepdims=keepdim).astype(dt), (x,), {})
+
+
+def argmin(x, axis=None, keepdim=False, dtype="int64", name=None):
+    dt = to_jax_dtype(dtype)
+    return apply_op("argmin",
+                    lambda v: jnp.argmin(v, axis=axis,
+                                         keepdims=keepdim).astype(dt), (x,), {})
+
+
+def argsort(x, axis=-1, descending=False, name=None):
+    def impl(v):
+        idx = jnp.argsort(v, axis=axis, descending=descending)
+        return idx.astype("int64")
+    return apply_op("argsort", impl, (x,), {})
+
+
+def sort(x, axis=-1, descending=False, name=None):
+    return apply_op("sort",
+                    lambda v: jnp.sort(v, axis=axis, descending=descending),
+                    (x,), {})
+
+
+def topk(x, k, axis=-1, largest=True, sorted=True, name=None):
+    if isinstance(k, Tensor):
+        k = int(k.item())
+
+    def impl(v):
+        ax = axis if axis >= 0 else v.ndim + axis
+        vm = jnp.moveaxis(v, ax, -1)
+        if largest:
+            vals, idx = jax.lax.top_k(vm, k)
+        else:
+            vals, idx = jax.lax.top_k(-vm, k)
+            vals = -vals
+        return (jnp.moveaxis(vals, -1, ax),
+                jnp.moveaxis(idx, -1, ax).astype("int64"))
+    return apply_op("top_k_v2", impl, (x,), {})
+
+
+def searchsorted(sorted_sequence, values, out_int32=False, right=False,
+                 name=None):
+    side = "right" if right else "left"
+    dt = "int32" if out_int32 else "int64"
+    return apply_op("searchsorted",
+                    lambda s, v: jnp.searchsorted(s, v, side=side).astype(dt),
+                    (sorted_sequence, values), {})
+
+
+def kthvalue(x, k, axis=-1, keepdim=False, name=None):
+    def impl(v):
+        ax = axis if axis >= 0 else v.ndim + axis
+        srt = jnp.sort(v, axis=ax)
+        idx = jnp.argsort(v, axis=ax)
+        vals = jnp.take(srt, k - 1, axis=ax)
+        inds = jnp.take(idx, k - 1, axis=ax).astype("int64")
+        if keepdim:
+            vals = jnp.expand_dims(vals, ax)
+            inds = jnp.expand_dims(inds, ax)
+        return vals, inds
+    return apply_op("kthvalue", impl, (x,), {})
+
+
+def mode(x, axis=-1, keepdim=False, name=None):
+    import numpy as np
+    v = np.asarray(x._value)
+    vm = np.moveaxis(v, axis, -1)
+    srt = np.sort(vm, axis=-1)
+    # mode = most frequent value per row (ties → smallest, paddle keeps last)
+    def row_mode(r):
+        vals, counts = np.unique(r, return_counts=True)
+        m = vals[np.argmax(counts)]
+        idx = np.where(r == m)[0][-1]
+        return m, idx
+    flat = srt.reshape(-1, srt.shape[-1])
+    vflat = vm.reshape(-1, vm.shape[-1])
+    ms, idxs = [], []
+    for orig in vflat:
+        m, _ = row_mode(orig)
+        ms.append(m)
+        idxs.append(np.where(orig == m)[0][-1])
+    out_shape = vm.shape[:-1]
+    mvals = np.array(ms).reshape(out_shape)
+    minds = np.array(idxs).reshape(out_shape).astype(np.int64)
+    if keepdim:
+        mvals = np.expand_dims(mvals, axis)
+        minds = np.expand_dims(minds, axis)
+    return Tensor(jnp.asarray(mvals)), Tensor(jnp.asarray(minds))
+
+
+def index_sample(x, index):
+    """reference `operators/index_sample_op`: per-row gather."""
+    return apply_op("index_sample",
+                    lambda v, i: jnp.take_along_axis(v, i, axis=1),
+                    (x, index), {})

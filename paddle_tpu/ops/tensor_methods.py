@@ -1,0 +1,136 @@
+"""Attach the op surface to Tensor as methods + operator overloads.
+
+Reference: `python/paddle/fluid/dygraph/math_op_patch.py` (monkey-patched
+VarBase operators) — same approach, one place.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..framework.tensor import Tensor, apply_op
+from . import creation, linalg, logic, manipulation, math, reduction, search
+
+_METHOD_SOURCES = [
+    (math, ["exp", "log", "sqrt", "rsqrt", "square", "abs", "sign", "floor",
+            "ceil", "round", "trunc", "sin", "cos", "tan", "tanh", "sigmoid",
+            "erf", "reciprocal", "scale", "clip", "cumsum", "cumprod",
+            "isnan", "isinf", "isfinite", "add", "subtract", "multiply",
+            "divide", "pow", "maximum", "minimum", "mod", "floor_divide",
+            "remainder", "neg", "trace", "lerp", "addmm"]),
+    (reduction, ["sum", "mean", "max", "min", "prod", "all", "any",
+                 "logsumexp", "std", "var"]),
+    (manipulation, ["reshape", "flatten", "transpose", "squeeze", "unsqueeze",
+                    "split", "chunk", "tile", "expand", "expand_as",
+                    "broadcast_to", "gather", "gather_nd", "scatter",
+                    "index_select", "masked_select", "roll", "flip", "cast",
+                    "unbind",
+                    "repeat_interleave", "take_along_axis", "put_along_axis",
+                    "unique", "nonzero", "diagonal", "masked_fill",
+                    "moveaxis"]),
+    (linalg, ["matmul", "mm", "bmm", "dot", "norm", "dist", "cross",
+              "cholesky", "inverse", "det", "matrix_power", "mv"]),
+    (logic, ["equal", "not_equal", "less_than", "less_equal", "greater_than",
+             "greater_equal", "logical_and", "logical_or", "logical_xor",
+             "logical_not", "allclose", "isclose", "equal_all",
+             "bitwise_and", "bitwise_or", "bitwise_xor", "bitwise_not"]),
+    (search, ["argmax", "argmin", "argsort", "sort", "topk", "kthvalue"]),
+    (creation, ["tril", "triu"]),
+]
+
+for mod, names in _METHOD_SOURCES:
+    for n in set(names):
+        fn = getattr(mod, n, None)
+        if fn is not None and not hasattr(Tensor, n):
+            setattr(Tensor, n, fn)
+
+# `astype` (paddle name for cast)
+Tensor.astype = manipulation.cast
+
+
+def _coerce(other):
+    return other
+
+
+def _binop(name, fn, reverse=False):
+    def op(self, other):
+        if isinstance(other, (list, tuple, np.ndarray)):
+            other = Tensor(jnp.asarray(np.asarray(other)))
+        a, b = (other, self) if reverse else (self, other)
+        return apply_op(name, fn, (a, b), {})
+    return op
+
+
+Tensor.__add__ = _binop("add", jnp.add)
+Tensor.__radd__ = _binop("add", jnp.add, reverse=True)
+Tensor.__sub__ = _binop("subtract", jnp.subtract)
+Tensor.__rsub__ = _binop("subtract", jnp.subtract, reverse=True)
+Tensor.__mul__ = _binop("multiply", jnp.multiply)
+Tensor.__rmul__ = _binop("multiply", jnp.multiply, reverse=True)
+Tensor.__truediv__ = _binop("divide", jnp.divide)
+Tensor.__rtruediv__ = _binop("divide", jnp.divide, reverse=True)
+Tensor.__floordiv__ = _binop("floor_divide", jnp.floor_divide)
+Tensor.__rfloordiv__ = _binop("floor_divide", jnp.floor_divide, reverse=True)
+Tensor.__mod__ = _binop("mod", jnp.mod)
+Tensor.__pow__ = _binop("pow", jnp.power)
+Tensor.__rpow__ = _binop("pow", jnp.power, reverse=True)
+Tensor.__matmul__ = _binop("matmul", jnp.matmul)
+Tensor.__rmatmul__ = _binop("matmul", jnp.matmul, reverse=True)
+Tensor.__neg__ = lambda self: apply_op("neg", jnp.negative, (self,), {})
+Tensor.__abs__ = lambda self: apply_op("abs", jnp.abs, (self,), {})
+Tensor.__invert__ = lambda self: apply_op("bitwise_not", jnp.bitwise_not,
+                                          (self,), {})
+Tensor.__and__ = _binop("bitwise_and", jnp.bitwise_and)
+Tensor.__or__ = _binop("bitwise_or", jnp.bitwise_or)
+Tensor.__xor__ = _binop("bitwise_xor", jnp.bitwise_xor)
+
+Tensor.__eq__ = _binop("equal", jnp.equal)
+Tensor.__ne__ = _binop("not_equal", jnp.not_equal)
+Tensor.__lt__ = _binop("less_than", jnp.less)
+Tensor.__le__ = _binop("less_equal", jnp.less_equal)
+Tensor.__gt__ = _binop("greater_than", jnp.greater)
+Tensor.__ge__ = _binop("greater_equal", jnp.greater_equal)
+
+
+def _getitem(self, idx):
+    def unwrap(i):
+        if isinstance(i, Tensor):
+            return i._value
+        if isinstance(i, tuple):
+            return tuple(unwrap(j) for j in i)
+        return i
+    idx = unwrap(idx)
+    return apply_op("getitem", lambda v: v[idx], (self,), {})
+
+
+def _setitem(self, idx, value):
+    def unwrap(i):
+        if isinstance(i, Tensor):
+            return i._value
+        if isinstance(i, tuple):
+            return tuple(unwrap(j) for j in i)
+        return i
+    idx = unwrap(idx)
+    if isinstance(value, Tensor):
+        out = apply_op("setitem", lambda v, u: v.at[idx].set(u),
+                       (self, value), {})
+    else:
+        out = apply_op("setitem", lambda v: v.at[idx].set(value), (self,), {})
+    # in-place semantics: adopt the new value (and graph node) in place
+    self._value = out._value
+    self._node = out._node
+    if out._node is not None:
+        self.stop_gradient = False
+    return self
+
+
+Tensor.__getitem__ = _getitem
+Tensor.__setitem__ = _setitem
+
+# iteration over first axis
+def _iter(self):
+    for i in range(self.shape[0]):
+        yield self[i]
+
+
+Tensor.__iter__ = _iter

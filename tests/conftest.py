@@ -1,0 +1,10 @@
+"""Test env: force CPU with 8 virtual devices BEFORE jax import, so every
+sharding/collective test runs the same code path the driver's
+dryrun_multichip uses (xla_force_host_platform_device_count)."""
+import os
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+_flags = os.environ.get("XLA_FLAGS", "")
+if "host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (_flags +
+                               " --xla_force_host_platform_device_count=8")

@@ -1,0 +1,155 @@
+"""Op parity tests vs numpy (reference op_test.py strategy: numpy-expected
+outputs + finite-difference grad checks, `op_test.py:1033/1335`)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+
+
+def fd_grad(f, x, eps=1e-3):
+    g = np.zeros_like(x)
+    it = np.nditer(x, flags=["multi_index"])
+    while not it.finished:
+        i = it.multi_index
+        xp = x.copy(); xp[i] += eps
+        xm = x.copy(); xm[i] -= eps
+        g[i] = (f(xp) - f(xm)) / (2 * eps)
+        it.iternext()
+    return g
+
+
+@pytest.mark.parametrize("name,np_fn", [
+    ("exp", np.exp), ("log", np.log), ("sqrt", np.sqrt),
+    ("tanh", np.tanh), ("abs", np.abs), ("square", np.square),
+    ("sigmoid", lambda v: 1 / (1 + np.exp(-v))),
+])
+def test_unary_parity_and_grad(name, np_fn):
+    rng = np.random.RandomState(42)
+    x_np = (rng.rand(4, 5).astype(np.float32) + 0.5)
+    x = paddle.to_tensor(x_np, stop_gradient=False)
+    out = getattr(paddle, name)(x)
+    np.testing.assert_allclose(out.numpy(), np_fn(x_np), rtol=5e-4, atol=1e-5)
+    out.sum().backward()
+    num = fd_grad(lambda v: np_fn(v).sum(), x_np)
+    np.testing.assert_allclose(x.grad.numpy(), num, rtol=2e-2, atol=2e-3)
+
+
+def test_reductions():
+    x_np = np.random.RandomState(0).randn(3, 4, 5).astype(np.float32)
+    x = paddle.to_tensor(x_np)
+    np.testing.assert_allclose(paddle.sum(x, axis=1).numpy(), x_np.sum(1),
+                               rtol=1e-5)
+    np.testing.assert_allclose(paddle.mean(x, axis=[0, 2]).numpy(),
+                               x_np.mean((0, 2)), rtol=1e-5)
+    np.testing.assert_allclose(paddle.max(x, axis=2, keepdim=True).numpy(),
+                               x_np.max(2, keepdims=True))
+    np.testing.assert_allclose(paddle.var(x).numpy(), x_np.var(ddof=1),
+                               rtol=1e-4)
+
+
+def test_manipulation():
+    x_np = np.arange(24, dtype=np.float32).reshape(2, 3, 4)
+    x = paddle.to_tensor(x_np)
+    assert paddle.reshape(x, [6, 4]).shape == [6, 4]
+    assert paddle.transpose(x, [2, 0, 1]).shape == [4, 2, 3]
+    assert paddle.flatten(x, 1, 2).shape == [2, 12]
+    assert paddle.unsqueeze(x, [0, 2]).shape == [1, 2, 1, 3, 4]
+    assert paddle.squeeze(paddle.unsqueeze(x, [0]), [0]).shape == [2, 3, 4]
+    y = paddle.concat([x, x], axis=1)
+    assert y.shape == [2, 6, 4]
+    z = paddle.stack([x, x], axis=0)
+    assert z.shape == [2, 2, 3, 4]
+    parts = paddle.split(x, [1, 2], axis=1)
+    assert parts[0].shape == [2, 1, 4] and parts[1].shape == [2, 2, 4]
+    assert paddle.tile(x, [2, 1, 1]).shape == [4, 3, 4]
+    assert paddle.expand(paddle.to_tensor(np.ones((1, 3), np.float32)),
+                         [5, 3]).shape == [5, 3]
+
+
+def test_gather_scatter():
+    x = paddle.to_tensor(np.arange(12, dtype=np.float32).reshape(4, 3))
+    idx = paddle.to_tensor(np.array([0, 2]))
+    np.testing.assert_allclose(paddle.gather(x, idx).numpy(),
+                               [[0, 1, 2], [6, 7, 8]])
+    upd = paddle.to_tensor(np.ones((2, 3), np.float32))
+    out = paddle.scatter(x, idx, upd, overwrite=True)
+    np.testing.assert_allclose(out.numpy()[0], [1, 1, 1])
+    np.testing.assert_allclose(out.numpy()[2], [1, 1, 1])
+
+
+def test_gather_nd():
+    x = paddle.to_tensor(np.arange(12, dtype=np.float32).reshape(4, 3))
+    idx = paddle.to_tensor(np.array([[0, 1], [2, 2]]))
+    np.testing.assert_allclose(paddle.gather_nd(x, idx).numpy(), [1.0, 8.0])
+
+
+def test_where_nonzero_masked():
+    x = paddle.to_tensor(np.array([1.0, -2.0, 3.0], np.float32))
+    out = paddle.where(x > 0, x, paddle.zeros_like(x))
+    np.testing.assert_allclose(out.numpy(), [1, 0, 3])
+    nz = paddle.nonzero(x > 0)
+    np.testing.assert_allclose(nz.numpy().reshape(-1), [0, 2])
+    ms = paddle.masked_select(x, x > 0)
+    np.testing.assert_allclose(ms.numpy(), [1, 3])
+
+
+def test_linalg():
+    rng = np.random.RandomState(1)
+    a = rng.randn(3, 3).astype(np.float32)
+    a = a @ a.T + 3 * np.eye(3, dtype=np.float32)
+    x = paddle.to_tensor(a)
+    np.testing.assert_allclose(paddle.inverse(x).numpy(), np.linalg.inv(a),
+                               rtol=1e-3, atol=1e-4)
+    np.testing.assert_allclose(paddle.det(x).numpy(), np.linalg.det(a),
+                               rtol=1e-3)
+    L = paddle.cholesky(x).numpy()
+    np.testing.assert_allclose(L @ L.T, a, rtol=1e-4, atol=1e-4)
+    np.testing.assert_allclose(
+        paddle.einsum("ij,jk->ik", x, x).numpy(), a @ a, rtol=1e-4)
+
+
+def test_topk_argsort():
+    x = paddle.to_tensor(np.array([[3.0, 1.0, 2.0], [9.0, 7.0, 8.0]]))
+    vals, idx = paddle.topk(x, 2)
+    np.testing.assert_allclose(vals.numpy(), [[3, 2], [9, 8]])
+    np.testing.assert_allclose(idx.numpy(), [[0, 2], [0, 2]])
+    s = paddle.argsort(x, axis=1)
+    np.testing.assert_allclose(s.numpy(), [[1, 2, 0], [1, 2, 0]])
+
+
+def test_creation():
+    assert paddle.ones([2, 3]).numpy().sum() == 6
+    assert paddle.zeros([2]).numpy().sum() == 0
+    assert paddle.full([2, 2], 7).numpy().sum() == 28
+    np.testing.assert_allclose(paddle.arange(0, 6, 2).numpy(), [0, 2, 4])
+    assert paddle.eye(3).numpy().trace() == 3
+    np.testing.assert_allclose(paddle.linspace(0, 1, 3).numpy(), [0, 0.5, 1])
+    t = paddle.tril(paddle.ones([3, 3]))
+    assert t.numpy().sum() == 6
+
+
+def test_random_seeded():
+    paddle.seed(123)
+    a = paddle.rand([4])
+    paddle.seed(123)
+    b = paddle.rand([4])
+    np.testing.assert_allclose(a.numpy(), b.numpy())
+    r = paddle.randint(0, 10, [100])
+    assert (r.numpy() >= 0).all() and (r.numpy() < 10).all()
+    p = paddle.randperm(10)
+    assert sorted(p.numpy().tolist()) == list(range(10))
+
+
+def test_cumsum_clip_scale():
+    x = paddle.to_tensor(np.array([1.0, 2.0, 3.0], np.float32))
+    np.testing.assert_allclose(paddle.cumsum(x).numpy(), [1, 3, 6])
+    np.testing.assert_allclose(paddle.clip(x, 1.5, 2.5).numpy(),
+                               [1.5, 2, 2.5])
+    np.testing.assert_allclose(paddle.scale(x, 2.0, 1.0).numpy(), [3, 5, 7])
+
+
+def test_pad():
+    x = paddle.to_tensor(np.ones((1, 1, 2, 2), np.float32))
+    out = paddle.manipulation.pad(x, [1, 1, 1, 1], data_format="NCHW")
+    assert out.shape == [1, 1, 4, 4]
+    assert out.numpy().sum() == 4

@@ -1,0 +1,145 @@
+"""Core Tensor + tape autograd tests (mirrors reference
+`test_imperative_basic.py` / `op_test.py` grad-check strategy)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+
+
+def test_to_tensor_roundtrip():
+    x = paddle.to_tensor([[1.0, 2.0], [3.0, 4.0]])
+    assert x.shape == [2, 2]
+    assert x.dtype == paddle.float32
+    np.testing.assert_allclose(x.numpy(), [[1, 2], [3, 4]])
+
+
+def test_default_float64_downcast():
+    x = paddle.to_tensor(np.zeros((3,), dtype=np.float64))
+    assert x.dtype == paddle.float32
+    y = paddle.to_tensor(np.zeros((3,), dtype=np.float64), dtype="float64")
+    # jax x64 disabled → float64 stored as f32; dtype request honored best-effort
+    assert y.numpy().shape == (3,)
+
+
+def test_basic_arithmetic_and_backward():
+    x = paddle.to_tensor([1.0, 2.0, 3.0], stop_gradient=False)
+    y = paddle.to_tensor([4.0, 5.0, 6.0], stop_gradient=False)
+    z = (x * y + x ** 2).sum()
+    z.backward()
+    np.testing.assert_allclose(x.grad.numpy(), [4 + 2, 5 + 4, 6 + 6])
+    np.testing.assert_allclose(y.grad.numpy(), [1, 2, 3])
+
+
+def test_grad_accumulation_and_clear():
+    x = paddle.to_tensor([2.0], stop_gradient=False)
+    (x * 3).backward()
+    (x * 3).backward()
+    np.testing.assert_allclose(x.grad.numpy(), [6.0])
+    x.clear_grad()
+    assert x.grad is None
+
+
+def test_stop_gradient_blocks():
+    x = paddle.to_tensor([1.0], stop_gradient=False)
+    y = paddle.to_tensor([2.0])  # stop_gradient=True default
+    z = (x * y).sum()
+    z.backward()
+    assert y.grad is None
+    np.testing.assert_allclose(x.grad.numpy(), [2.0])
+
+
+def test_no_grad_context():
+    x = paddle.to_tensor([1.0], stop_gradient=False)
+    with paddle.no_grad():
+        y = x * 2
+    assert y._node is None
+
+
+def test_detach():
+    x = paddle.to_tensor([1.0], stop_gradient=False)
+    y = (x * 2).detach()
+    assert y.stop_gradient
+    z = y * 3
+    assert z._node is None
+
+
+def test_matmul_grad_matches_fd():
+    rng = np.random.RandomState(0)
+    a_np = rng.randn(3, 4).astype(np.float32)
+    b_np = rng.randn(4, 5).astype(np.float32)
+    a = paddle.to_tensor(a_np, stop_gradient=False)
+    b = paddle.to_tensor(b_np, stop_gradient=False)
+    out = paddle.matmul(a, b).sum()
+    out.backward()
+    np.testing.assert_allclose(a.grad.numpy(), b_np.sum(1)[None, :].repeat(3, 0),
+                               rtol=1e-5)
+
+
+def test_paddle_grad_api():
+    x = paddle.to_tensor([3.0], stop_gradient=False)
+    y = x * x
+    (gx,) = paddle.grad(y, x)
+    np.testing.assert_allclose(gx.numpy(), [6.0])
+    assert x.grad is None  # paddle.grad must not touch .grad
+
+
+def test_backward_twice_raises_without_retain():
+    x = paddle.to_tensor([1.0], stop_gradient=False)
+    y = x * 2
+    y.backward(retain_graph=False)
+    with pytest.raises(RuntimeError):
+        y.backward()
+
+
+def test_retain_graph():
+    x = paddle.to_tensor([1.0], stop_gradient=False)
+    y = x * 2
+    y.backward(retain_graph=True)
+    y.backward()
+    np.testing.assert_allclose(x.grad.numpy(), [4.0])
+
+
+def test_multi_output_op_grad():
+    x = paddle.to_tensor(np.arange(6, dtype=np.float32).reshape(2, 3),
+                         stop_gradient=False)
+    a, b = paddle.split(x, 2, axis=0)
+    (a.sum() + (b * 2).sum()).backward()
+    np.testing.assert_allclose(x.grad.numpy(),
+                               [[1, 1, 1], [2, 2, 2]])
+
+
+def test_getitem_grad():
+    x = paddle.to_tensor([1.0, 2.0, 3.0], stop_gradient=False)
+    y = x[1] * 5
+    y.backward()
+    np.testing.assert_allclose(x.grad.numpy(), [0, 5, 0])
+
+
+def test_setitem():
+    x = paddle.to_tensor([1.0, 2.0, 3.0])
+    x[1] = 9.0
+    np.testing.assert_allclose(x.numpy(), [1, 9, 3])
+
+
+def test_indexing_with_tensor():
+    x = paddle.to_tensor([10.0, 20.0, 30.0])
+    idx = paddle.to_tensor([2, 0])
+    np.testing.assert_allclose(x[idx].numpy(), [30, 10])
+
+
+def test_comparison_and_logic():
+    x = paddle.to_tensor([1.0, 2.0])
+    y = paddle.to_tensor([2.0, 2.0])
+    assert (x < y).numpy().tolist() == [True, False]
+    assert bool(paddle.allclose(x, x))
+
+
+def test_cast_astype():
+    x = paddle.to_tensor([1.5])
+    assert x.astype("int32").dtype == paddle.int32
+
+
+def test_inplace_set_value():
+    x = paddle.to_tensor([1.0, 2.0])
+    x.set_value(np.array([5.0, 6.0], dtype=np.float32))
+    np.testing.assert_allclose(x.numpy(), [5, 6])
