@@ -1,3 +1,10 @@
+import jax as _jax
+
+# Precision follows dtype (reference semantics: float32 matmul IS float32).
+# TPU perf comes from explicit bf16 params/activations (amp.auto_cast), where
+# this setting is a no-op — the MXU consumes bf16 natively.
+_jax.config.update("jax_default_matmul_precision", "highest")
+
 from . import autograd, dtype, flags, place, random
 from .autograd import (backward, enable_grad, grad, in_trace_mode,
                        is_grad_enabled, no_grad, trace_mode)
