@@ -1,0 +1,98 @@
+"""Gradient clipping (reference `python/paddle/fluid/clip.py`:
+ClipGradByValue/Norm/GlobalNorm). Operates on (param, grad) lists; also
+provides pure-pytree versions used by the functional/jitted train paths."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..framework.tensor import Tensor
+
+__all__ = ["ClipGradByValue", "ClipGradByNorm", "ClipGradByGlobalNorm",
+           "clip_by_global_norm_pytree"]
+
+
+class ClipGradBase:
+    def __call__(self, params_grads):
+        return self._clip(params_grads)
+
+    def _tree_clip(self, grads):
+        """Pure function on a pytree of raw arrays (jit path)."""
+        raise NotImplementedError
+
+
+class ClipGradByValue(ClipGradBase):
+    def __init__(self, max, min=None):
+        self.max = float(max)
+        self.min = float(min) if min is not None else -self.max
+
+    def _clip(self, params_grads):
+        out = []
+        for p, g in params_grads:
+            if g is None or (hasattr(p, "need_clip") and not p.need_clip):
+                out.append((p, g))
+                continue
+            out.append((p, Tensor(jnp.clip(g._value, self.min, self.max))))
+        return out
+
+    def _tree_clip(self, grads):
+        return jax.tree_util.tree_map(
+            lambda g: jnp.clip(g, self.min, self.max), grads)
+
+
+class ClipGradByNorm(ClipGradBase):
+    def __init__(self, clip_norm):
+        self.clip_norm = float(clip_norm)
+
+    def _clip(self, params_grads):
+        out = []
+        for p, g in params_grads:
+            if g is None or (hasattr(p, "need_clip") and not p.need_clip):
+                out.append((p, g))
+                continue
+            v = g._value
+            n = jnp.sqrt(jnp.sum(v * v))
+            scale = jnp.where(n > self.clip_norm, self.clip_norm / n, 1.0)
+            out.append((p, Tensor(v * scale)))
+        return out
+
+    def _tree_clip(self, grads):
+        def one(g):
+            n = jnp.sqrt(jnp.sum(g * g))
+            return g * jnp.where(n > self.clip_norm, self.clip_norm / n, 1.0)
+        return jax.tree_util.tree_map(one, grads)
+
+
+class ClipGradByGlobalNorm(ClipGradBase):
+    def __init__(self, clip_norm, group_name="default_group"):
+        self.clip_norm = float(clip_norm)
+        self.group_name = group_name
+
+    def _clip(self, params_grads):
+        sq = []
+        for p, g in params_grads:
+            if g is None or (hasattr(p, "need_clip") and not p.need_clip):
+                continue
+            sq.append(jnp.sum(g._value.astype("float32") ** 2))
+        if not sq:
+            return params_grads
+        gn = jnp.sqrt(jnp.sum(jnp.stack(sq)))
+        scale = self.clip_norm / jnp.maximum(gn, self.clip_norm)
+        out = []
+        for p, g in params_grads:
+            if g is None or (hasattr(p, "need_clip") and not p.need_clip):
+                out.append((p, g))
+            else:
+                out.append((p, Tensor(g._value * scale.astype(g._value.dtype))))
+        return out
+
+    def _tree_clip(self, grads):
+        leaves = jax.tree_util.tree_leaves(grads)
+        gn = jnp.sqrt(sum(jnp.sum(g.astype("float32") ** 2) for g in leaves))
+        scale = self.clip_norm / jnp.maximum(gn, self.clip_norm)
+        return jax.tree_util.tree_map(
+            lambda g: g * scale.astype(g.dtype), grads)
+
+
+def clip_by_global_norm_pytree(grads, clip_norm):
+    return ClipGradByGlobalNorm(clip_norm)._tree_clip(grads)

@@ -1,0 +1,187 @@
+"""Activation functionals (reference `python/paddle/nn/functional/activation.py`,
+kernels `paddle/fluid/operators/activation_op.*`). Pure elementwise — XLA
+fuses them into surrounding matmuls/convs."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ...framework.tensor import apply_op
+
+__all__ = ["relu", "relu6", "relu_", "leaky_relu", "prelu", "elu", "selu",
+           "celu", "gelu", "silu", "swish", "sigmoid", "hardsigmoid",
+           "hardswish", "hardtanh", "hardshrink", "softshrink", "tanhshrink",
+           "softplus", "softsign", "tanh", "mish", "maxout", "softmax",
+           "log_softmax", "log_sigmoid", "glu", "gumbel_softmax",
+           "thresholded_relu"]
+
+
+def relu(x, name=None):
+    return apply_op("relu", jax.nn.relu, (x,), {})
+
+
+relu_ = relu
+
+
+def relu6(x, name=None):
+    return apply_op("relu6", jax.nn.relu6, (x,), {})
+
+
+def leaky_relu(x, negative_slope=0.01, name=None):
+    return apply_op("leaky_relu",
+                    lambda v: jax.nn.leaky_relu(v, negative_slope), (x,), {})
+
+
+def prelu(x, weight, data_format="NCHW", name=None):
+    def impl(v, w):
+        if w.size == 1:
+            return jnp.where(v >= 0, v, w.reshape(()) * v)
+        ch_axis = 1 if data_format == "NCHW" else v.ndim - 1
+        shape = [1] * v.ndim
+        shape[ch_axis] = w.size
+        return jnp.where(v >= 0, v, w.reshape(shape) * v)
+    return apply_op("prelu", impl, (x, weight), {})
+
+
+def elu(x, alpha=1.0, name=None):
+    return apply_op("elu", lambda v: jax.nn.elu(v, alpha), (x,), {})
+
+
+def selu(x, scale=1.0507009873554805, alpha=1.6732632423543772, name=None):
+    return apply_op("selu",
+                    lambda v: scale * jnp.where(v > 0, v,
+                                                alpha * jnp.expm1(v)), (x,), {})
+
+
+def celu(x, alpha=1.0, name=None):
+    return apply_op("celu", lambda v: jax.nn.celu(v, alpha), (x,), {})
+
+
+def gelu(x, approximate=False, name=None):
+    return apply_op("gelu",
+                    lambda v: jax.nn.gelu(v, approximate=approximate), (x,), {})
+
+
+def silu(x, name=None):
+    return apply_op("silu", jax.nn.silu, (x,), {})
+
+
+def swish(x, name=None):
+    return silu(x)
+
+
+def sigmoid(x, name=None):
+    return apply_op("sigmoid", jax.nn.sigmoid, (x,), {})
+
+
+def log_sigmoid(x, name=None):
+    return apply_op("log_sigmoid", jax.nn.log_sigmoid, (x,), {})
+
+
+def hardsigmoid(x, slope=0.1666667, offset=0.5, name=None):
+    return apply_op("hardsigmoid",
+                    lambda v: jnp.clip(slope * v + offset, 0.0, 1.0), (x,), {})
+
+
+def hardswish(x, name=None):
+    return apply_op("hardswish",
+                    lambda v: v * jnp.clip(v + 3.0, 0.0, 6.0) / 6.0, (x,), {})
+
+
+def hardtanh(x, min=-1.0, max=1.0, name=None):
+    return apply_op("hardtanh", lambda v: jnp.clip(v, min, max), (x,), {})
+
+
+def hardshrink(x, threshold=0.5, name=None):
+    return apply_op("hardshrink",
+                    lambda v: jnp.where(jnp.abs(v) > threshold, v, 0.0),
+                    (x,), {})
+
+
+def softshrink(x, threshold=0.5, name=None):
+    return apply_op(
+        "softshrink",
+        lambda v: jnp.where(v > threshold, v - threshold,
+                            jnp.where(v < -threshold, v + threshold, 0.0)),
+        (x,), {})
+
+
+def tanhshrink(x, name=None):
+    return apply_op("tanhshrink", lambda v: v - jnp.tanh(v), (x,), {})
+
+
+def softplus(x, beta=1.0, threshold=20.0, name=None):
+    return apply_op(
+        "softplus",
+        lambda v: jnp.where(beta * v > threshold, v,
+                            jnp.log1p(jnp.exp(beta * v)) / beta), (x,), {})
+
+
+def softsign(x, name=None):
+    return apply_op("softsign", jax.nn.soft_sign, (x,), {})
+
+
+def tanh(x, name=None):
+    return apply_op("tanh", jnp.tanh, (x,), {})
+
+
+def mish(x, name=None):
+    return apply_op("mish",
+                    lambda v: v * jnp.tanh(jax.nn.softplus(v)), (x,), {})
+
+
+def maxout(x, groups, axis=1, name=None):
+    def impl(v):
+        ax = axis % v.ndim
+        c = v.shape[ax]
+        shape = v.shape[:ax] + (c // groups, groups) + v.shape[ax + 1:]
+        return jnp.max(v.reshape(shape), axis=ax + 1)
+    return apply_op("maxout", impl, (x,), {})
+
+
+def thresholded_relu(x, threshold=1.0, name=None):
+    return apply_op("thresholded_relu",
+                    lambda v: jnp.where(v > threshold, v, 0.0), (x,), {})
+
+
+def softmax(x, axis=-1, dtype=None, name=None):
+    def impl(v):
+        if dtype is not None:
+            from ...framework.dtype import to_jax_dtype
+            v = v.astype(to_jax_dtype(dtype))
+        return jax.nn.softmax(v, axis=axis)
+    return apply_op("softmax", impl, (x,), {})
+
+
+def log_softmax(x, axis=-1, dtype=None, name=None):
+    def impl(v):
+        if dtype is not None:
+            from ...framework.dtype import to_jax_dtype
+            v = v.astype(to_jax_dtype(dtype))
+        return jax.nn.log_softmax(v, axis=axis)
+    return apply_op("log_softmax", impl, (x,), {})
+
+
+def glu(x, axis=-1, name=None):
+    def impl(v):
+        a, b = jnp.split(v, 2, axis=axis)
+        return a * jax.nn.sigmoid(b)
+    return apply_op("glu", impl, (x,), {})
+
+
+def gumbel_softmax(x, temperature=1.0, hard=False, axis=-1, name=None):
+    from ...framework.random import get_rng_key
+    key = get_rng_key()
+
+    def impl(v):
+        g = jax.random.gumbel(key, v.shape, v.dtype)
+        y = jax.nn.softmax((v + g) / temperature, axis=axis)
+        if hard:
+            idx = jnp.argmax(y, axis=axis, keepdims=True)
+            y_hard = jnp.zeros_like(y)
+            y_hard = jnp.put_along_axis(y_hard, idx, 1.0, axis=axis,
+                                        inplace=False)
+            # straight-through estimator
+            y = y_hard + (y - jax.lax.stop_gradient(y))
+        return y
+    return apply_op("gumbel_softmax", impl, (x,), {})

@@ -1,0 +1,66 @@
+"""Attention functionals.
+
+The reference (~v2.0) has no fused attention op — MultiHeadAttention is
+composed in Python (`python/paddle/nn/layer/transformer.py:87`). Here
+scaled-dot-product attention is a first-class functional with a Pallas
+flash-attention fast path on TPU (paddle_tpu/ops/pallas_ops.py) and a pure
+jnp fallback that XLA fuses well on any backend.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ...framework.flags import flag
+from ...framework.tensor import apply_op
+
+__all__ = ["scaled_dot_product_attention"]
+
+
+def _sdpa_ref(q, k, v, mask, scale, is_causal):
+    # q,k,v: [B, H, S, D]
+    s = jnp.einsum("bhqd,bhkd->bhqk", q, k) * scale
+    if is_causal:
+        S, K = s.shape[-2], s.shape[-1]
+        causal = jnp.tril(jnp.ones((S, K), bool))
+        s = jnp.where(causal, s, -1e30)
+    if mask is not None:
+        if mask.dtype == jnp.bool_:
+            s = jnp.where(mask, s, -1e30)
+        else:
+            s = s + mask
+    p = jax.nn.softmax(s, axis=-1)
+    return jnp.einsum("bhqk,bhkd->bhqd", p, v)
+
+
+def scaled_dot_product_attention(query, key, value, attn_mask=None,
+                                 dropout_p=0.0, is_causal=False,
+                                 training=True, name=None):
+    """query/key/value: [batch, num_heads, seq, head_dim] (BHSD)."""
+    d = query.shape[-1]
+    scale = 1.0 / (d ** 0.5)
+
+    use_flash = False
+    if flag("FLAGS_use_flash_attention") and attn_mask is None and \
+            dropout_p == 0.0:
+        try:
+            import jax as _j
+            plats = {dd.platform for dd in _j.devices()}
+            use_flash = "tpu" in plats or "axon" in plats
+        except Exception:
+            use_flash = False
+
+    if use_flash:
+        from ...ops.pallas_ops import flash_attention
+        return flash_attention(query, key, value, causal=is_causal,
+                               scale=scale)
+
+    def impl(q, k, v, *m):
+        mask = m[0] if m else None
+        return _sdpa_ref(q, k, v, mask, scale, is_causal)
+    args = (query, key, value) + ((attn_mask,) if attn_mask is not None else ())
+    out = apply_op("sdpa", impl, args, {})
+    if dropout_p > 0.0 and training:
+        from .common import dropout
+        out = dropout(out, dropout_p, training=training)
+    return out

@@ -1,0 +1,232 @@
+"""Common functionals: linear / dropout / embedding / interpolate / pad…
+(reference `python/paddle/nn/functional/common.py`, `input.py`)."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ...framework.random import get_rng_key
+from ...framework.tensor import Tensor, apply_op
+from ...ops.manipulation import pad as _pad_op
+
+__all__ = ["linear", "dropout", "dropout2d", "dropout3d", "alpha_dropout",
+           "embedding", "one_hot", "label_smooth", "pad", "interpolate",
+           "upsample", "cosine_similarity", "pixel_shuffle", "unfold",
+           "bilinear", "pairwise_distance", "normalize", "sequence_mask"]
+
+
+def linear(x, weight, bias=None, name=None):
+    """x @ W + b with paddle weight layout [in_features, out_features]
+    (reference `operators/matmul_v2_op` + elementwise_add fusion; on TPU the
+    bias add fuses into the MXU matmul epilogue via XLA)."""
+    if bias is None:
+        return apply_op("linear", jnp.matmul, (x, weight), {})
+    return apply_op("linear", lambda v, w, b: jnp.matmul(v, w) + b,
+                    (x, weight, bias), {})
+
+
+def dropout(x, p=0.5, axis=None, training=True, mode="upscale_in_train",
+            name=None):
+    if not training or p == 0.0:
+        return x if isinstance(x, Tensor) else Tensor(x)
+    key = get_rng_key()
+
+    def impl(v):
+        shape = list(v.shape)
+        if axis is not None:
+            axes = [axis] if isinstance(axis, int) else list(axis)
+            shape = [s if i in axes else 1 for i, s in enumerate(v.shape)]
+        keep = 1.0 - p
+        mask = jax.random.bernoulli(key, keep, tuple(shape))
+        if mode == "upscale_in_train":
+            return jnp.where(mask, v / keep, 0.0).astype(v.dtype)
+        return jnp.where(mask, v, 0.0).astype(v.dtype)
+    return apply_op("dropout", impl, (x,), {})
+
+
+def dropout2d(x, p=0.5, training=True, data_format="NCHW", name=None):
+    axis = [0, 1] if data_format == "NCHW" else [0, 3]
+    return dropout(x, p, axis=axis, training=training)
+
+
+def dropout3d(x, p=0.5, training=True, data_format="NCDHW", name=None):
+    axis = [0, 1] if data_format == "NCDHW" else [0, 4]
+    return dropout(x, p, axis=axis, training=training)
+
+
+def alpha_dropout(x, p=0.5, training=True, name=None):
+    if not training or p == 0.0:
+        return x
+    key = get_rng_key()
+
+    def impl(v):
+        alpha = 1.6732632423543772
+        scale = 1.0507009873554805
+        alpha_p = -alpha * scale
+        keep = 1.0 - p
+        a = (keep + alpha_p ** 2 * keep * (1 - keep)) ** -0.5
+        b = -a * alpha_p * (1 - keep)
+        mask = jax.random.bernoulli(key, keep, v.shape)
+        return (a * jnp.where(mask, v, alpha_p) + b).astype(v.dtype)
+    return apply_op("alpha_dropout", impl, (x,), {})
+
+
+def embedding(x, weight, padding_idx=None, sparse=False, name=None):
+    """reference `operators/lookup_table_v2_op`. sparse is accepted for API
+    parity; on TPU the gather lowers to a dynamic-gather HLO either way."""
+    def impl(i, w):
+        out = jnp.take(w, i, axis=0)
+        if padding_idx is not None:
+            mask = (i == padding_idx)[..., None]
+            out = jnp.where(mask, 0.0, out)
+        return out
+    return apply_op("embedding", lambda i, w: impl(i, w), (x, weight), {})
+
+
+def one_hot(x, num_classes, name=None):
+    return apply_op("one_hot",
+                    lambda v: jax.nn.one_hot(v, num_classes, dtype="float32"),
+                    (x,), {})
+
+
+def label_smooth(label, prior_dist=None, epsilon=0.1, name=None):
+    def impl(v):
+        k = v.shape[-1]
+        return (1 - epsilon) * v + epsilon / k
+    if prior_dist is not None:
+        return apply_op("label_smooth",
+                        lambda v, p: (1 - epsilon) * v + epsilon * p,
+                        (label, prior_dist), {})
+    return apply_op("label_smooth", impl, (label,), {})
+
+
+pad = _pad_op
+
+
+def interpolate(x, size=None, scale_factor=None, mode="nearest",
+                align_corners=False, align_mode=0, data_format="NCHW",
+                name=None):
+    """reference `operators/interpolate_v2_op` — jax.image.resize backed."""
+    def impl(v):
+        chan_last = data_format in ("NHWC", "NWC", "NDHWC")
+        spatial_nd = v.ndim - 2
+        if chan_last:
+            spat = v.shape[1:-1]
+        else:
+            spat = v.shape[2:]
+        if size is not None:
+            tgt = [int(s.item() if isinstance(s, Tensor) else s)
+                   for s in (size if isinstance(size, (list, tuple)) else [size])]
+        else:
+            sf = (scale_factor if isinstance(scale_factor, (list, tuple))
+                  else [scale_factor] * spatial_nd)
+            tgt = [int(d * f) for d, f in zip(spat, sf)]
+        jmode = {"nearest": "nearest", "bilinear": "bilinear",
+                 "trilinear": "trilinear", "bicubic": "bicubic",
+                 "linear": "linear", "area": "linear"}[mode]
+        if chan_last:
+            out_shape = (v.shape[0], *tgt, v.shape[-1])
+        else:
+            out_shape = (v.shape[0], v.shape[1], *tgt)
+        return jax.image.resize(v, out_shape, method=jmode)
+    return apply_op("interpolate", impl, (x,), {})
+
+
+def upsample(x, size=None, scale_factor=None, mode="nearest",
+             align_corners=False, align_mode=0, data_format="NCHW", name=None):
+    return interpolate(x, size, scale_factor, mode, align_corners, align_mode,
+                       data_format)
+
+
+def cosine_similarity(x1, x2, axis=1, eps=1e-8):
+    def impl(a, b):
+        num = jnp.sum(a * b, axis=axis)
+        d1 = jnp.sqrt(jnp.sum(a * a, axis=axis))
+        d2 = jnp.sqrt(jnp.sum(b * b, axis=axis))
+        return num / jnp.clip(d1 * d2, eps, None)
+    return apply_op("cosine_similarity", impl, (x1, x2), {})
+
+
+def pairwise_distance(x, y, p=2.0, epsilon=1e-6, keepdim=False, name=None):
+    def impl(a, b):
+        d = a - b + epsilon
+        return jnp.sum(jnp.abs(d) ** p, axis=-1,
+                       keepdims=keepdim) ** (1.0 / p)
+    return apply_op("pairwise_distance", impl, (x, y), {})
+
+
+def normalize(x, p=2, axis=1, epsilon=1e-12, name=None):
+    def impl(v):
+        nrm = jnp.sum(jnp.abs(v) ** p, axis=axis, keepdims=True) ** (1.0 / p)
+        return v / jnp.clip(nrm, epsilon, None)
+    return apply_op("normalize", impl, (x,), {})
+
+
+def pixel_shuffle(x, upscale_factor, data_format="NCHW", name=None):
+    r = upscale_factor
+
+    def impl(v):
+        if data_format == "NCHW":
+            n, c, h, w = v.shape
+            v = v.reshape(n, c // (r * r), r, r, h, w)
+            v = v.transpose(0, 1, 4, 2, 5, 3)
+            return v.reshape(n, c // (r * r), h * r, w * r)
+        n, h, w, c = v.shape
+        v = v.reshape(n, h, w, r, r, c // (r * r))
+        v = v.transpose(0, 1, 3, 2, 4, 5)
+        return v.reshape(n, h * r, w * r, c // (r * r))
+    return apply_op("pixel_shuffle", impl, (x,), {})
+
+
+def unfold(x, kernel_sizes, strides=1, paddings=0, dilations=1, name=None):
+    """im2col (reference `operators/unfold_op`)."""
+    def to2(v):
+        return (v, v) if isinstance(v, int) else tuple(v)
+    kh, kw = to2(kernel_sizes)
+    sh, sw = to2(strides)
+    dh, dw = to2(dilations)
+    if isinstance(paddings, int):
+        pads = (paddings,) * 4
+    elif len(paddings) == 2:
+        pads = (paddings[0], paddings[0], paddings[1], paddings[1])
+    else:
+        pads = tuple(paddings)
+
+    def impl(v):
+        n, c, h, w = v.shape
+        v = jnp.pad(v, ((0, 0), (0, 0), (pads[0], pads[1]),
+                        (pads[2], pads[3])))
+        patches = jax.lax.conv_general_dilated_patches(
+            v, (kh, kw), (sh, sw), padding="VALID",
+            rhs_dilation=(dh, dw),
+            dimension_numbers=("NCHW", "OIHW", "NCHW"))
+        n2, ckk, oh, ow = patches.shape
+        return patches.reshape(n2, ckk, oh * ow)
+    return apply_op("unfold", impl, (x,), {})
+
+
+def bilinear(x1, x2, weight, bias=None, name=None):
+    def impl(a, b, w, *rest):
+        out = jnp.einsum("bi,oij,bj->bo", a, w, b)
+        if rest:
+            out = out + rest[0]
+        return out
+    args = (x1, x2, weight) + ((bias,) if bias is not None else ())
+    return apply_op("bilinear", impl, args, {})
+
+
+def sequence_mask(lengths, maxlen=None, dtype="int64", name=None):
+    from ...framework.dtype import to_jax_dtype
+    ml = maxlen
+
+    def impl(l):
+        m = ml if ml is not None else int(l.max())
+        rng = jnp.arange(m)
+        return (rng[None, :] < l[:, None]).astype(to_jax_dtype(dtype))
+    if maxlen is None:
+        import numpy as np
+        l = np.asarray(lengths._value if isinstance(lengths, Tensor) else lengths)
+        m = int(l.max())
+        return Tensor(jnp.asarray(
+            (np.arange(m)[None, :] < l[:, None]).astype("int64")))
+    return apply_op("sequence_mask", impl, (lengths,), {})

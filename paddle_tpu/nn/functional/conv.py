@@ -1,0 +1,164 @@
+"""Convolutions (reference `python/paddle/nn/functional/conv.py`,
+`operators/conv_op.*`, `conv_cudnn_op.cu`). TPU-native: one
+lax.conv_general_dilated per op — XLA tiles it onto the MXU; no
+cuDNN-algorithm selection machinery needed."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ...framework.tensor import apply_op
+
+__all__ = ["conv1d", "conv2d", "conv3d", "conv1d_transpose",
+           "conv2d_transpose", "conv3d_transpose"]
+
+
+def _tuple(v, n):
+    if isinstance(v, int):
+        return (v,) * n
+    return tuple(v)
+
+
+def _padding(padding, n):
+    """paddle padding: int, list[int] (per-dim), list of pairs, or
+    'SAME'/'VALID'."""
+    if isinstance(padding, str):
+        return padding.upper()
+    if isinstance(padding, int):
+        return [(padding, padding)] * n
+    padding = list(padding)
+    if len(padding) == n and all(isinstance(p, int) for p in padding):
+        return [(p, p) for p in padding]
+    if len(padding) == 2 * n:
+        return [(padding[2 * i], padding[2 * i + 1]) for i in range(n)]
+    return [tuple(p) for p in padding]
+
+
+def _dn(nd, channel_last):
+    if nd == 1:
+        return ("NWC", "WIO", "NWC") if channel_last else ("NCW", "OIW", "NCW")
+    if nd == 2:
+        return (("NHWC", "HWIO", "NHWC") if channel_last
+                else ("NCHW", "OIHW", "NCHW"))
+    return (("NDHWC", "DHWIO", "NDHWC") if channel_last
+            else ("NCDHW", "OIDHW", "NCDHW"))
+
+
+def _conv(nd, x, weight, bias, stride, padding, dilation, groups,
+          data_format):
+    channel_last = data_format in ("NHWC", "NWC", "NDHWC", "NLC")
+    stride = _tuple(stride, nd)
+    dilation = _tuple(dilation, nd)
+    pad = _padding(padding, nd)
+    dn = _dn(nd, channel_last)
+
+    def impl(v, w, *rest):
+        # weight is always paddle layout [out, in/groups, *k]; convert for
+        # channel-last specs
+        if channel_last:
+            perm = tuple(range(2, 2 + nd)) + (1, 0)
+            w = jnp.transpose(w, perm)
+        out = jax.lax.conv_general_dilated(
+            v, w, window_strides=stride, padding=pad,
+            rhs_dilation=dilation, feature_group_count=groups,
+            dimension_numbers=jax.lax.conv_dimension_numbers(
+                v.shape, w.shape, dn))
+        if rest:
+            b = rest[0]
+            shape = [1] * out.ndim
+            shape[-1 if channel_last else 1] = b.shape[0]
+            out = out + b.reshape(shape)
+        return out
+    args = (x, weight) + ((bias,) if bias is not None else ())
+    return apply_op(f"conv{nd}d", impl, args, {})
+
+
+def conv1d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1,
+           data_format="NCL", name=None):
+    df = "NWC" if data_format in ("NLC", "NWC") else "NCW"
+    return _conv(1, x, weight, bias, stride, padding, dilation, groups, df)
+
+
+def conv2d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1,
+           data_format="NCHW", name=None):
+    return _conv(2, x, weight, bias, stride, padding, dilation, groups,
+                 data_format)
+
+
+def conv3d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1,
+           data_format="NCDHW", name=None):
+    return _conv(3, x, weight, bias, stride, padding, dilation, groups,
+                 data_format)
+
+
+def _conv_transpose(nd, x, weight, bias, stride, padding, output_padding,
+                    dilation, groups, data_format):
+    """Gradient-of-conv formulation (reference conv2d_transpose semantics =
+    torch): lhs-dilate by stride, pad by dilation*(k-1)-p, flip kernel."""
+    channel_last = data_format in ("NHWC", "NWC", "NDHWC", "NLC")
+    stride = _tuple(stride, nd)
+    dilation = _tuple(dilation, nd)
+    opad = _tuple(output_padding, nd)
+    if isinstance(padding, str):
+        raise ValueError("string padding unsupported for conv_transpose")
+    pads = _padding(padding, nd)
+    dn = _dn(nd, False)
+
+    def impl(v, w, *rest):
+        if channel_last:
+            v = jnp.moveaxis(v, -1, 1)
+        # weight paddle layout: [in, out/groups, *k]
+        kdims = w.shape[2:]
+        # flip spatial dims, swap in/out
+        wf = jnp.flip(w, axis=tuple(range(2, 2 + nd)))
+        if groups > 1:
+            gi = w.shape[0] // groups
+            go = w.shape[1]
+            wf = wf.reshape(groups, gi, go, *kdims)
+            wf = jnp.swapaxes(wf, 1, 2)  # [g, out/g, in/g, *k]
+            wf = wf.reshape(groups * go, gi, *kdims)
+        else:
+            wf = jnp.swapaxes(wf, 0, 1)
+        newpads = []
+        for i in range(nd):
+            lo, hi = pads[i]
+            k = (kdims[i] - 1) * dilation[i]
+            newpads.append((k - lo, k - hi + opad[i]))
+        out = jax.lax.conv_general_dilated(
+            v, wf, window_strides=(1,) * nd, padding=newpads,
+            lhs_dilation=stride, rhs_dilation=dilation,
+            feature_group_count=groups,
+            dimension_numbers=jax.lax.conv_dimension_numbers(
+                v.shape, wf.shape, dn))
+        if rest:
+            b = rest[0]
+            shape = [1] * out.ndim
+            shape[1] = b.shape[0]
+            out = out + b.reshape(shape)
+        if channel_last:
+            out = jnp.moveaxis(out, 1, -1)
+        return out
+    args = (x, weight) + ((bias,) if bias is not None else ())
+    return apply_op(f"conv{nd}d_transpose", impl, args, {})
+
+
+def conv1d_transpose(x, weight, bias=None, stride=1, padding=0,
+                     output_padding=0, groups=1, dilation=1,
+                     output_size=None, data_format="NCL", name=None):
+    df = "NWC" if data_format in ("NLC", "NWC") else "NCW"
+    return _conv_transpose(1, x, weight, bias, stride, padding,
+                           output_padding, dilation, groups, df)
+
+
+def conv2d_transpose(x, weight, bias=None, stride=1, padding=0,
+                     output_padding=0, groups=1, dilation=1,
+                     output_size=None, data_format="NCHW", name=None):
+    return _conv_transpose(2, x, weight, bias, stride, padding,
+                           output_padding, dilation, groups, data_format)
+
+
+def conv3d_transpose(x, weight, bias=None, stride=1, padding=0,
+                     output_padding=0, groups=1, dilation=1,
+                     output_size=None, data_format="NCDHW", name=None):
+    return _conv_transpose(3, x, weight, bias, stride, padding,
+                           output_padding, dilation, groups, data_format)

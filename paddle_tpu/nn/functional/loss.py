@@ -1,0 +1,292 @@
+"""Loss functionals (reference `python/paddle/nn/functional/loss.py`,
+`operators/softmax_with_cross_entropy_op.*`)."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ...framework.tensor import Tensor, apply_op
+
+__all__ = ["cross_entropy", "softmax_with_cross_entropy", "mse_loss",
+           "l1_loss", "nll_loss", "kl_div", "smooth_l1_loss",
+           "binary_cross_entropy", "binary_cross_entropy_with_logits",
+           "margin_ranking_loss", "hinge_embedding_loss", "cosine_embedding_loss",
+           "triplet_margin_loss", "log_loss", "square_error_cost",
+           "sigmoid_focal_loss", "dice_loss", "npair_loss", "ctc_loss"]
+
+
+def _reduce(v, reduction):
+    if reduction == "mean":
+        return jnp.mean(v)
+    if reduction == "sum":
+        return jnp.sum(v)
+    return v
+
+
+def cross_entropy(input, label, weight=None, ignore_index=-100,
+                  reduction="mean", soft_label=False, axis=-1,
+                  use_softmax=True, name=None):
+    def impl(logits, lab, *w):
+        if use_softmax:
+            logp = jax.nn.log_softmax(logits, axis=axis)
+        else:
+            logp = jnp.log(jnp.clip(logits, 1e-12, None))
+        if soft_label:
+            loss = -jnp.sum(lab * logp, axis=axis)
+        else:
+            lab_ = lab
+            if lab_.ndim == logp.ndim:
+                lab_ = jnp.squeeze(lab_, axis=axis)
+            lab_ = lab_.astype("int32")
+            valid = lab_ != ignore_index
+            safe = jnp.where(valid, lab_, 0)
+            picked = jnp.take_along_axis(
+                logp, jnp.expand_dims(safe, axis), axis=axis)
+            loss = -jnp.squeeze(picked, axis=axis)
+            if w:
+                wt = jnp.take(w[0], safe)
+                loss = loss * wt
+            loss = jnp.where(valid, loss, 0.0)
+            if reduction == "mean":
+                denom = (jnp.sum(w[0][safe] * valid) if w
+                         else jnp.sum(valid.astype(loss.dtype)))
+                return jnp.sum(loss) / jnp.clip(denom, 1e-12, None)
+        return _reduce(loss, reduction)
+    args = (input, label) + ((weight,) if weight is not None else ())
+    return apply_op("cross_entropy", impl, args, {})
+
+
+def softmax_with_cross_entropy(logits, label, soft_label=False,
+                               ignore_index=-100, numeric_stable_mode=True,
+                               return_softmax=False, axis=-1):
+    loss = cross_entropy(logits, label, soft_label=soft_label,
+                         ignore_index=ignore_index, reduction="none",
+                         axis=axis)
+    # reference keeps label dim
+    from ...ops.manipulation import unsqueeze
+    loss = unsqueeze(loss, [axis])
+    if return_softmax:
+        from .activation import softmax
+        return loss, softmax(logits, axis=axis)
+    return loss
+
+
+def mse_loss(input, label, reduction="mean", name=None):
+    return apply_op("mse_loss",
+                    lambda a, b: _reduce((a - b) ** 2, reduction),
+                    (input, label), {})
+
+
+def square_error_cost(input, label):
+    return apply_op("square_error_cost", lambda a, b: (a - b) ** 2,
+                    (input, label), {})
+
+
+def l1_loss(input, label, reduction="mean", name=None):
+    return apply_op("l1_loss",
+                    lambda a, b: _reduce(jnp.abs(a - b), reduction),
+                    (input, label), {})
+
+
+def nll_loss(input, label, weight=None, ignore_index=-100, reduction="mean",
+             name=None):
+    def impl(logp, lab, *w):
+        lab = lab.astype("int32")
+        valid = lab != ignore_index
+        safe = jnp.where(valid, lab, 0)
+        picked = jnp.take_along_axis(logp, safe[:, None], axis=1)[:, 0]
+        loss = -picked
+        if w:
+            loss = loss * jnp.take(w[0], safe)
+        loss = jnp.where(valid, loss, 0.0)
+        if reduction == "mean":
+            denom = (jnp.sum(jnp.take(w[0], safe) * valid) if w
+                     else jnp.sum(valid.astype(loss.dtype)))
+            return jnp.sum(loss) / jnp.clip(denom, 1e-12, None)
+        return _reduce(loss, reduction)
+    args = (input, label) + ((weight,) if weight is not None else ())
+    return apply_op("nll_loss", impl, args, {})
+
+
+def kl_div(input, label, reduction="mean", name=None):
+    def impl(logp, target):
+        loss = target * (jnp.log(jnp.clip(target, 1e-12, None)) - logp)
+        if reduction == "batchmean":
+            return jnp.sum(loss) / logp.shape[0]
+        return _reduce(loss, reduction)
+    return apply_op("kl_div", impl, (input, label), {})
+
+
+def smooth_l1_loss(input, label, reduction="mean", delta=1.0, name=None):
+    def impl(a, b):
+        d = jnp.abs(a - b)
+        loss = jnp.where(d < delta, 0.5 * d * d / delta, d - 0.5 * delta)
+        return _reduce(loss, reduction)
+    return apply_op("smooth_l1_loss", impl, (input, label), {})
+
+
+def binary_cross_entropy(input, label, weight=None, reduction="mean",
+                         name=None):
+    def impl(p, y, *w):
+        p = jnp.clip(p, 1e-12, 1 - 1e-12)
+        loss = -(y * jnp.log(p) + (1 - y) * jnp.log(1 - p))
+        if w:
+            loss = loss * w[0]
+        return _reduce(loss, reduction)
+    args = (input, label) + ((weight,) if weight is not None else ())
+    return apply_op("bce", impl, args, {})
+
+
+def binary_cross_entropy_with_logits(logit, label, weight=None,
+                                     reduction="mean", pos_weight=None,
+                                     name=None):
+    def impl(z, y, *rest):
+        logp = jax.nn.log_sigmoid(z)
+        lognotp = jax.nn.log_sigmoid(-z)
+        i = 0
+        pw = None
+        if pos_weight is not None:
+            pw = rest[i]; i += 1
+        loss = -(y * logp * (pw if pw is not None else 1.0)
+                 + (1 - y) * lognotp)
+        if weight is not None:
+            loss = loss * rest[i]
+        return _reduce(loss, reduction)
+    args = [logit, label]
+    if pos_weight is not None:
+        args.append(pos_weight)
+    if weight is not None:
+        args.append(weight)
+    return apply_op("bce_with_logits", impl, tuple(args), {})
+
+
+def margin_ranking_loss(input, other, label, margin=0.0, reduction="mean",
+                        name=None):
+    def impl(a, b, y):
+        return _reduce(jnp.maximum(0.0, -y * (a - b) + margin), reduction)
+    return apply_op("margin_ranking_loss", impl, (input, other, label), {})
+
+
+def hinge_embedding_loss(input, label, margin=1.0, reduction="mean",
+                         name=None):
+    def impl(a, y):
+        loss = jnp.where(y == 1, a, jnp.maximum(0.0, margin - a))
+        return _reduce(loss, reduction)
+    return apply_op("hinge_embedding_loss", impl, (input, label), {})
+
+
+def cosine_embedding_loss(input1, input2, label, margin=0.0,
+                          reduction="mean", name=None):
+    def impl(a, b, y):
+        cos = jnp.sum(a * b, -1) / jnp.clip(
+            jnp.linalg.norm(a, axis=-1) * jnp.linalg.norm(b, axis=-1), 1e-12,
+            None)
+        loss = jnp.where(y == 1, 1 - cos, jnp.maximum(0.0, cos - margin))
+        return _reduce(loss, reduction)
+    return apply_op("cosine_embedding_loss", impl, (input1, input2, label), {})
+
+
+def triplet_margin_loss(input, positive, negative, margin=1.0, p=2.0,
+                        epsilon=1e-6, swap=False, reduction="mean",
+                        name=None):
+    def impl(a, pos, neg):
+        dp = jnp.sum(jnp.abs(a - pos + epsilon) ** p, -1) ** (1 / p)
+        dn = jnp.sum(jnp.abs(a - neg + epsilon) ** p, -1) ** (1 / p)
+        if swap:
+            dn2 = jnp.sum(jnp.abs(pos - neg + epsilon) ** p, -1) ** (1 / p)
+            dn = jnp.minimum(dn, dn2)
+        return _reduce(jnp.maximum(0.0, dp - dn + margin), reduction)
+    return apply_op("triplet_margin_loss", impl,
+                    (input, positive, negative), {})
+
+
+def log_loss(input, label, epsilon=1e-4, name=None):
+    def impl(p, y):
+        return -y * jnp.log(p + epsilon) - (1 - y) * jnp.log(1 - p + epsilon)
+    return apply_op("log_loss", impl, (input, label), {})
+
+
+def sigmoid_focal_loss(logit, label, normalizer=None, alpha=0.25, gamma=2.0,
+                       reduction="sum", name=None):
+    def impl(z, y, *n):
+        p = jax.nn.sigmoid(z)
+        ce = -(y * jax.nn.log_sigmoid(z) + (1 - y) * jax.nn.log_sigmoid(-z))
+        pt = p * y + (1 - p) * (1 - y)
+        at = alpha * y + (1 - alpha) * (1 - y)
+        loss = at * (1 - pt) ** gamma * ce
+        if n:
+            loss = loss / n[0]
+        return _reduce(loss, reduction)
+    args = (logit, label) + ((normalizer,) if normalizer is not None else ())
+    return apply_op("sigmoid_focal_loss", impl, args, {})
+
+
+def dice_loss(input, label, epsilon=1e-5, name=None):
+    def impl(p, y):
+        y1 = jax.nn.one_hot(jnp.squeeze(y, -1), p.shape[-1], dtype=p.dtype)
+        red = tuple(range(1, p.ndim))
+        inter = jnp.sum(p * y1, axis=red)
+        union = jnp.sum(p, axis=red) + jnp.sum(y1, axis=red)
+        return jnp.mean(1 - (2 * inter + epsilon) / (union + epsilon))
+    return apply_op("dice_loss", impl, (input, label), {})
+
+
+def npair_loss(anchor, positive, labels, l2_reg=0.002):
+    def impl(a, pos, lab):
+        sim = a @ pos.T
+        lab = lab.reshape(-1)
+        tgt = (lab[:, None] == lab[None, :]).astype(sim.dtype)
+        tgt = tgt / jnp.sum(tgt, -1, keepdims=True)
+        ce = -jnp.sum(tgt * jax.nn.log_softmax(sim, -1), -1)
+        reg = l2_reg * (jnp.mean(jnp.sum(a * a, -1))
+                        + jnp.mean(jnp.sum(pos * pos, -1))) * 0.25
+        return jnp.mean(ce) + reg
+    return apply_op("npair_loss", impl, (anchor, positive, labels), {})
+
+
+def ctc_loss(log_probs, labels, input_lengths, label_lengths, blank=0,
+             reduction="mean", name=None):
+    """CTC via the standard log-alpha recursion under lax.scan
+    (reference `operators/warpctc_op` — here a pure-XLA implementation)."""
+    def impl(lp, lab, il, ll):
+        # lp: [T, B, C] log probs; lab: [B, S]
+        T, B, C = lp.shape
+        S = lab.shape[1]
+        ext = jnp.full((B, 2 * S + 1), blank, lab.dtype)
+        ext = ext.at[:, 1::2].set(lab)
+        L = 2 * S + 1
+        neg_inf = -1e30
+        alpha0 = jnp.full((B, L), neg_inf)
+        alpha0 = alpha0.at[:, 0].set(lp[0, :, blank])
+        alpha0 = alpha0.at[:, 1].set(
+            jnp.take_along_axis(lp[0], lab[:, :1], axis=1)[:, 0])
+
+        same = jnp.concatenate(
+            [jnp.ones((B, 2), bool),
+             ext[:, 2:] == ext[:, :-2]], axis=1)
+
+        def step(alpha, lp_t):
+            a1 = jnp.concatenate([jnp.full((B, 1), neg_inf), alpha[:, :-1]],
+                                 axis=1)
+            a2 = jnp.concatenate([jnp.full((B, 2), neg_inf), alpha[:, :-2]],
+                                 axis=1)
+            a2 = jnp.where(same, neg_inf, a2)
+            m = jnp.maximum(jnp.maximum(alpha, a1), a2)
+            new = m + jnp.log(jnp.exp(alpha - m) + jnp.exp(a1 - m)
+                              + jnp.exp(a2 - m))
+            emit = jnp.take_along_axis(lp_t, ext, axis=1)
+            return new + emit, None
+
+        alpha, _ = jax.lax.scan(step, alpha0, lp[1:])
+        idx_last = 2 * ll
+        a_last = jnp.take_along_axis(alpha, idx_last[:, None], axis=1)[:, 0]
+        a_prev = jnp.take_along_axis(alpha, (idx_last - 1)[:, None],
+                                     axis=1)[:, 0]
+        m = jnp.maximum(a_last, a_prev)
+        ll_total = m + jnp.log(jnp.exp(a_last - m) + jnp.exp(a_prev - m))
+        loss = -ll_total
+        if reduction == "mean":
+            return jnp.mean(loss / jnp.clip(ll.astype(loss.dtype), 1, None))
+        return _reduce(loss, reduction)
+    return apply_op("ctc_loss", impl,
+                    (log_probs, labels, input_lengths, label_lengths), {})

@@ -1,0 +1,151 @@
+"""Normalization functionals (reference `operators/batch_norm_op.*`,
+`layer_norm_op.*`, `group_norm_op.*`, `instance_norm_op.*`)."""
+from __future__ import annotations
+
+import numpy as np
+import jax.numpy as jnp
+
+from ...framework.tensor import Tensor, apply_op
+
+__all__ = ["batch_norm", "layer_norm", "instance_norm", "group_norm",
+           "local_response_norm"]
+
+
+def batch_norm(x, running_mean, running_var, weight=None, bias=None,
+               training=False, momentum=0.9, epsilon=1e-5,
+               data_format="NCHW", use_global_stats=None, name=None):
+    """Training mode computes batch stats AND (eagerly) updates the running
+    buffers in place — matching the reference kernel's side effect
+    (`batch_norm_op.cc` MeanOut/VarianceOut). Under functional capture the
+    buffer update is recorded by the capture machinery instead."""
+    channel_last = data_format in ("NHWC", "NLC", "NDHWC")
+    use_batch = training and not use_global_stats
+
+    def impl(v, rm, rv, *wb):
+        ch_ax = v.ndim - 1 if channel_last else 1
+        axes = tuple(i for i in range(v.ndim) if i != ch_ax)
+        if use_batch:
+            mean = jnp.mean(v, axis=axes)
+            var = jnp.var(v, axis=axes)
+        else:
+            mean, var = rm, rv
+        shape = [1] * v.ndim
+        shape[ch_ax] = v.shape[ch_ax]
+        out = (v - mean.reshape(shape)) / jnp.sqrt(var.reshape(shape) + epsilon)
+        idx = 0
+        if weight is not None:
+            out = out * wb[idx].reshape(shape)
+            idx += 1
+        if bias is not None:
+            out = out + wb[idx].reshape(shape)
+        return out
+
+    wb = tuple(t for t in (weight, bias) if t is not None)
+    out = apply_op("batch_norm", impl, (x, running_mean, running_var) + wb, {})
+
+    if use_batch and isinstance(running_mean, Tensor):
+        # eager side effect on the running stats (no grad flows)
+        v = x._value if isinstance(x, Tensor) else jnp.asarray(x)
+        ch_ax = v.ndim - 1 if channel_last else 1
+        axes = tuple(i for i in range(v.ndim) if i != ch_ax)
+        m = jnp.mean(v, axis=axes)
+        n = int(np.prod([v.shape[a] for a in axes]))
+        var_unbiased = jnp.var(v, axis=axes) * (n / max(n - 1, 1))
+        running_mean._value = (momentum * running_mean._value
+                               + (1 - momentum) * m)
+        running_var._value = (momentum * running_var._value
+                              + (1 - momentum) * var_unbiased)
+    return out
+
+
+def layer_norm(x, normalized_shape, weight=None, bias=None, epsilon=1e-5,
+               name=None):
+    if isinstance(normalized_shape, int):
+        normalized_shape = [normalized_shape]
+    nd = len(tuple(normalized_shape))
+
+    def impl(v, *wb):
+        axes = tuple(range(v.ndim - nd, v.ndim))
+        mean = jnp.mean(v, axis=axes, keepdims=True)
+        var = jnp.var(v, axis=axes, keepdims=True)
+        out = (v - mean) / jnp.sqrt(var + epsilon)
+        idx = 0
+        if weight is not None:
+            out = out * wb[idx]
+            idx += 1
+        if bias is not None:
+            out = out + wb[idx]
+        return out
+    wb = tuple(t for t in (weight, bias) if t is not None)
+    return apply_op("layer_norm", impl, (x,) + wb, {})
+
+
+def instance_norm(x, running_mean=None, running_var=None, weight=None,
+                  bias=None, use_input_stats=True, momentum=0.9, eps=1e-5,
+                  data_format="NCHW", name=None):
+    channel_last = data_format in ("NHWC", "NLC", "NDHWC")
+
+    def impl(v, *wb):
+        ch_ax = v.ndim - 1 if channel_last else 1
+        axes = tuple(i for i in range(2, v.ndim)) if not channel_last else \
+            tuple(i for i in range(1, v.ndim - 1))
+        mean = jnp.mean(v, axis=axes, keepdims=True)
+        var = jnp.var(v, axis=axes, keepdims=True)
+        out = (v - mean) / jnp.sqrt(var + eps)
+        shape = [1] * v.ndim
+        shape[ch_ax] = v.shape[ch_ax]
+        idx = 0
+        if weight is not None:
+            out = out * wb[idx].reshape(shape)
+            idx += 1
+        if bias is not None:
+            out = out + wb[idx].reshape(shape)
+        return out
+    wb = tuple(t for t in (weight, bias) if t is not None)
+    return apply_op("instance_norm", impl, (x,) + wb, {})
+
+
+def group_norm(x, num_groups, epsilon=1e-5, weight=None, bias=None,
+               data_format="NCHW", name=None):
+    channel_last = data_format in ("NHWC", "NLC", "NDHWC")
+
+    def impl(v, *wb):
+        if channel_last:
+            v = jnp.moveaxis(v, -1, 1)
+        n, c = v.shape[:2]
+        spatial = v.shape[2:]
+        g = v.reshape(n, num_groups, c // num_groups, *spatial)
+        axes = tuple(range(2, g.ndim))
+        mean = jnp.mean(g, axis=axes, keepdims=True)
+        var = jnp.var(g, axis=axes, keepdims=True)
+        out = ((g - mean) / jnp.sqrt(var + epsilon)).reshape(v.shape)
+        shape = [1, c] + [1] * len(spatial)
+        idx = 0
+        if weight is not None:
+            out = out * wb[idx].reshape(shape)
+            idx += 1
+        if bias is not None:
+            out = out + wb[idx].reshape(shape)
+        if channel_last:
+            out = jnp.moveaxis(out, 1, -1)
+        return out
+    wb = tuple(t for t in (weight, bias) if t is not None)
+    return apply_op("group_norm", impl, (x,) + wb, {})
+
+
+def local_response_norm(x, size, alpha=1e-4, beta=0.75, k=1.0,
+                        data_format="NCHW", name=None):
+    def impl(v):
+        ch_ax = 1 if data_format.startswith("NC") else v.ndim - 1
+        sq = jnp.square(v)
+        half = size // 2
+        pads = [(0, 0)] * v.ndim
+        pads[ch_ax] = (half, size - half - 1)
+        padded = jnp.pad(sq, pads)
+        acc = jnp.zeros_like(v)
+        for i in range(size):
+            sl = [slice(None)] * v.ndim
+            sl[ch_ax] = slice(i, i + v.shape[ch_ax])
+            acc = acc + padded[tuple(sl)]
+        return v / jnp.power(k + alpha * acc, beta)
+    return apply_op("local_response_norm", impl, (x,), {})

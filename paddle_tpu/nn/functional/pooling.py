@@ -1,0 +1,159 @@
+"""Pooling (reference `python/paddle/nn/functional/pooling.py`,
+`operators/pool_op.*`) — lax.reduce_window based."""
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ...framework.tensor import apply_op
+
+__all__ = ["avg_pool1d", "avg_pool2d", "avg_pool3d", "max_pool1d",
+           "max_pool2d", "max_pool3d", "adaptive_avg_pool1d",
+           "adaptive_avg_pool2d", "adaptive_avg_pool3d",
+           "adaptive_max_pool1d", "adaptive_max_pool2d",
+           "adaptive_max_pool3d"]
+
+
+def _tuple(v, n):
+    return (v,) * n if isinstance(v, int) else tuple(v)
+
+
+def _pads(padding, n):
+    if isinstance(padding, str):
+        return padding.upper()
+    if isinstance(padding, int):
+        return [(padding, padding)] * n
+    padding = list(padding)
+    if len(padding) == n and all(isinstance(p, int) for p in padding):
+        return [(p, p) for p in padding]
+    if len(padding) == 2 * n:
+        return [(padding[2 * i], padding[2 * i + 1]) for i in range(n)]
+    return [tuple(p) for p in padding]
+
+
+def _pool(nd, x, kernel, stride, padding, kind, ceil_mode, exclusive,
+          channel_last):
+    kernel = _tuple(kernel, nd)
+    stride = _tuple(stride if stride is not None else kernel, nd)
+    pads = _pads(padding, nd)
+
+    def impl(v):
+        if channel_last:
+            v = jnp.moveaxis(v, -1, 1)
+        window = (1, 1) + kernel
+        strides = (1, 1) + stride
+        if isinstance(pads, str):
+            padcfg = pads
+        else:
+            padcfg = [(0, 0), (0, 0)] + list(pads)
+        if kind == "max":
+            init = -jnp.inf if jnp.issubdtype(v.dtype, jnp.floating) else \
+                jnp.iinfo(v.dtype).min
+            out = jax.lax.reduce_window(v, init, jax.lax.max, window, strides,
+                                        padcfg)
+        else:
+            s = jax.lax.reduce_window(v, 0.0, jax.lax.add, window, strides,
+                                      padcfg)
+            if exclusive and not isinstance(padcfg, str):
+                ones = jnp.ones_like(v)
+                cnt = jax.lax.reduce_window(ones, 0.0, jax.lax.add, window,
+                                            strides, padcfg)
+                out = s / cnt
+            else:
+                out = s / float(np.prod(kernel))
+        if channel_last:
+            out = jnp.moveaxis(out, 1, -1)
+        return out
+    return apply_op(f"{kind}_pool{nd}d", impl, (x,), {})
+
+
+def max_pool1d(x, kernel_size, stride=None, padding=0, return_mask=False,
+               ceil_mode=False, data_format="NCL", name=None):
+    return _pool(1, x, kernel_size, stride, padding, "max", ceil_mode, True,
+                 data_format == "NLC")
+
+
+def max_pool2d(x, kernel_size, stride=None, padding=0, return_mask=False,
+               ceil_mode=False, data_format="NCHW", name=None):
+    return _pool(2, x, kernel_size, stride, padding, "max", ceil_mode, True,
+                 data_format == "NHWC")
+
+
+def max_pool3d(x, kernel_size, stride=None, padding=0, return_mask=False,
+               ceil_mode=False, data_format="NCDHW", name=None):
+    return _pool(3, x, kernel_size, stride, padding, "max", ceil_mode, True,
+                 data_format == "NDHWC")
+
+
+def avg_pool1d(x, kernel_size, stride=None, padding=0, exclusive=True,
+               ceil_mode=False, data_format="NCL", name=None):
+    return _pool(1, x, kernel_size, stride, padding, "avg", ceil_mode,
+                 exclusive, data_format == "NLC")
+
+
+def avg_pool2d(x, kernel_size, stride=None, padding=0, ceil_mode=False,
+               exclusive=True, divisor_override=None, data_format="NCHW",
+               name=None):
+    return _pool(2, x, kernel_size, stride, padding, "avg", ceil_mode,
+                 exclusive, data_format == "NHWC")
+
+
+def avg_pool3d(x, kernel_size, stride=None, padding=0, ceil_mode=False,
+               exclusive=True, divisor_override=None, data_format="NCDHW",
+               name=None):
+    return _pool(3, x, kernel_size, stride, padding, "avg", ceil_mode,
+                 exclusive, data_format == "NDHWC")
+
+
+def _adaptive(nd, x, output_size, kind, channel_last):
+    out_sz = _tuple(output_size, nd)
+
+    def impl(v):
+        if channel_last:
+            v = jnp.moveaxis(v, -1, 1)
+        spat = v.shape[2:]
+        out = v
+        # per-axis adaptive pooling: split axis into out_sz windows
+        for ax in range(nd):
+            dim = spat[ax]
+            o = out_sz[ax]
+            axis = 2 + ax
+            if o is None or o == dim:
+                continue
+            starts = [int(np.floor(i * dim / o)) for i in range(o)]
+            ends = [int(np.ceil((i + 1) * dim / o)) for i in range(o)]
+            segs = []
+            red = jnp.max if kind == "max" else jnp.mean
+            for s, e in zip(starts, ends):
+                seg = jax.lax.slice_in_dim(out, s, e, axis=axis)
+                segs.append(red(seg, axis=axis, keepdims=True))
+            out = jnp.concatenate(segs, axis=axis)
+        if channel_last:
+            out = jnp.moveaxis(out, 1, -1)
+        return out
+    return apply_op(f"adaptive_{kind}_pool{nd}d", impl, (x,), {})
+
+
+def adaptive_avg_pool1d(x, output_size, name=None):
+    return _adaptive(1, x, output_size, "avg", False)
+
+
+def adaptive_avg_pool2d(x, output_size, data_format="NCHW", name=None):
+    return _adaptive(2, x, output_size, "avg", data_format == "NHWC")
+
+
+def adaptive_avg_pool3d(x, output_size, data_format="NCDHW", name=None):
+    return _adaptive(3, x, output_size, "avg", data_format == "NDHWC")
+
+
+def adaptive_max_pool1d(x, output_size, return_mask=False, name=None):
+    return _adaptive(1, x, output_size, "max", False)
+
+
+def adaptive_max_pool2d(x, output_size, return_mask=False, name=None):
+    return _adaptive(2, x, output_size, "max", False)
+
+
+def adaptive_max_pool3d(x, output_size, return_mask=False, name=None):
+    return _adaptive(3, x, output_size, "max", False)

@@ -1,0 +1,332 @@
+"""Recurrent layers (reference `python/paddle/nn/layer/rnn.py`,
+`operators/rnn_op` cudnn path).
+
+TPU-native design: the whole multi-layer (bi)directional recurrence is ONE
+op whose body is `lax.scan` — XLA compiles it to a single fused while loop
+on device (the reference needs cuDNN descriptors for the same effect).
+Gate order follows the reference: LSTM [i, f, g, o]; GRU [r, z, c] with the
+candidate using r∘(W_hc·h) (paddle/cuDNN convention).
+"""
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+
+from ...framework.tensor import apply_op
+from .. import functional as Fn
+from .. import initializer as I
+from .layers import Layer
+
+__all__ = ["SimpleRNNCell", "LSTMCell", "GRUCell", "RNN", "BiRNN",
+           "SimpleRNN", "LSTM", "GRU"]
+
+
+# ---------------------------------------------------------------------------
+# Cells (eager building blocks)
+# ---------------------------------------------------------------------------
+
+class RNNCellBase(Layer):
+    def get_initial_states(self, batch_ref, shape=None, dtype="float32",
+                           init_value=0.0, batch_dim_idx=0):
+        from ...ops.creation import full
+        batch = batch_ref.shape[batch_dim_idx]
+        return full([batch, self.hidden_size], init_value, dtype)
+
+
+class SimpleRNNCell(RNNCellBase):
+    def __init__(self, input_size, hidden_size, activation="tanh",
+                 weight_ih_attr=None, weight_hh_attr=None, bias_ih_attr=None,
+                 bias_hh_attr=None, name=None):
+        super().__init__()
+        self.input_size = input_size
+        self.hidden_size = hidden_size
+        self.activation = activation
+        std = 1.0 / math.sqrt(hidden_size)
+        u = I.Uniform(-std, std)
+        self.weight_ih = self.create_parameter([hidden_size, input_size],
+                                               weight_ih_attr,
+                                               default_initializer=u)
+        self.weight_hh = self.create_parameter([hidden_size, hidden_size],
+                                               weight_hh_attr,
+                                               default_initializer=u)
+        self.bias_ih = self.create_parameter([hidden_size], bias_ih_attr,
+                                             is_bias=True,
+                                             default_initializer=u)
+        self.bias_hh = self.create_parameter([hidden_size], bias_hh_attr,
+                                             is_bias=True,
+                                             default_initializer=u)
+
+    def forward(self, inputs, states=None):
+        if states is None:
+            states = self.get_initial_states(inputs)
+        act = jnp.tanh if self.activation == "tanh" else jax.nn.relu
+
+        def impl(x, h, wi, wh, bi, bh):
+            return act(x @ wi.T + bi + h @ wh.T + bh)
+        h = apply_op("simple_rnn_cell", impl,
+                     (inputs, states, self.weight_ih, self.weight_hh,
+                      self.bias_ih, self.bias_hh), {})
+        return h, h
+
+
+class LSTMCell(RNNCellBase):
+    def __init__(self, input_size, hidden_size, weight_ih_attr=None,
+                 weight_hh_attr=None, bias_ih_attr=None, bias_hh_attr=None,
+                 name=None):
+        super().__init__()
+        self.input_size = input_size
+        self.hidden_size = hidden_size
+        std = 1.0 / math.sqrt(hidden_size)
+        u = I.Uniform(-std, std)
+        self.weight_ih = self.create_parameter([4 * hidden_size, input_size],
+                                               weight_ih_attr,
+                                               default_initializer=u)
+        self.weight_hh = self.create_parameter([4 * hidden_size, hidden_size],
+                                               weight_hh_attr,
+                                               default_initializer=u)
+        self.bias_ih = self.create_parameter([4 * hidden_size], bias_ih_attr,
+                                             is_bias=True,
+                                             default_initializer=u)
+        self.bias_hh = self.create_parameter([4 * hidden_size], bias_hh_attr,
+                                             is_bias=True,
+                                             default_initializer=u)
+
+    def forward(self, inputs, states=None):
+        if states is None:
+            h = self.get_initial_states(inputs)
+            c = self.get_initial_states(inputs)
+        else:
+            h, c = states
+
+        def impl(x, h, c, wi, wh, bi, bh):
+            z = x @ wi.T + bi + h @ wh.T + bh
+            i, f, g, o = jnp.split(z, 4, axis=-1)
+            c_new = jax.nn.sigmoid(f) * c + jax.nn.sigmoid(i) * jnp.tanh(g)
+            h_new = jax.nn.sigmoid(o) * jnp.tanh(c_new)
+            return h_new, c_new
+        h_new, c_new = apply_op("lstm_cell", impl,
+                                (inputs, h, c, self.weight_ih, self.weight_hh,
+                                 self.bias_ih, self.bias_hh), {})
+        return h_new, (h_new, c_new)
+
+
+class GRUCell(RNNCellBase):
+    def __init__(self, input_size, hidden_size, weight_ih_attr=None,
+                 weight_hh_attr=None, bias_ih_attr=None, bias_hh_attr=None,
+                 name=None):
+        super().__init__()
+        self.input_size = input_size
+        self.hidden_size = hidden_size
+        std = 1.0 / math.sqrt(hidden_size)
+        u = I.Uniform(-std, std)
+        self.weight_ih = self.create_parameter([3 * hidden_size, input_size],
+                                               weight_ih_attr,
+                                               default_initializer=u)
+        self.weight_hh = self.create_parameter([3 * hidden_size, hidden_size],
+                                               weight_hh_attr,
+                                               default_initializer=u)
+        self.bias_ih = self.create_parameter([3 * hidden_size], bias_ih_attr,
+                                             is_bias=True,
+                                             default_initializer=u)
+        self.bias_hh = self.create_parameter([3 * hidden_size], bias_hh_attr,
+                                             is_bias=True,
+                                             default_initializer=u)
+
+    def forward(self, inputs, states=None):
+        if states is None:
+            states = self.get_initial_states(inputs)
+
+        def impl(x, h, wi, wh, bi, bh):
+            gi = x @ wi.T + bi
+            gh = h @ wh.T + bh
+            ir, iz, ic = jnp.split(gi, 3, axis=-1)
+            hr, hz, hc = jnp.split(gh, 3, axis=-1)
+            r = jax.nn.sigmoid(ir + hr)
+            z = jax.nn.sigmoid(iz + hz)
+            c = jnp.tanh(ic + r * hc)
+            return (1 - z) * c + z * h
+        h = apply_op("gru_cell", impl,
+                     (inputs, states, self.weight_ih, self.weight_hh,
+                      self.bias_ih, self.bias_hh), {})
+        return h, h
+
+
+# ---------------------------------------------------------------------------
+# Generic cell drivers (API parity with paddle.nn.RNN / BiRNN)
+# ---------------------------------------------------------------------------
+
+class RNN(Layer):
+    def __init__(self, cell, is_reverse=False, time_major=False):
+        super().__init__()
+        self.cell = cell
+        self.is_reverse = is_reverse
+        self.time_major = time_major
+
+    def forward(self, inputs, initial_states=None, sequence_length=None):
+        from ...ops.manipulation import stack
+        time_axis = 0 if self.time_major else 1
+        steps = inputs.shape[time_axis]
+        order = range(steps - 1, -1, -1) if self.is_reverse else range(steps)
+        states = initial_states
+        outs = []
+        for t in order:
+            xt = inputs[:, t] if time_axis == 1 else inputs[t]
+            out, states = self.cell(xt, states)
+            outs.append(out)
+        if self.is_reverse:
+            outs = outs[::-1]
+        return stack(outs, axis=time_axis), states
+
+
+class BiRNN(Layer):
+    def __init__(self, cell_fw, cell_bw, time_major=False):
+        super().__init__()
+        self.rnn_fw = RNN(cell_fw, False, time_major)
+        self.rnn_bw = RNN(cell_bw, True, time_major)
+
+    def forward(self, inputs, initial_states=None, sequence_length=None):
+        from ...ops.manipulation import concat
+        s_fw, s_bw = (initial_states if initial_states is not None
+                      else (None, None))
+        o_fw, s_fw = self.rnn_fw(inputs, s_fw)
+        o_bw, s_bw = self.rnn_bw(inputs, s_bw)
+        return concat([o_fw, o_bw], axis=-1), (s_fw, s_bw)
+
+
+# ---------------------------------------------------------------------------
+# Fused multi-layer RNNs — one lax.scan per layer/direction
+# ---------------------------------------------------------------------------
+
+class _RNNBase(Layer):
+    _mode = "LSTM"
+
+    def __init__(self, input_size, hidden_size, num_layers=1,
+                 direction="forward", time_major=False, dropout=0.0,
+                 weight_ih_attr=None, weight_hh_attr=None, bias_ih_attr=None,
+                 bias_hh_attr=None, name=None):
+        super().__init__()
+        self.input_size = input_size
+        self.hidden_size = hidden_size
+        self.num_layers = num_layers
+        self.direction = direction
+        self.time_major = time_major
+        self.dropout = dropout
+        self.bidirectional = direction in ("bidirect", "bidirectional")
+        num_dir = 2 if self.bidirectional else 1
+        self.num_directions = num_dir
+        g = {"LSTM": 4, "GRU": 3}.get(self._mode, 1)
+        std = 1.0 / math.sqrt(hidden_size)
+        u = I.Uniform(-std, std)
+        self._param_names = []
+        for layer in range(num_layers):
+            in_sz = input_size if layer == 0 else hidden_size * num_dir
+            for d in range(num_dir):
+                sfx = f"_l{layer}" + ("_reverse" if d else "")
+                wi = self.create_parameter([g * hidden_size, in_sz],
+                                           weight_ih_attr,
+                                           default_initializer=u)
+                wh = self.create_parameter([g * hidden_size, hidden_size],
+                                           weight_hh_attr,
+                                           default_initializer=u)
+                bi = self.create_parameter([g * hidden_size], bias_ih_attr,
+                                           is_bias=True, default_initializer=u)
+                bh = self.create_parameter([g * hidden_size], bias_hh_attr,
+                                           is_bias=True, default_initializer=u)
+                for n, p in ((f"weight_ih{sfx}", wi), (f"weight_hh{sfx}", wh),
+                             (f"bias_ih{sfx}", bi), (f"bias_hh{sfx}", bh)):
+                    self.add_parameter(n, p)
+                    self._param_names.append(n)
+
+    def _cell_step(self):
+        mode = self._mode
+
+        def step(x, h, c, wi, wh, bi, bh):
+            z = x @ wi.T + bi + h @ wh.T + bh
+            if mode == "LSTM":
+                i, f, g_, o = jnp.split(z, 4, axis=-1)
+                c_new = jax.nn.sigmoid(f) * c + jax.nn.sigmoid(i) * jnp.tanh(g_)
+                h_new = jax.nn.sigmoid(o) * jnp.tanh(c_new)
+                return h_new, c_new
+            if mode == "GRU":
+                gi = x @ wi.T + bi
+                gh = h @ wh.T + bh
+                ir, iz, ic = jnp.split(gi, 3, axis=-1)
+                hr, hz, hc = jnp.split(gh, 3, axis=-1)
+                r = jax.nn.sigmoid(ir + hr)
+                zt = jax.nn.sigmoid(iz + hz)
+                ct = jnp.tanh(ic + r * hc)
+                h_new = (1 - zt) * ct + zt * h
+                return h_new, h_new
+            act = jnp.tanh if mode == "RNN_TANH" else jax.nn.relu
+            h_new = act(z)
+            return h_new, h_new
+        return step
+
+    def forward(self, inputs, initial_states=None, sequence_length=None):
+        is_lstm = self._mode == "LSTM"
+        nl, nd, hs = self.num_layers, self.num_directions, self.hidden_size
+        time_major = self.time_major
+        step = self._cell_step()
+        params = [getattr(self, n) for n in self._param_names]
+
+        def impl(x, *flat):
+            widx = 0
+            if not time_major:
+                x = jnp.swapaxes(x, 0, 1)  # [T, B, C]
+            B = x.shape[1]
+            h_all, c_all = [], []
+            layer_in = x
+            for layer in range(nl):
+                outs_dir = []
+                for d in range(nd):
+                    wi, wh, bi, bh = flat[widx:widx + 4]
+                    widx += 4
+                    h0 = jnp.zeros((B, hs), x.dtype)
+                    c0 = jnp.zeros((B, hs), x.dtype)
+                    seq = layer_in[::-1] if d == 1 else layer_in
+
+                    def scan_fn(carry, xt):
+                        h, c = carry
+                        h2, c2 = step(xt, h, c, wi, wh, bi, bh)
+                        return (h2, c2), h2
+                    (hT, cT), ys = jax.lax.scan(scan_fn, (h0, c0), seq)
+                    if d == 1:
+                        ys = ys[::-1]
+                    outs_dir.append(ys)
+                    h_all.append(hT)
+                    c_all.append(cT)
+                layer_in = (jnp.concatenate(outs_dir, axis=-1)
+                            if nd == 2 else outs_dir[0])
+            out = layer_in if time_major else jnp.swapaxes(layer_in, 0, 1)
+            h_stack = jnp.stack(h_all)  # [nl*nd, B, H]
+            if is_lstm:
+                return out, h_stack, jnp.stack(c_all)
+            return out, h_stack
+
+        res = apply_op(self._mode.lower(), impl, (inputs, *params), {})
+        if is_lstm:
+            out, h, c = res
+            return out, (h, c)
+        out, h = res
+        return out, h
+
+
+class SimpleRNN(_RNNBase):
+    _mode = "RNN_TANH"
+
+    def __init__(self, input_size, hidden_size, num_layers=1,
+                 direction="forward", time_major=False, dropout=0.0,
+                 activation="tanh", **kwargs):
+        self._mode = "RNN_TANH" if activation == "tanh" else "RNN_RELU"
+        super().__init__(input_size, hidden_size, num_layers, direction,
+                         time_major, dropout, **kwargs)
+
+
+class LSTM(_RNNBase):
+    _mode = "LSTM"
+
+
+class GRU(_RNNBase):
+    _mode = "GRU"

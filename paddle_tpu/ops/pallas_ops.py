@@ -1,0 +1,149 @@
+"""Pallas TPU kernels for hot ops.
+
+The reference ships hand-written CUDA for its hot paths
+(`paddle/fluid/operators/fused/`, `math/`). The TPU equivalents are Pallas
+kernels; everything else rides XLA fusion. First kernel: flash attention
+(online-softmax tiling, VMEM-resident running max/denominator), used by
+`F.scaled_dot_product_attention` / MultiHeadAttention when on TPU.
+
+Design (not from the reference — it has no fused attention):
+  grid = (batch*heads, q_blocks); K/V for the head stay in VMEM; inner
+  fori_loop streams K blocks with the usual (m, l, acc) online-softmax
+  recurrence. Backward recomputes via the jnp reference inside a
+  jax.custom_vjp (same FLOP trade flash makes anyway).
+"""
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+__all__ = ["flash_attention", "flash_attention_raw"]
+
+_BLOCK_Q = 128
+_BLOCK_K = 128
+
+
+def _sdpa_reference(q, k, v, causal, scale):
+    s = jnp.einsum("bhqd,bhkd->bhqk", q, k,
+                   precision=jax.lax.Precision.DEFAULT) * scale
+    if causal:
+        S, K = s.shape[-2], s.shape[-1]
+        mask = jnp.tril(jnp.ones((S, K), bool))
+        s = jnp.where(mask, s, -1e30)
+    p = jax.nn.softmax(s, axis=-1)
+    return jnp.einsum("bhqk,bhkd->bhqd", p, v,
+                      precision=jax.lax.Precision.DEFAULT)
+
+
+def _flash_fwd_kernel(q_ref, k_ref, v_ref, o_ref, *, scale, causal, block_k):
+    # q_ref: [block_q, D]; k_ref/v_ref: [S, D]; o_ref: [block_q, D]
+    qi = pl.program_id(1)
+    q = q_ref[:].astype(jnp.float32) * scale
+    S = k_ref.shape[0]
+    D = q_ref.shape[1]
+    bq = q_ref.shape[0]
+    nkb = S // block_k
+
+    m0 = jnp.full((bq, 1), -1e30, jnp.float32)
+    l0 = jnp.zeros((bq, 1), jnp.float32)
+    acc0 = jnp.zeros((bq, D), jnp.float32)
+
+    q_offs = qi * bq + jax.lax.broadcasted_iota(jnp.int32, (bq, 1), 0)
+
+    def body(kb, carry):
+        m, l, acc = carry
+        k_blk = k_ref[pl.ds(kb * block_k, block_k), :].astype(jnp.float32)
+        v_blk = v_ref[pl.ds(kb * block_k, block_k), :].astype(jnp.float32)
+        s = jax.lax.dot_general(q, k_blk, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32)
+        if causal:
+            k_offs = kb * block_k + jax.lax.broadcasted_iota(
+                jnp.int32, (1, block_k), 1)
+            s = jnp.where(q_offs >= k_offs, s, -1e30)
+        m_new = jnp.maximum(m, jnp.max(s, axis=1, keepdims=True))
+        p = jnp.exp(s - m_new)
+        alpha = jnp.exp(m - m_new)
+        l_new = alpha * l + jnp.sum(p, axis=1, keepdims=True)
+        acc_new = alpha * acc + jax.lax.dot_general(
+            p, v_blk, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        return m_new, l_new, acc_new
+
+    if causal:
+        # only blocks with k_start <= q_end contribute
+        last = jnp.minimum(nkb, (qi + 1) * bq // block_k + 1)
+        m, l, acc = jax.lax.fori_loop(0, last, body, (m0, l0, acc0))
+    else:
+        m, l, acc = jax.lax.fori_loop(0, nkb, body, (m0, l0, acc0))
+    o_ref[:] = (acc / l).astype(o_ref.dtype)
+
+
+try:  # pallas availability is TPU/backend dependent
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+    _HAS_PALLAS = True
+except Exception:  # pragma: no cover
+    _HAS_PALLAS = False
+
+
+def _flash_call(q, k, v, causal, scale, block_q, block_k):
+    B, H, S, D = q.shape
+    qr = q.reshape(B * H, S, D)
+    kr = k.reshape(B * H, S, D)
+    vr = v.reshape(B * H, S, D)
+
+    kernel = functools.partial(_flash_fwd_kernel, scale=scale, causal=causal,
+                               block_k=block_k)
+    out = pl.pallas_call(
+        kernel,
+        grid=(B * H, S // block_q),
+        in_specs=[
+            pl.BlockSpec((None, block_q, D), lambda b, i: (b, i, 0)),
+            pl.BlockSpec((None, S, D), lambda b, i: (b, 0, 0)),
+            pl.BlockSpec((None, S, D), lambda b, i: (b, 0, 0)),
+        ],
+        out_specs=pl.BlockSpec((None, block_q, D), lambda b, i: (b, i, 0)),
+        out_shape=jax.ShapeDtypeStruct((B * H, S, D), q.dtype),
+    )(qr, kr, vr)
+    return out.reshape(B, H, S, D)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4))
+def flash_attention_raw(q, k, v, causal, scale):
+    return _flash_fwd(q, k, v, causal, scale)[0]
+
+
+def _flash_fwd(q, k, v, causal, scale):
+    S, D = q.shape[-2], q.shape[-1]
+    ok = (_HAS_PALLAS and S % _BLOCK_Q == 0 and S % _BLOCK_K == 0
+          and D % 128 == 0 and q.shape == k.shape == v.shape)
+    if ok:
+        try:
+            out = _flash_call(q, k, v, causal, scale, _BLOCK_Q, _BLOCK_K)
+            return out, (q, k, v)
+        except Exception:
+            pass
+    return _sdpa_reference(q, k, v, causal, scale), (q, k, v)
+
+
+def _flash_bwd(causal, scale, res, g):
+    q, k, v = res
+    _, vjp = jax.vjp(lambda a, b, c: _sdpa_reference(a, b, c, causal, scale),
+                     q, k, v)
+    return vjp(g)
+
+
+flash_attention_raw.defvjp(_flash_fwd, _flash_bwd)
+
+
+def flash_attention(query, key, value, causal=False, scale=None):
+    """Framework-level entry: Tensor in/out, tape-recorded."""
+    from ..framework.tensor import apply_op
+    if scale is None:
+        scale = 1.0 / (query.shape[-1] ** 0.5)
+    return apply_op("flash_attention",
+                    lambda q, k, v: flash_attention_raw(q, k, v, causal,
+                                                        scale),
+                    (query, key, value), {})
