@@ -18,4 +18,19 @@ from .ops import *  # noqa: F401,F403  (tensor/math/… API at top level)
 from .ops import creation, linalg, logic, manipulation, math, reduction, search
 from .ops import random_ops as random  # paddle.rand etc already exported
 
+from . import nn  # noqa: E402
+from . import optimizer  # noqa: E402
+from . import amp  # noqa: E402
+from . import jit  # noqa: E402
+from . import static  # noqa: E402
+from .framework.io_state import load, save  # noqa: E402
+from .framework.param_attr import ParamAttr  # noqa: E402
+from .static.program import disable_static, enable_static  # noqa: E402
+from .static.program import in_static_mode as _in_static  # noqa: E402
+
+
+def in_dynamic_mode():
+    return not _in_static()
+
+
 __version__ = "0.1.0"

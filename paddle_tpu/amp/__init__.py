@@ -1,0 +1,193 @@
+"""AMP (reference `python/paddle/amp/auto_cast.py`, `grad_scaler.py`;
+static lists `fluid/contrib/mixed_precision/fp16_lists.py:20`).
+
+TPU-native: level O1 autocasts whitelisted ops (the MXU ops) to bfloat16 at
+dispatch time; bf16 needs no loss scaling (8-bit exponent == fp32 range), so
+GradScaler is a working parity shim whose scale path only activates for
+float16. Level O2 casts whole models via `amp.decorate`.
+"""
+from __future__ import annotations
+
+import contextlib
+import threading
+
+import jax.numpy as jnp
+
+from ..framework.dtype import to_jax_dtype
+
+__all__ = ["auto_cast", "amp_guard", "decorate", "GradScaler",
+           "white_list", "black_list"]
+
+# reference fp16_lists.py:20 white/black lists, pruned to our op names
+WHITE_LIST = {"matmul", "mm", "bmm", "linear", "conv1d", "conv2d", "conv3d",
+              "conv1d_transpose", "conv2d_transpose", "conv3d_transpose",
+              "einsum", "sdpa", "flash_attention"}
+BLACK_LIST = {"exp", "log", "softmax", "log_softmax", "cross_entropy",
+              "bce", "bce_with_logits", "mse_loss", "l1_loss", "nll_loss",
+              "kl_div", "layer_norm", "batch_norm", "group_norm",
+              "instance_norm", "reduce_sum", "reduce_mean", "cumsum",
+              "logsumexp", "norm", "softmax_with_cross_entropy"}
+
+
+def white_list():
+    return set(WHITE_LIST)
+
+
+def black_list():
+    return set(BLACK_LIST)
+
+
+class _AmpState(threading.local):
+    def __init__(self):
+        self.enabled = False
+        self.dtype = "bfloat16"
+        self.level = "O1"
+        self.custom_white = set()
+        self.custom_black = set()
+
+
+_state = _AmpState()
+
+
+def amp_active():
+    return _state.enabled
+
+
+def maybe_cast_inputs(op_name, raw_args):
+    """Called from the dispatch core for each op when AMP is active."""
+    if not _state.enabled:
+        return raw_args
+    in_white = (op_name in WHITE_LIST or op_name in _state.custom_white) \
+        and op_name not in _state.custom_black
+    if not in_white:
+        return raw_args
+    target = to_jax_dtype(_state.dtype)
+    out = []
+    for a in raw_args:
+        if hasattr(a, "dtype") and a.dtype == jnp.float32:
+            out.append(a.astype(target))
+        else:
+            out.append(a)
+    return out
+
+
+@contextlib.contextmanager
+def auto_cast(enable=True, custom_white_list=None, custom_black_list=None,
+              level="O1", dtype="bfloat16"):
+    prev = (_state.enabled, _state.dtype, _state.level, _state.custom_white,
+            _state.custom_black)
+    _state.enabled = enable
+    _state.dtype = dtype
+    _state.level = level
+    _state.custom_white = set(custom_white_list or ())
+    _state.custom_black = set(custom_black_list or ())
+    try:
+        yield
+    finally:
+        (_state.enabled, _state.dtype, _state.level, _state.custom_white,
+         _state.custom_black) = prev
+
+
+amp_guard = auto_cast
+
+
+def decorate(models=None, optimizers=None, level="O2", dtype="bfloat16",
+             master_weight=None, save_dtype=None):
+    """O2: cast model params to bf16 (optimizer keeps fp32 master weights —
+    our Adam-family moments are always fp32, and the update math upcasts)."""
+    if level == "O2" and models is not None:
+        single = not isinstance(models, (list, tuple))
+        ms = [models] if single else list(models)
+        for m in ms:
+            m.to(dtype=dtype)
+        models = ms[0] if single else ms
+    if optimizers is None:
+        return models
+    return models, optimizers
+
+
+class GradScaler:
+    """reference `amp/grad_scaler.py:20` / `imperative/amp_auto_cast.cc`.
+    For bfloat16 (TPU default) scaling is an identity passthrough; for
+    float16 the full dynamic-loss-scaling state machine runs."""
+
+    def __init__(self, enable=True, init_loss_scaling=2.0 ** 15,
+                 incr_ratio=2.0, decr_ratio=0.5, incr_every_n_steps=1000,
+                 decr_every_n_nan_or_inf=1, use_dynamic_loss_scaling=True):
+        self._enable = enable
+        self._scale = float(init_loss_scaling)
+        self._incr_ratio = incr_ratio
+        self._decr_ratio = decr_ratio
+        self._incr_every = incr_every_n_steps
+        self._decr_every = decr_every_n_nan_or_inf
+        self._dynamic = use_dynamic_loss_scaling
+        self._good_steps = 0
+        self._bad_steps = 0
+        self._found_inf = False
+
+    def _active(self):
+        return self._enable and _state.dtype == "float16"
+
+    def scale(self, loss):
+        if not self._active():
+            return loss
+        return loss * self._scale
+
+    def unscale_(self, optimizer):
+        if not self._active():
+            return
+        import jax.numpy as jnp
+        inv = 1.0 / self._scale
+        found = False
+        for p in (optimizer._parameter_list or []):
+            if p._grad is not None:
+                g = p._grad * inv
+                if bool(jnp.any(~jnp.isfinite(g))):
+                    found = True
+                p._grad = g
+        self._found_inf = found
+
+    def step(self, optimizer):
+        if not self._active():
+            optimizer.step()
+            return
+        self.unscale_(optimizer)
+        if not self._found_inf:
+            optimizer.step()
+        self.update()
+
+    def minimize(self, optimizer, scaled_loss):
+        scaled_loss.backward()
+        self.step(optimizer)
+
+    def update(self):
+        if not (self._active() and self._dynamic):
+            return
+        if self._found_inf:
+            self._bad_steps += 1
+            self._good_steps = 0
+            if self._bad_steps >= self._decr_every:
+                self._scale = max(self._scale * self._decr_ratio, 1.0)
+                self._bad_steps = 0
+        else:
+            self._good_steps += 1
+            self._bad_steps = 0
+            if self._good_steps >= self._incr_every:
+                self._scale *= self._incr_ratio
+                self._good_steps = 0
+        self._found_inf = False
+
+    def is_enable(self):
+        return self._enable
+
+    def get_scale(self):
+        return self._scale
+
+    def state_dict(self):
+        return {"scale": self._scale, "good_steps": self._good_steps,
+                "bad_steps": self._bad_steps}
+
+    def load_state_dict(self, d):
+        self._scale = d.get("scale", self._scale)
+        self._good_steps = d.get("good_steps", 0)
+        self._bad_steps = d.get("bad_steps", 0)

@@ -1,0 +1,39 @@
+"""paddle.save / paddle.load (reference `fluid/dygraph/checkpoint.py:56,128`
+save_dygraph/load_dygraph; format: pickled dict of numpy arrays →
+`.pdparams` / `.pdopt`)."""
+from __future__ import annotations
+
+import os
+import pickle
+from typing import Any
+
+import numpy as np
+
+from .tensor import Tensor
+
+__all__ = ["save", "load"]
+
+
+def _to_saveable(obj):
+    if isinstance(obj, Tensor):
+        return np.asarray(obj.numpy())
+    if isinstance(obj, dict):
+        return {k: _to_saveable(v) for k, v in obj.items()}
+    if isinstance(obj, (list, tuple)):
+        return type(obj)(_to_saveable(v) for v in obj)
+    if hasattr(obj, "shape") and hasattr(obj, "dtype"):
+        return np.asarray(obj)
+    return obj
+
+
+def save(obj: Any, path: str, protocol: int = 4, **configs):
+    d = os.path.dirname(os.path.abspath(path))
+    if d:
+        os.makedirs(d, exist_ok=True)
+    with open(path, "wb") as f:
+        pickle.dump(_to_saveable(obj), f, protocol=protocol)
+
+
+def load(path: str, **configs):
+    with open(path, "rb") as f:
+        return pickle.load(f)

@@ -245,6 +245,36 @@ def apply_op(name: str, fn: Callable, args: Sequence[Any], kwargs: dict):
     raw_kwargs = {k: (v._value if isinstance(v, Tensor) else v)
                   for k, v in kwargs.items()}
 
+    from ..amp import amp_active, maybe_cast_inputs
+    if amp_active():
+        raw_args = maybe_cast_inputs(name, raw_args)
+
+    # static-graph mode: execute eagerly on placeholder values for
+    # shape/dtype propagation AND record the op into the current Program
+    # (reference: Python Program building in fluid/framework.py; here the
+    # record is replayed through one jax.jit at Executor.run time).
+    if not autograd.in_trace_mode():
+        from ..static import program as _static
+        if _static.in_static_mode():
+            def closed_static(*vals):
+                full = list(raw_args)
+                vi = 0
+                for i, a in enumerate(args):
+                    if isinstance(a, Tensor):
+                        full[i] = vals[vi]
+                        vi += 1
+                return fn(*full, **raw_kwargs)
+            out = fn(*raw_args, **raw_kwargs)
+            single = not isinstance(out, (tuple, list))
+            flat = [out] if single else list(out)
+            outs = [_static.Variable(x) for x in flat]
+            tin = [a for a in args if isinstance(a, Tensor)]
+
+            def fn_slots(*vals):
+                return closed_static(*vals)
+            _static.record_op(name, fn_slots, tin, outs)
+            return outs[0] if single else tuple(outs)
+
     record = bool(diff_pos) and is_grad_enabled()
     if not record:
         out = fn(*raw_args, **raw_kwargs)

@@ -1,0 +1,4 @@
+from . import lr
+from .optimizer import Optimizer
+from .optimizers import (SGD, Adadelta, Adagrad, Adam, Adamax, AdamW, Lamb,
+                         LarsMomentum, Momentum, RMSProp)

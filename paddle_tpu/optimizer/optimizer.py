@@ -1,0 +1,184 @@
+"""Optimizer base (reference `python/paddle/optimizer/optimizer.py`; in the
+reference each update rule is a CUDA op, e.g. `operators/optimizers/adam_op`).
+
+TPU-native design: every optimizer is a *pure pytree update rule*
+`_update(grads, params, state, lr) -> (new_params, new_state)`. Eager
+`step()` runs it through a cached jit over the whole parameter set (one
+fused XLA program — the analogue of the reference's fused_adam); the
+functional train paths (Model.fit / fleet / to_static) call the same rule
+inside their compiled step, and ZeRO shards `state` over the dp axis.
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+import jax
+import jax.numpy as jnp
+
+from ..framework.tensor import Parameter, Tensor
+from .lr import LRScheduler
+
+__all__ = ["Optimizer"]
+
+
+class Optimizer:
+    def __init__(self, learning_rate=0.001, parameters=None, weight_decay=None,
+                 grad_clip=None, name=None):
+        self._lr = learning_rate
+        if parameters is not None:
+            parameters = list(parameters)
+        self._parameter_list = parameters
+        self._grad_clip = grad_clip
+        if isinstance(weight_decay, float) or isinstance(weight_decay, int):
+            self._weight_decay = float(weight_decay)
+        elif weight_decay is None:
+            self._weight_decay = 0.0
+        else:  # L2Decay-like object
+            self._weight_decay = float(getattr(weight_decay, "_coeff",
+                                               getattr(weight_decay,
+                                                       "coeff", 0.0)))
+        self._accumulators: Dict[int, dict] = {}
+        self._global_step = 0
+        self._jit_cache = {}
+
+    # -- lr -----------------------------------------------------------------
+    def get_lr(self) -> float:
+        if isinstance(self._lr, LRScheduler):
+            return float(self._lr())
+        return float(self._lr)
+
+    def set_lr(self, value):
+        if isinstance(self._lr, LRScheduler):
+            raise RuntimeError(
+                "cannot set_lr when the lr is an LRScheduler; call "
+                "scheduler.step() instead")
+        self._lr = float(value)
+
+    @property
+    def _learning_rate(self):
+        return self._lr
+
+    # -- state --------------------------------------------------------------
+    def _init_state(self, param_value) -> dict:
+        """Per-parameter accumulator init. Override."""
+        return {}
+
+    def _update(self, g, p, state: dict, lr, step) -> tuple:
+        """Pure per-parameter update: returns (new_p, new_state)."""
+        raise NotImplementedError
+
+    def _state_for(self, p: Parameter) -> dict:
+        st = self._accumulators.get(id(p))
+        if st is None:
+            st = self._init_state(p._value)
+            self._accumulators[id(p)] = st
+        return st
+
+    # -- eager step ---------------------------------------------------------
+    def step(self):
+        params = [p for p in (self._parameter_list or [])
+                  if not p.stop_gradient and p._grad is not None]
+        if not params:
+            return
+        grads = [p._grad for p in params]
+        if self._grad_clip is not None:
+            clipped = self._grad_clip._tree_clip(grads)
+            grads = clipped
+        states = [self._state_for(p) for p in params]
+        lr = self.get_lr()
+        step_no = self._global_step
+        key = (len(params), tuple(p._value.shape for p in params),
+               tuple(str(p._value.dtype) for p in params))
+        fn = self._jit_cache.get(key)
+        if fn is None:
+            def batch_update(gs, ps, sts, lr_, st_no):
+                new_ps, new_sts = [], []
+                for g, p, s in zip(gs, ps, sts):
+                    np_, ns_ = self._update(g, p, s, lr_, st_no)
+                    new_ps.append(np_)
+                    new_sts.append(ns_)
+                return new_ps, new_sts
+            fn = jax.jit(batch_update)
+            self._jit_cache[key] = fn
+        new_vals, new_states = fn(grads, [p._value for p in params], states,
+                                  jnp.asarray(lr, "float32"),
+                                  jnp.asarray(step_no + 1, "int32"))
+        for p, v, s in zip(params, new_vals, new_states):
+            p._value = v
+            self._accumulators[id(p)] = s
+        self._global_step += 1
+
+    def minimize(self, loss, startup_program=None, parameters=None,
+                 no_grad_set=None):
+        from ..static import program as _static
+        if _static.in_static_mode():
+            prog = _static.default_main_program()
+            pg = _static.append_backward(loss, parameters)
+            prog._opt_hooks.append(self)
+            return [], pg
+        loss.backward()
+        self.step()
+        return [], []
+
+    def clear_grad(self):
+        for p in (self._parameter_list or []):
+            p.clear_grad()
+
+    clear_gradients = clear_grad
+
+    # -- functional API (used by jitted train steps / fleet / ZeRO) ---------
+    def init_state_pytree(self, params_pytree):
+        return jax.tree_util.tree_map(
+            lambda v: self._init_state(v), params_pytree,
+            is_leaf=lambda x: isinstance(x, jnp.ndarray) or hasattr(x, "shape"))
+
+    def apply_gradients_pytree(self, grads, params, opt_state, lr=None,
+                               step=0):
+        """Pure: same rule as step(), over arbitrary pytrees (jit/pjit-safe)."""
+        if self._grad_clip is not None:
+            grads = self._grad_clip._tree_clip(grads)
+        lr = self.get_lr() if lr is None else lr
+        leaves_g, treedef = jax.tree_util.tree_flatten(grads)
+        leaves_p = treedef.flatten_up_to(params)
+        leaves_s = treedef.flatten_up_to(opt_state)
+        new_p, new_s = [], []
+        for g, p, s in zip(leaves_g, leaves_p, leaves_s):
+            np_, ns_ = self._update(g, p, s, lr, step)
+            new_p.append(np_)
+            new_s.append(ns_)
+        return (jax.tree_util.tree_unflatten(treedef, new_p),
+                jax.tree_util.tree_unflatten(treedef, new_s))
+
+    # -- checkpoint ---------------------------------------------------------
+    def state_dict(self):
+        out = {"global_step": self._global_step}
+        if isinstance(self._lr, LRScheduler):
+            out["LR_Scheduler"] = self._lr.state_dict()
+        for i, p in enumerate(self._parameter_list or []):
+            st = self._accumulators.get(id(p))
+            if st:
+                for k, v in st.items():
+                    out[f"{p.name}_{k}"] = Tensor(v)
+        return out
+
+    def set_state_dict(self, state_dict):
+        self._global_step = int(state_dict.get("global_step", 0))
+        if isinstance(self._lr, LRScheduler) and "LR_Scheduler" in state_dict:
+            self._lr.set_state_dict(state_dict["LR_Scheduler"])
+        for p in (self._parameter_list or []):
+            st = self._init_state(p._value)
+            found = False
+            for k in st:
+                key = f"{p.name}_{k}"
+                if key in state_dict:
+                    v = state_dict[key]
+                    st[k] = jnp.asarray(
+                        v.numpy() if isinstance(v, Tensor) else v)
+                    found = True
+            if found:
+                self._accumulators[id(p)] = st
+
+    def _apply_weight_decay(self, g, p):
+        if self._weight_decay:
+            return g + self._weight_decay * p
+        return g

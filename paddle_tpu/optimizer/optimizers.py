@@ -1,0 +1,249 @@
+"""Optimizer update rules (reference `paddle/fluid/operators/optimizers/*`:
+sgd_op, momentum_op, adam_op, adamw, lamb_op, lars_momentum_op, rmsprop_op,
+adagrad_op, adadelta_op, adamax_op). Each is a pure pytree rule; see
+Optimizer for the execution model."""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from .optimizer import Optimizer
+
+__all__ = ["SGD", "Momentum", "Adam", "AdamW", "Adamax", "Adagrad",
+           "Adadelta", "RMSProp", "Lamb", "LarsMomentum"]
+
+
+class SGD(Optimizer):
+    def _update(self, g, p, state, lr, step):
+        g = self._apply_weight_decay(g, p)
+        return p - lr.astype(p.dtype) * g.astype(p.dtype), state
+
+
+class Momentum(Optimizer):
+    def __init__(self, learning_rate=0.001, momentum=0.9, parameters=None,
+                 use_nesterov=False, weight_decay=None, grad_clip=None,
+                 name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip,
+                         name)
+        self._momentum = momentum
+        self._nesterov = use_nesterov
+
+    def _init_state(self, v):
+        return {"velocity": jnp.zeros_like(v)}
+
+    def _update(self, g, p, state, lr, step):
+        g = self._apply_weight_decay(g.astype(p.dtype), p)
+        vel = self._momentum * state["velocity"] + g
+        if self._nesterov:
+            new_p = p - lr.astype(p.dtype) * (g + self._momentum * vel)
+        else:
+            new_p = p - lr.astype(p.dtype) * vel
+        return new_p, {"velocity": vel}
+
+
+class Adam(Optimizer):
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-8, parameters=None, weight_decay=None,
+                 grad_clip=None, lazy_mode=False, multi_precision=False,
+                 name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip,
+                         name)
+        self._beta1 = beta1
+        self._beta2 = beta2
+        self._eps = epsilon
+
+    def _init_state(self, v):
+        return {"moment1": jnp.zeros_like(v, "float32"),
+                "moment2": jnp.zeros_like(v, "float32")}
+
+    def _adam_core(self, g, p, state, lr, step):
+        g32 = g.astype("float32")
+        m = self._beta1 * state["moment1"] + (1 - self._beta1) * g32
+        v = self._beta2 * state["moment2"] + (1 - self._beta2) * g32 * g32
+        t = step.astype("float32")
+        mhat = m / (1 - self._beta1 ** t)
+        vhat = v / (1 - self._beta2 ** t)
+        upd = lr * mhat / (jnp.sqrt(vhat) + self._eps)
+        return upd, {"moment1": m, "moment2": v}
+
+    def _update(self, g, p, state, lr, step):
+        g = self._apply_weight_decay(g.astype(p.dtype), p)
+        upd, new_state = self._adam_core(g, p, state, lr, step)
+        return (p.astype("float32") - upd).astype(p.dtype), new_state
+
+
+class AdamW(Adam):
+    """Decoupled weight decay (reference `paddle/optimizer/adamw.py`)."""
+
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-8, parameters=None, weight_decay=0.01,
+                 lr_ratio=None, apply_decay_param_fun=None, grad_clip=None,
+                 lazy_mode=False, multi_precision=False, name=None):
+        super().__init__(learning_rate, beta1, beta2, epsilon, parameters,
+                         None, grad_clip, name=name)
+        self._coeff = float(weight_decay) if not hasattr(
+            weight_decay, "_coeff") else weight_decay._coeff
+        self._apply_decay_fn = apply_decay_param_fun
+
+    def _update(self, g, p, state, lr, step):
+        upd, new_state = self._adam_core(g, p, state, lr, step)
+        p32 = p.astype("float32")
+        p32 = p32 - lr * self._coeff * p32 - upd
+        return p32.astype(p.dtype), new_state
+
+
+class Adamax(Optimizer):
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-8, parameters=None, weight_decay=None,
+                 grad_clip=None, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip,
+                         name)
+        self._beta1, self._beta2, self._eps = beta1, beta2, epsilon
+
+    def _init_state(self, v):
+        return {"moment": jnp.zeros_like(v, "float32"),
+                "inf_norm": jnp.zeros_like(v, "float32")}
+
+    def _update(self, g, p, state, lr, step):
+        g = self._apply_weight_decay(g.astype("float32"), p.astype("float32"))
+        m = self._beta1 * state["moment"] + (1 - self._beta1) * g
+        u = jnp.maximum(self._beta2 * state["inf_norm"], jnp.abs(g))
+        t = step.astype("float32")
+        upd = lr / (1 - self._beta1 ** t) * m / (u + self._eps)
+        return ((p.astype("float32") - upd).astype(p.dtype),
+                {"moment": m, "inf_norm": u})
+
+
+class Adagrad(Optimizer):
+    def __init__(self, learning_rate, epsilon=1e-6, parameters=None,
+                 weight_decay=None, grad_clip=None,
+                 initial_accumulator_value=0.0, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip,
+                         name)
+        self._eps = epsilon
+        self._init_acc = initial_accumulator_value
+
+    def _init_state(self, v):
+        return {"moment": jnp.full_like(v, self._init_acc, "float32")}
+
+    def _update(self, g, p, state, lr, step):
+        g = self._apply_weight_decay(g.astype("float32"), p.astype("float32"))
+        acc = state["moment"] + g * g
+        new_p = p.astype("float32") - lr * g / (jnp.sqrt(acc) + self._eps)
+        return new_p.astype(p.dtype), {"moment": acc}
+
+
+class Adadelta(Optimizer):
+    def __init__(self, learning_rate=0.001, epsilon=1e-6, rho=0.95,
+                 parameters=None, weight_decay=None, grad_clip=None,
+                 name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip,
+                         name)
+        self._eps, self._rho = epsilon, rho
+
+    def _init_state(self, v):
+        return {"avg_squared_grad": jnp.zeros_like(v, "float32"),
+                "avg_squared_update": jnp.zeros_like(v, "float32")}
+
+    def _update(self, g, p, state, lr, step):
+        g = self._apply_weight_decay(g.astype("float32"), p.astype("float32"))
+        asg = self._rho * state["avg_squared_grad"] + (1 - self._rho) * g * g
+        upd = (jnp.sqrt(state["avg_squared_update"] + self._eps)
+               / jnp.sqrt(asg + self._eps)) * g
+        asu = (self._rho * state["avg_squared_update"]
+               + (1 - self._rho) * upd * upd)
+        return ((p.astype("float32") - lr * upd).astype(p.dtype),
+                {"avg_squared_grad": asg, "avg_squared_update": asu})
+
+
+class RMSProp(Optimizer):
+    def __init__(self, learning_rate, rho=0.95, epsilon=1e-6, momentum=0.0,
+                 centered=False, parameters=None, weight_decay=None,
+                 grad_clip=None, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip,
+                         name)
+        self._rho, self._eps = rho, epsilon
+        self._momentum, self._centered = momentum, centered
+
+    def _init_state(self, v):
+        st = {"mean_square": jnp.zeros_like(v, "float32"),
+              "momentum_acc": jnp.zeros_like(v, "float32")}
+        if self._centered:
+            st["mean_grad"] = jnp.zeros_like(v, "float32")
+        return st
+
+    def _update(self, g, p, state, lr, step):
+        g = self._apply_weight_decay(g.astype("float32"), p.astype("float32"))
+        ms = self._rho * state["mean_square"] + (1 - self._rho) * g * g
+        if self._centered:
+            mg = self._rho * state["mean_grad"] + (1 - self._rho) * g
+            denom = jnp.sqrt(ms - mg * mg + self._eps)
+        else:
+            mg = None
+            denom = jnp.sqrt(ms + self._eps)
+        mom = self._momentum * state["momentum_acc"] + lr * g / denom
+        new_p = (p.astype("float32") - mom).astype(p.dtype)
+        st = {"mean_square": ms, "momentum_acc": mom}
+        if mg is not None:
+            st["mean_grad"] = mg
+        return new_p, st
+
+
+class Lamb(Optimizer):
+    """reference `operators/optimizers/lamb_op.h`."""
+
+    def __init__(self, learning_rate=0.001, lamb_weight_decay=0.01,
+                 beta1=0.9, beta2=0.999, epsilon=1e-6, parameters=None,
+                 grad_clip=None, exclude_from_weight_decay_fn=None,
+                 name=None):
+        super().__init__(learning_rate, parameters, None, grad_clip, name)
+        self._beta1, self._beta2, self._eps = beta1, beta2, epsilon
+        self._lamb_wd = lamb_weight_decay
+        self._exclude_fn = exclude_from_weight_decay_fn
+
+    def _init_state(self, v):
+        return {"moment1": jnp.zeros_like(v, "float32"),
+                "moment2": jnp.zeros_like(v, "float32")}
+
+    def _update(self, g, p, state, lr, step):
+        g32 = g.astype("float32")
+        p32 = p.astype("float32")
+        m = self._beta1 * state["moment1"] + (1 - self._beta1) * g32
+        v = self._beta2 * state["moment2"] + (1 - self._beta2) * g32 * g32
+        t = step.astype("float32")
+        mhat = m / (1 - self._beta1 ** t)
+        vhat = v / (1 - self._beta2 ** t)
+        r = mhat / (jnp.sqrt(vhat) + self._eps) + self._lamb_wd * p32
+        w_norm = jnp.sqrt(jnp.sum(p32 * p32))
+        r_norm = jnp.sqrt(jnp.sum(r * r))
+        trust = jnp.where((w_norm > 0) & (r_norm > 0), w_norm / r_norm, 1.0)
+        new_p = (p32 - lr * trust * r).astype(p.dtype)
+        return new_p, {"moment1": m, "moment2": v}
+
+
+class LarsMomentum(Optimizer):
+    """reference `operators/optimizers/lars_momentum_op.*`."""
+
+    def __init__(self, learning_rate=0.001, momentum=0.9,
+                 lars_coeff=0.001, lars_weight_decay=0.0005, parameters=None,
+                 grad_clip=None, epsilon=1e-9, name=None):
+        super().__init__(learning_rate, parameters, None, grad_clip, name)
+        self._momentum = momentum
+        self._lars_coeff = lars_coeff
+        self._lars_wd = lars_weight_decay
+        self._eps = epsilon
+
+    def _init_state(self, v):
+        return {"velocity": jnp.zeros_like(v, "float32")}
+
+    def _update(self, g, p, state, lr, step):
+        g32 = g.astype("float32")
+        p32 = p.astype("float32")
+        p_norm = jnp.sqrt(jnp.sum(p32 * p32))
+        g_norm = jnp.sqrt(jnp.sum(g32 * g32))
+        local_lr = jnp.where(
+            (p_norm > 0) & (g_norm > 0),
+            self._lars_coeff * p_norm /
+            (g_norm + self._lars_wd * p_norm + self._eps), 1.0)
+        vel = (self._momentum * state["velocity"]
+               + lr * local_lr * (g32 + self._lars_wd * p32))
+        return (p32 - vel).astype(p.dtype), {"velocity": vel}

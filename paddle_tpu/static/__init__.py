@@ -1,0 +1,27 @@
+"""paddle.static namespace (reference `python/paddle/static/`)."""
+from ..nn import functional as _F  # noqa: F401
+from .input_spec import InputSpec
+from .program import (Executor, Program, Variable, append_backward, data,
+                      default_main_program, default_startup_program,
+                      disable_static, enable_static, global_scope,
+                      in_static_mode, program_guard, scope_guard)
+
+# nn re-exports used by static-style model code
+from .. import nn  # noqa: F401
+
+
+def save(program, model_path, **kwargs):
+    import pickle
+    import numpy as np
+    from .program import global_scope
+    state = {k: np.asarray(v) for k, v in global_scope().items()}
+    with open(model_path + ".pdparams", "wb") as f:
+        pickle.dump(state, f, protocol=4)
+
+
+def load(program, model_path, executor=None, var_list=None):
+    import pickle
+    from .program import global_scope
+    with open(model_path + ".pdparams", "rb") as f:
+        state = pickle.load(f)
+    global_scope().update(state)

@@ -1,0 +1,322 @@
+"""Static graph: Program / Executor / program_guard.
+
+Reference: `python/paddle/fluid/framework.py` (Program/Block/Operator),
+`fluid/executor.py:916` Executor.run, `framework/executor.cc:460` op loop.
+
+TPU-native redesign: a Program is a recorded op list (each entry: the raw
+XLA-lowerable fn + SSA slot ids). `Executor.run` lowers the whole program
+(feed slots + parameter slots → fetch slots) into ONE jax.jit computation —
+the reference's per-op interpreter loop is replaced by whole-program XLA
+compilation, which is the only sane execution model on TPU. append_backward
+differentiates that same lowered function with jax.grad, so static autodiff
+needs no per-op grad makers.
+"""
+from __future__ import annotations
+
+import contextlib
+import itertools
+import threading
+from typing import Any, Callable, Dict, List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..framework.tensor import Tensor
+
+__all__ = ["Program", "Executor", "program_guard", "default_main_program",
+           "default_startup_program", "enable_static", "disable_static",
+           "in_static_mode", "data", "scope_guard", "global_scope",
+           "Variable", "append_backward"]
+
+_slot_counter = itertools.count()
+
+
+class Variable(Tensor):
+    """A static-graph variable: a Tensor whose value is a placeholder zeros
+    array (for shape/dtype propagation during graph building) plus an SSA
+    slot id used at execution time."""
+
+    def __init__(self, value, name=None, is_param=False, is_feed=False):
+        super().__init__(value, stop_gradient=not is_param, name=name)
+        self.slot = next(_slot_counter)
+        self.is_param = is_param
+        self.is_feed = is_feed
+
+
+class _Op:
+    __slots__ = ("name", "fn", "in_refs", "out_slots")
+
+    def __init__(self, name, fn, in_refs, out_slots):
+        self.name = name
+        self.fn = fn
+        self.in_refs = in_refs  # list of ("s", slot) | ("c", const_array)
+        self.out_slots = out_slots
+
+
+class Program:
+    def __init__(self):
+        self.ops: List[_Op] = []
+        self.vars: Dict[int, Variable] = {}
+        self.feed_vars: Dict[str, Variable] = {}
+        self.param_vars: Dict[str, Variable] = {}
+        self.random_ops = False
+        self._opt_hooks: List[Callable] = []
+
+    def record(self, name, fn, inputs, output_tensors):
+        from ..framework.tensor import Parameter
+        in_refs = []
+        for t in inputs:
+            if isinstance(t, Parameter):
+                # lazily promote eager Parameters used in static graphs
+                if not hasattr(t, "slot"):
+                    t.slot = next(_slot_counter)
+                    self.param_vars[t.name] = t
+                    self.vars[t.slot] = t
+                    _state.scope[t.name] = np.asarray(t._value)
+                in_refs.append(("s", t.slot))
+            elif isinstance(t, Variable):
+                in_refs.append(("s", t.slot))
+                self.vars[t.slot] = t
+            else:
+                in_refs.append(("c", t._value))
+        out_slots = [t.slot for t in output_tensors]
+        for t in output_tensors:
+            self.vars[t.slot] = t
+        self.ops.append(_Op(name, fn, in_refs, out_slots))
+
+    def clone(self, for_test=False):
+        return self
+
+    def global_block(self):
+        return self
+
+    def all_parameters(self):
+        return list(self.param_vars.values())
+
+    def __repr__(self):
+        lines = [f"Program({len(self.ops)} ops)"]
+        for op in self.ops[:50]:
+            lines.append(f"  {op.name}: {op.in_slots} -> {op.out_slots}")
+        return "\n".join(lines)
+
+
+class _StaticState(threading.local):
+    def __init__(self):
+        self.enabled = False
+        self.main: Program = Program()
+        self.startup: Program = Program()
+        self.scope: Dict[str, np.ndarray] = {}
+
+
+_state = _StaticState()
+
+
+def in_static_mode() -> bool:
+    return _state.enabled
+
+
+def enable_static():
+    _state.enabled = True
+
+
+def disable_static(place=None):
+    _state.enabled = False
+
+
+def default_main_program() -> Program:
+    return _state.main
+
+
+def default_startup_program() -> Program:
+    return _state.startup
+
+
+@contextlib.contextmanager
+def program_guard(main_program, startup_program=None):
+    prev_m, prev_s = _state.main, _state.startup
+    _state.main = main_program
+    if startup_program is not None:
+        _state.startup = startup_program
+    try:
+        yield
+    finally:
+        _state.main, _state.startup = prev_m, prev_s
+
+
+def global_scope():
+    return _state.scope
+
+
+@contextlib.contextmanager
+def scope_guard(scope):
+    prev = _state.scope
+    _state.scope = scope
+    try:
+        yield
+    finally:
+        _state.scope = prev
+
+
+def data(name, shape, dtype="float32", lod_level=0):
+    from ..framework.dtype import to_jax_dtype
+    shape = [1 if (s is None or s == -1) else int(s) for s in shape]
+    v = Variable(jnp.zeros(shape, to_jax_dtype(dtype)), name=name,
+                 is_feed=True)
+    _state.main.feed_vars[name] = v
+    _state.main.vars[v.slot] = v
+    return v
+
+
+def make_parameter(name, value):
+    """Called by static-mode create_parameter: registers the param in the
+    scope and returns its Variable."""
+    v = Variable(value, name=name, is_param=True)
+    _state.main.param_vars[name] = v
+    _state.main.vars[v.slot] = v
+    _state.scope[name] = np.asarray(value)
+    return v
+
+
+def record_op(name, fn, inputs, outputs):
+    _state.main.record(name, fn, inputs, outputs)
+
+
+class _Lowered:
+    """program → one jittable function (feeds, params) -> fetches."""
+
+    def __init__(self, program: Program, fetch_slots: Sequence[int]):
+        self.program = program
+        self.fetch_slots = list(fetch_slots)
+        feed_items = sorted(program.feed_vars.items())
+        self.feed_names = [n for n, _ in feed_items]
+        self.feed_slots = [v.slot for _, v in feed_items]
+        param_items = sorted(program.param_vars.items())
+        self.param_names = [n for n, _ in param_items]
+        self.param_slots = [v.slot for _, v in param_items]
+
+    def __call__(self, feed_list, param_list):
+        env: Dict[int, Any] = {}
+        for s, v in zip(self.feed_slots, feed_list):
+            env[s] = v
+        for s, v in zip(self.param_slots, param_list):
+            env[s] = v
+        for op in self.program.ops:
+            args = []
+            for tag, ref in op.in_refs:
+                if tag == "c":
+                    args.append(ref)
+                elif ref in env:
+                    args.append(env[ref])
+                else:
+                    args.append(self.program.vars[ref]._value)
+            outs = op.fn(*args)
+            if not isinstance(outs, (tuple, list)):
+                outs = (outs,)
+            for s, o in zip(op.out_slots, outs):
+                env[s] = o
+        return [env[s] if s in env else self.program.vars[s]._value
+                for s in self.fetch_slots]
+
+
+def append_backward(loss, parameter_list=None, no_grad_set=None,
+                    callbacks=None):
+    """Marks the program for gradient computation (reference
+    `fluid/backward.py:1337`). Actual differentiation happens at lowering
+    time via jax.grad over the lowered function."""
+    prog = _state.main
+    prog._loss_slot = loss.slot
+    params = parameter_list or list(prog.param_vars.values())
+    return [(p, None) for p in params]
+
+
+class Executor:
+    """reference `fluid/executor.py:916`. One jit per (program, fetch) key."""
+
+    def __init__(self, place=None):
+        self.place = place
+        self._cache: Dict[Any, Callable] = {}
+
+    def run(self, program=None, feed=None, fetch_list=None,
+            scope=None, return_numpy=True, use_program_cache=True):
+        program = program or _state.main
+        feed = feed or {}
+        fetch_list = fetch_list or []
+        scope = scope if scope is not None else _state.scope
+
+        if program is _state.startup or not fetch_list and not feed:
+            # startup program: parameters were already initialized eagerly at
+            # build time (make_parameter); nothing to execute.
+            if program.ops:
+                self._run_plain(program, scope)
+            return []
+
+        fetch_vars = [f for f in fetch_list]
+        fetch_slots = [f.slot for f in fetch_vars]
+        lowered = _Lowered(program, fetch_slots)
+
+        feed_arrays = []
+        for n in lowered.feed_names:
+            if n in feed:
+                arr = feed[n]
+                arr = arr.numpy() if isinstance(arr, Tensor) else np.asarray(arr)
+                feed_arrays.append(jnp.asarray(arr))
+            else:
+                feed_arrays.append(program.feed_vars[n]._value)
+        param_arrays = [jnp.asarray(scope[n]) for n in lowered.param_names]
+
+        train = hasattr(program, "_loss_slot") and program._opt_hooks
+        key = (id(program), tuple(fetch_slots),
+               tuple((tuple(a.shape), str(a.dtype)) for a in feed_arrays),
+               bool(train), len(program.ops))
+        fn = self._cache.get(key)
+        if fn is None:
+            if train:
+                opt = program._opt_hooks[-1]
+
+                def step(feeds, params_vals, opt_state, step_no, lr):
+                    def loss_fn(pvals):
+                        loss_lowered = _Lowered(program,
+                                                [program._loss_slot])
+                        return loss_lowered(feeds, pvals)[0]
+                    grads = jax.grad(loss_fn)(params_vals)
+                    new_params, new_state = opt.apply_gradients_pytree(
+                        grads, params_vals, opt_state, lr, step_no)
+                    outs = _Lowered(program, fetch_slots)(feeds, params_vals)
+                    return outs, new_params, new_state
+                fn = jax.jit(step)
+            else:
+                fn = jax.jit(lambda feeds, params_vals: lowered(
+                    feeds, params_vals))
+            self._cache[key] = fn
+
+        if train:
+            opt = program._opt_hooks[-1]
+            if not hasattr(program, "_opt_state"):
+                program._opt_state = [opt._init_state(p)
+                                      for p in param_arrays]
+                program._step_no = 0
+            outs, new_params, new_state = fn(
+                feed_arrays, param_arrays, program._opt_state,
+                jnp.asarray(program._step_no + 1, "int32"),
+                jnp.asarray(opt.get_lr(), "float32"))
+            program._opt_state = new_state
+            program._step_no += 1
+            for n, v in zip(lowered.param_names, new_params):
+                scope[n] = v
+        else:
+            outs = fn(feed_arrays, param_arrays)
+        if return_numpy:
+            return [np.asarray(o) for o in outs]
+        return [Tensor(o) for o in outs]
+
+    def _run_plain(self, program, scope):
+        lowered = _Lowered(program, [])
+        feed_arrays = [program.feed_vars[n]._value
+                       for n in lowered.feed_names]
+        param_arrays = [jnp.asarray(scope.get(n, program.param_vars[n]._value))
+                        for n in lowered.param_names]
+        lowered(feed_arrays, param_arrays)
+
+    def close(self):
+        pass
