@@ -23,6 +23,12 @@ from . import optimizer  # noqa: E402
 from . import amp  # noqa: E402
 from . import jit  # noqa: E402
 from . import static  # noqa: E402
+from . import io  # noqa: E402
+from . import metric  # noqa: E402
+from . import hapi  # noqa: E402
+from . import vision  # noqa: E402
+from .hapi.model import Model  # noqa: E402
+from .hapi.model_summary import summary  # noqa: E402
 from .framework.io_state import load, save  # noqa: E402
 from .framework.param_attr import ParamAttr  # noqa: E402
 from .static.program import disable_static, enable_static  # noqa: E402

@@ -1,0 +1,80 @@
+"""Process-level distributed env (reference
+`python/paddle/distributed/parallel.py:57` init_parallel_env +
+`fleet/base/role_maker.py:528` PaddleCloudRoleMaker env parsing).
+
+TPU model: one process per HOST (not per chip — SPMD covers local chips);
+rendezvous = jax.distributed.initialize with a coordinator address. The
+same PADDLE_* env vars the reference launcher sets are honored.
+"""
+from __future__ import annotations
+
+import os
+from typing import Optional
+
+import jax
+
+__all__ = ["ParallelEnv", "init_parallel_env", "get_rank", "get_world_size",
+           "is_initialized"]
+
+_initialized = False
+
+
+class ParallelEnv:
+    def __init__(self):
+        self.rank = get_rank()
+        self.world_size = get_world_size()
+        self.device_id = 0
+        self.current_endpoint = os.environ.get(
+            "PADDLE_CURRENT_ENDPOINT", "127.0.0.1:6170")
+        self.trainer_endpoints = os.environ.get(
+            "PADDLE_TRAINER_ENDPOINTS", self.current_endpoint).split(",")
+
+    @property
+    def nranks(self):
+        return self.world_size
+
+    @property
+    def local_rank(self):
+        return self.rank
+
+
+def get_rank(group=None) -> int:
+    try:
+        return jax.process_index()
+    except Exception:
+        return 0
+
+
+def get_world_size(group=None) -> int:
+    try:
+        return jax.process_count()
+    except Exception:
+        return 1
+
+
+def is_initialized() -> bool:
+    return _initialized
+
+
+def init_parallel_env(strategy=None) -> ParallelEnv:
+    """Multi-host bootstrap. Single-host (this environment): builds the
+    default all-devices mesh and returns. Multi-host: initializes the jax
+    distributed runtime from PADDLE_* / JAX coordinator env vars, after
+    which jax.devices() spans all hosts and meshes lay over ICI+DCN."""
+    global _initialized
+    if _initialized:
+        return ParallelEnv()
+    coord = os.environ.get("JAX_COORDINATOR_ADDRESS") or \
+        os.environ.get("PADDLE_MASTER") or None
+    nproc = int(os.environ.get("PADDLE_TRAINERS_NUM",
+                               os.environ.get("JAX_NUM_PROCESSES", "1")))
+    pid = int(os.environ.get("PADDLE_TRAINER_ID",
+                             os.environ.get("JAX_PROCESS_ID", "0")))
+    if coord and nproc > 1:
+        jax.distributed.initialize(coordinator_address=coord,
+                                   num_processes=nproc, process_id=pid)
+    from ..parallel.mesh import create_mesh, get_mesh
+    if get_mesh() is None:
+        create_mesh({"dp": len(jax.devices())})
+    _initialized = True
+    return ParallelEnv()

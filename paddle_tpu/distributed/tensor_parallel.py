@@ -1,0 +1,113 @@
+"""Tensor-parallel layers (reference `distributed/collective.py:566` split:
+parallel embedding, row/col-parallel Linear built from c_allreduce/c_concat
+epilogues + `operators/collective/c_split_op` etc.).
+
+TPU-native (GSPMD): the layer stores FULL (logical) weights annotated with
+a PartitionSpec over the 'mp' mesh axis. Forward is the ordinary dense op
+plus sharding constraints; XLA partitions the matmul and inserts the same
+allreduce/allgather epilogues the reference hand-writes — but fused and
+scheduled by the compiler over ICI. Megatron-style column→row pairs
+therefore need NO explicit collectives in framework code.
+"""
+from __future__ import annotations
+
+import jax
+from jax.sharding import PartitionSpec
+
+from ..framework.tensor import Tensor, apply_op
+from ..nn import functional as F
+from ..nn import initializer as I
+from ..nn.layer.layers import Layer
+from ..parallel.mesh import get_mesh, named_sharding
+
+__all__ = ["ColumnParallelLinear", "RowParallelLinear",
+           "VocabParallelEmbedding", "mark_sharding", "constraint"]
+
+
+def mark_sharding(param, *spec):
+    """Attach a partition spec to a Parameter; consumed by the SPMD train
+    step builder (parallel/api.py) when laying params onto the mesh."""
+    param.partition_spec = PartitionSpec(*spec)
+    return param
+
+
+def constraint(x, *spec):
+    """with_sharding_constraint on a framework Tensor (no-op off-mesh)."""
+    mesh = get_mesh()
+    if mesh is None:
+        return x
+    sh = named_sharding(*spec)
+
+    def impl(v):
+        return jax.lax.with_sharding_constraint(v, sh)
+    return apply_op("sharding_constraint", impl, (x,), {})
+
+
+class ColumnParallelLinear(Layer):
+    """weight [in, out] sharded on out ('mp'); output optionally gathered."""
+
+    def __init__(self, in_features, out_features, weight_attr=None,
+                 has_bias=True, bias_attr=None, gather_output=True,
+                 name=None):
+        super().__init__()
+        self.gather_output = gather_output
+        self.weight = self.create_parameter(
+            [in_features, out_features], attr=weight_attr,
+            default_initializer=I.XavierUniform())
+        mark_sharding(self.weight, None, "mp")
+        if has_bias and bias_attr is not False:
+            self.bias = self.create_parameter([out_features], attr=bias_attr,
+                                              is_bias=True)
+            mark_sharding(self.bias, "mp")
+        else:
+            self.bias = None
+
+    def forward(self, x):
+        out = F.linear(x, self.weight, self.bias)
+        if self.gather_output:
+            out = constraint(out, None)  # force replicated (XLA all-gather)
+        else:
+            out = constraint(out, *([None] * (out.ndim - 1) + ["mp"]))
+        return out
+
+
+class RowParallelLinear(Layer):
+    """weight [in, out] sharded on in ('mp'); XLA inserts the partial-sum
+    allreduce the reference writes as c_allreduce_sum."""
+
+    def __init__(self, in_features, out_features, weight_attr=None,
+                 has_bias=True, bias_attr=None, input_is_parallel=False,
+                 name=None):
+        super().__init__()
+        self.input_is_parallel = input_is_parallel
+        self.weight = self.create_parameter(
+            [in_features, out_features], attr=weight_attr,
+            default_initializer=I.XavierUniform())
+        mark_sharding(self.weight, "mp", None)
+        if has_bias and bias_attr is not False:
+            self.bias = self.create_parameter([out_features], attr=bias_attr,
+                                              is_bias=True)
+        else:
+            self.bias = None
+
+    def forward(self, x):
+        if not self.input_is_parallel:
+            x = constraint(x, *([None] * (x.ndim - 1) + ["mp"]))
+        out = F.linear(x, self.weight, self.bias)
+        return constraint(out, None)
+
+
+class VocabParallelEmbedding(Layer):
+    """weight [vocab, emb] sharded on vocab ('mp') — GSPMD partitions the
+    gather (reference: shard_index + c_embedding + allreduce)."""
+
+    def __init__(self, num_embeddings, embedding_dim, weight_attr=None,
+                 name=None):
+        super().__init__()
+        self.weight = self.create_parameter(
+            [num_embeddings, embedding_dim], attr=weight_attr,
+            default_initializer=I.XavierUniform())
+        mark_sharding(self.weight, "mp", None)
+
+    def forward(self, x):
+        return F.embedding(x, self.weight)

@@ -1,0 +1,3 @@
+from . import callbacks, model_summary
+from .model import Model
+from .model_summary import summary

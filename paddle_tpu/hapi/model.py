@@ -1,0 +1,376 @@
+"""High-level Model API (reference `python/paddle/hapi/model.py:810`:
+Model.fit:1299 / evaluate / predict / save:1043, dual Static/Dynamic
+adapters :224/:609).
+
+TPU-native: ONE adapter — the functional train step. prepare() captures
+the network functionally; fit() drives a jax.jit-compiled
+(params, opt_state, batch) -> (loss, outputs, new_params, new_opt_state)
+step — forward, backward and the optimizer update fused into a single XLA
+program per input signature (what the reference needs CompiledProgram +
+ParallelExecutor for). When fleet is initialized the same step is pjit'ed
+over the device mesh (see distributed/fleet).
+"""
+from __future__ import annotations
+
+import os
+import pickle
+import warnings
+from typing import List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..framework import random as frandom
+from ..framework.functional import functionalize, get_buffers, get_params
+from ..framework.tensor import Tensor
+from ..io import DataLoader, Dataset
+from ..metric import Metric
+from . import callbacks as cbks_mod
+
+__all__ = ["Model"]
+
+
+def _flatten_batch(data):
+    if isinstance(data, dict):
+        return list(data.values())
+    if isinstance(data, (list, tuple)):
+        return list(data)
+    return [data]
+
+
+class Model:
+    def __init__(self, network, inputs=None, labels=None):
+        self.network = network
+        self._inputs = inputs
+        self._labels = labels
+        self._optimizer = None
+        self._loss = None
+        self._metrics: List[Metric] = []
+        self._amp_level = None
+        self._apply_fn = None
+        self._opt_state = None
+        self._train_step_cache = {}
+        self._eval_step_cache = {}
+        self._pred_step_cache = {}
+        self.stop_training = False
+        self._dist_ctx = None  # set by fleet.distributed_model
+
+    # -- preparation --------------------------------------------------------
+    def prepare(self, optimizer=None, loss=None, metrics=None,
+                amp_configs=None):
+        self._optimizer = optimizer
+        self._loss = loss
+        if metrics is None:
+            self._metrics = []
+        elif isinstance(metrics, Metric):
+            self._metrics = [metrics]
+        else:
+            self._metrics = list(metrics)
+        if amp_configs is not None:
+            self._amp_level = (amp_configs if isinstance(amp_configs, str)
+                               else amp_configs.get("level", "O1"))
+        self._apply_fn, _, _ = functionalize(self.network)
+        if optimizer is not None and getattr(
+                optimizer, "_parameter_list", None) is None:
+            optimizer._parameter_list = self.network.parameters()
+        return self
+
+    # -- internals ----------------------------------------------------------
+    def _loss_value(self, outputs, labels):
+        outs = outputs if isinstance(outputs, (list, tuple)) else [outputs]
+        if self._loss is None:
+            # network returns the loss directly
+            v = outs[0]
+            return v
+        if callable(self._loss):
+            return self._loss(*outs, *labels)
+        raise TypeError("loss must be callable")
+
+    def _make_train_step(self):
+        apply_fn = self._apply_fn
+        opt = self._optimizer
+        amp_level = self._amp_level
+
+        def loss_fn(pv, bv, rng, inputs, labels):
+            def fwd():
+                wrapped_in = [Tensor(x) for x in inputs]
+                wrapped_lb = [Tensor(x) for x in labels]
+                out, new_bufs = apply_fn(pv, bv, rng, True,
+                                         *[w._value for w in wrapped_in])
+                wout = jax.tree_util.tree_map(
+                    lambda x: Tensor(x), out)
+                lv = self._loss_value(wout, wrapped_lb)
+                return lv, (out, new_bufs)
+            if amp_level:
+                from .. import amp as amp_mod
+                from ..framework.autograd import trace_mode
+                with trace_mode(), amp_mod.auto_cast(level=amp_level):
+                    lv, aux = fwd()
+            else:
+                from ..framework.autograd import trace_mode
+                with trace_mode():
+                    lv, aux = fwd()
+            lv_raw = lv._value if isinstance(lv, Tensor) else lv
+            return jnp.mean(lv_raw.astype("float32")), aux
+
+        def step(pv, bv, opt_state, rng, step_no, lr, inputs, labels):
+            (lv, (out, new_bufs)), grads = jax.value_and_grad(
+                loss_fn, has_aux=True)(pv, bv, rng, inputs, labels)
+            new_pv, new_state = opt.apply_gradients_pytree(
+                grads, pv, opt_state, lr, step_no)
+            return lv, out, new_bufs, new_pv, new_state
+        return step
+
+    def train_batch(self, inputs, labels=None, update=True):
+        params = get_params(self.network)
+        buffers = get_buffers(self.network)
+        pv = {n: t._value for n, t in params.items()}
+        bv = {n: t._value for n, t in buffers.items()}
+        inputs = [t._value if isinstance(t, Tensor) else jnp.asarray(t)
+                  for t in _flatten_batch(inputs)]
+        labels = [t._value if isinstance(t, Tensor) else jnp.asarray(t)
+                  for t in _flatten_batch(labels or [])]
+        if self._opt_state is None:
+            self._opt_state = {n: self._optimizer._init_state(v)
+                               for n, v in pv.items()}
+        key = (tuple((tuple(a.shape), str(a.dtype)) for a in inputs),
+               tuple((tuple(a.shape), str(a.dtype)) for a in labels))
+        fn = self._train_step_cache.get(key)
+        if fn is None:
+            fn = jax.jit(self._make_train_step())
+            self._train_step_cache[key] = fn
+        rng = frandom.get_rng_key()
+        step_no = getattr(self, "_global_step", 0) + 1
+        self._global_step = step_no
+        lv, out, new_bufs, new_pv, new_state = fn(
+            pv, bv, self._opt_state, rng,
+            jnp.asarray(step_no, "int32"),
+            jnp.asarray(self._optimizer.get_lr(), "float32"),
+            tuple(inputs), tuple(labels))
+        for n, t in params.items():
+            t._value = new_pv[n]
+        for n, t in buffers.items():
+            t._value = new_bufs[n]
+        self._opt_state = new_state
+        outs = jax.tree_util.tree_leaves(out)
+        metrics = self._update_metrics(outs, labels)
+        return (float(lv), metrics) if self._metrics else ([float(lv)],
+                                                           metrics)
+
+    def eval_batch(self, inputs, labels=None):
+        params = get_params(self.network)
+        buffers = get_buffers(self.network)
+        pv = {n: t._value for n, t in params.items()}
+        bv = {n: t._value for n, t in buffers.items()}
+        inputs = [t._value if isinstance(t, Tensor) else jnp.asarray(t)
+                  for t in _flatten_batch(inputs)]
+        labels = [t._value if isinstance(t, Tensor) else jnp.asarray(t)
+                  for t in _flatten_batch(labels or [])]
+        key = tuple((tuple(a.shape), str(a.dtype)) for a in inputs + labels)
+        fn = self._eval_step_cache.get(key)
+        if fn is None:
+            apply_fn = self._apply_fn
+
+            def estep(pv_, bv_, rng, ins, lbs):
+                from ..framework.autograd import trace_mode
+                out, _ = apply_fn(pv_, bv_, rng, False, *ins)
+                with trace_mode():
+                    wout = jax.tree_util.tree_map(lambda x: Tensor(x), out)
+                    lv = self._loss_value(wout, [Tensor(x) for x in lbs]) \
+                        if (self._loss is not None and lbs) else None
+                lv_raw = (jnp.mean(lv._value.astype("float32"))
+                          if isinstance(lv, Tensor) else
+                          (lv if lv is not None else jnp.zeros(())))
+                return lv_raw, out
+            fn = jax.jit(estep)
+            self._eval_step_cache[key] = fn
+        rng = frandom.get_rng_key()
+        lv, out = fn(pv, bv, rng, tuple(inputs), tuple(labels))
+        outs = jax.tree_util.tree_leaves(out)
+        metrics = self._update_metrics(outs, labels)
+        return float(lv), metrics
+
+    def predict_batch(self, inputs):
+        params = get_params(self.network)
+        buffers = get_buffers(self.network)
+        pv = {n: t._value for n, t in params.items()}
+        bv = {n: t._value for n, t in buffers.items()}
+        inputs = [t._value if isinstance(t, Tensor) else jnp.asarray(t)
+                  for t in _flatten_batch(inputs)]
+        key = tuple((tuple(a.shape), str(a.dtype)) for a in inputs)
+        fn = self._pred_step_cache.get(key)
+        if fn is None:
+            apply_fn = self._apply_fn
+            fn = jax.jit(lambda pv_, bv_, rng, ins: apply_fn(
+                pv_, bv_, rng, False, *ins)[0])
+            self._pred_step_cache[key] = fn
+        out = fn(pv, bv, frandom.get_rng_key(), tuple(inputs))
+        return jax.tree_util.tree_map(lambda x: np.asarray(x), out)
+
+    def _update_metrics(self, outputs, labels):
+        res = []
+        for m in self._metrics:
+            inp = m.compute(Tensor(outputs[0]),
+                            *[Tensor(l) for l in labels])
+            r = m.update(inp if not isinstance(inp, tuple) else inp[0])
+            res.append(r)
+        return res
+
+    # -- loops --------------------------------------------------------------
+    def _as_loader(self, data, batch_size, shuffle, num_workers, drop_last):
+        if data is None or isinstance(data, DataLoader):
+            return data
+        if isinstance(data, Dataset):
+            return DataLoader(data, batch_size=batch_size, shuffle=shuffle,
+                              num_workers=num_workers, drop_last=drop_last)
+        return data
+
+    def _split_batch(self, batch):
+        data = _flatten_batch(batch)
+        n_in = len(self._inputs) if self._inputs else 1
+        if len(data) == 1:
+            return data, []
+        return data[:n_in], data[n_in:]
+
+    def fit(self, train_data=None, eval_data=None, batch_size=1, epochs=1,
+            eval_freq=1, log_freq=10, save_dir=None, save_freq=1, verbose=2,
+            drop_last=False, shuffle=True, num_workers=0, callbacks=None,
+            accumulate_grad_batches=1, num_iters=None):
+        assert self._optimizer is not None, "call prepare() first"
+        loader = self._as_loader(train_data, batch_size, shuffle, num_workers,
+                                 drop_last)
+        eval_loader = self._as_loader(eval_data, batch_size, False,
+                                      num_workers, False)
+        cbks = cbks_mod.config_callbacks(
+            callbacks, model=self, epochs=epochs,
+            steps=len(loader) if hasattr(loader, "__len__") else None,
+            log_freq=log_freq, save_freq=save_freq, save_dir=save_dir,
+            verbose=verbose,
+            metrics=["loss"] + [n for m in self._metrics
+                                for n in (m.name() if isinstance(m.name(),
+                                                                 list)
+                                          else [m.name()])])
+        cbks.on_begin("train")
+        self.stop_training = False
+        step_count = 0
+        for epoch in range(epochs):
+            if hasattr(loader, "batch_sampler") and hasattr(
+                    loader.batch_sampler, "set_epoch"):
+                loader.batch_sampler.set_epoch(epoch)
+            cbks.on_epoch_begin(epoch)
+            for m in self._metrics:
+                m.reset()
+            logs = {}
+            for step, batch in enumerate(loader):
+                cbks.on_batch_begin("train", step, logs)
+                ins, lbs = self._split_batch(batch)
+                loss, metrics = self.train_batch(ins, lbs)
+                logs = {"loss": loss if np.isscalar(loss) else loss[0],
+                        "step": step, "batch_size":
+                        ins[0].shape[0] if hasattr(ins[0], "shape") else
+                        batch_size}
+                for m, r in zip(self._metrics, metrics):
+                    names = m.name() if isinstance(m.name(), list) else \
+                        [m.name()]
+                    vals = r if isinstance(r, list) else [r]
+                    for n, v in zip(names, vals):
+                        logs[n] = v
+                cbks.on_batch_end("train", step, logs)
+                step_count += 1
+                if num_iters is not None and step_count >= num_iters:
+                    self.stop_training = True
+                    break
+            # epoch-level metric accumulation
+            for m in self._metrics:
+                names = m.name() if isinstance(m.name(), list) else \
+                    [m.name()]
+                vals = m.accumulate()
+                vals = vals if isinstance(vals, list) else [vals]
+                for n, v in zip(names, vals):
+                    logs[n] = v
+            cbks.on_epoch_end(epoch, logs)
+            if eval_loader is not None and (epoch + 1) % eval_freq == 0:
+                self.evaluate(eval_loader, batch_size=batch_size,
+                              verbose=0, num_workers=num_workers,
+                              callbacks=None)
+            if isinstance(self._optimizer._lr, object) and hasattr(
+                    self._optimizer._lr, "step") and not np.isscalar(
+                    self._optimizer._lr):
+                pass
+            if self.stop_training:
+                break
+        cbks.on_end("train", logs)
+        return self
+
+    def evaluate(self, eval_data, batch_size=1, log_freq=10, verbose=2,
+                 num_workers=0, callbacks=None, num_samples=None):
+        loader = self._as_loader(eval_data, batch_size, False, num_workers,
+                                 False)
+        for m in self._metrics:
+            m.reset()
+        losses = []
+        for batch in loader:
+            ins, lbs = self._split_batch(batch)
+            lv, _ = self.eval_batch(ins, lbs)
+            losses.append(lv)
+        logs = {"loss": float(np.mean(losses)) if losses else 0.0}
+        for m in self._metrics:
+            names = m.name() if isinstance(m.name(), list) else [m.name()]
+            vals = m.accumulate()
+            vals = vals if isinstance(vals, list) else [vals]
+            for n, v in zip(names, vals):
+                logs[n] = v
+        return logs
+
+    def predict(self, test_data, batch_size=1, num_workers=0,
+                stack_outputs=False, verbose=1, callbacks=None):
+        loader = self._as_loader(test_data, batch_size, False, num_workers,
+                                 False)
+        outputs = []
+        for batch in loader:
+            ins, _ = self._split_batch(batch)
+            outputs.append(self.predict_batch(ins))
+        if stack_outputs and outputs:
+            if isinstance(outputs[0], (list, tuple)):
+                outputs = [np.concatenate([o[i] for o in outputs])
+                           for i in range(len(outputs[0]))]
+            else:
+                outputs = np.concatenate(outputs)
+        return outputs
+
+    # -- persistence --------------------------------------------------------
+    def save(self, path, training=True):
+        from ..framework.io_state import save as psave
+        if training:
+            psave(self.network.state_dict(), path + ".pdparams")
+            if self._optimizer is not None:
+                opt_state = {"global_step": getattr(self, "_global_step", 0)}
+                if self._opt_state is not None:
+                    opt_state["state"] = jax.tree_util.tree_map(
+                        lambda x: np.asarray(x), self._opt_state)
+                psave(opt_state, path + ".pdopt")
+        else:
+            from .. import jit as pjit
+            specs = self._inputs
+            pjit.save(self.network, path, input_spec=specs)
+
+    def load(self, path, skip_mismatch=False, reset_optimizer=False):
+        from ..framework.io_state import load as pload
+        state = pload(path + ".pdparams")
+        self.network.set_state_dict(state)
+        opt_path = path + ".pdopt"
+        if not reset_optimizer and os.path.exists(opt_path):
+            opt_state = pload(opt_path)
+            self._global_step = opt_state.get("global_step", 0)
+            if "state" in opt_state:
+                self._opt_state = jax.tree_util.tree_map(
+                    lambda x: jnp.asarray(x), opt_state["state"])
+        return self
+
+    def parameters(self, *args, **kwargs):
+        return self.network.parameters()
+
+    def summary(self, input_size=None, dtype=None):
+        from .model_summary import summary
+        return summary(self.network, input_size, dtype)

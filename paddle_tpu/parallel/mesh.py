@@ -1,0 +1,88 @@
+"""Device mesh management.
+
+The reference manages NCCL rings keyed by ring_id
+(`platform/collective_helper.h:65` NCCLCommContext) with TCP bootstrap
+(`gen_comm_id_helper.cc`). TPU-native replacement: ONE `jax.sharding.Mesh`
+whose named axes (dp/mp/pp/sp/ep) take the place of rings; collectives are
+compiled into programs over those axes. Bootstrap = jax.distributed
+(coordinator address), no nccl-id plumbing.
+"""
+from __future__ import annotations
+
+import contextlib
+import math
+import threading
+from typing import Dict, Optional, Sequence
+
+import numpy as np
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+__all__ = ["create_mesh", "get_mesh", "set_mesh", "mesh_scope", "axis_size",
+           "named_sharding", "DEFAULT_AXES", "replicated", "P"]
+
+P = PartitionSpec
+DEFAULT_AXES = ("dp", "mp", "pp", "sp", "ep")
+
+
+class _State(threading.local):
+    def __init__(self):
+        self.mesh: Optional[Mesh] = None
+
+
+_state = _State()
+
+
+def create_mesh(axes: Dict[str, int] = None, devices=None) -> Mesh:
+    """create_mesh({'dp': 2, 'mp': 4}) — -1 means 'rest of the devices'."""
+    devices = list(devices if devices is not None else jax.devices())
+    n = len(devices)
+    axes = dict(axes or {"dp": n})
+    known = math.prod(v for v in axes.values() if v > 0)
+    rest = [k for k, v in axes.items() if v in (-1, None)]
+    if rest:
+        assert len(rest) == 1, "only one -1 axis allowed"
+        axes[rest[0]] = n // known
+        known = n
+    assert math.prod(axes.values()) == n, \
+        f"mesh {axes} does not cover {n} devices"
+    arr = np.asarray(devices).reshape(tuple(axes.values()))
+    mesh = Mesh(arr, tuple(axes.keys()))
+    _state.mesh = mesh
+    return mesh
+
+
+def get_mesh() -> Optional[Mesh]:
+    return _state.mesh
+
+
+def set_mesh(mesh: Optional[Mesh]):
+    _state.mesh = mesh
+
+
+@contextlib.contextmanager
+def mesh_scope(mesh: Mesh):
+    prev = _state.mesh
+    _state.mesh = mesh
+    try:
+        yield mesh
+    finally:
+        _state.mesh = prev
+
+
+def axis_size(axis: str) -> int:
+    mesh = get_mesh()
+    if mesh is None or axis not in mesh.axis_names:
+        return 1
+    return mesh.shape[axis]
+
+
+def named_sharding(*spec) -> Optional[NamedSharding]:
+    mesh = get_mesh()
+    if mesh is None:
+        return None
+    return NamedSharding(mesh, PartitionSpec(*spec))
+
+
+def replicated() -> Optional[NamedSharding]:
+    return named_sharding()

@@ -1,0 +1,2 @@
+from . import datasets, models, transforms
+from .models import *  # noqa: F401,F403

@@ -1,10 +1,21 @@
-"""Test env: force CPU with 8 virtual devices BEFORE jax import, so every
-sharding/collective test runs the same code path the driver's
-dryrun_multichip uses (xla_force_host_platform_device_count)."""
+"""Test env: force CPU with 8 virtual devices so every sharding/collective
+test runs the same code path the driver's dryrun_multichip uses.
+
+NOTE: this image's sitecustomize imports jax at interpreter start (axon TPU
+tunnel), so setting JAX_PLATFORMS in os.environ here is too late — we must
+go through jax.config before the first backend initialization instead.
+"""
 import os
 
-os.environ["JAX_PLATFORMS"] = "cpu"
 _flags = os.environ.get("XLA_FLAGS", "")
 if "host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (_flags +
                                " --xla_force_host_platform_device_count=8")
+os.environ["JAX_PLATFORMS"] = "cpu"
+
+import jax  # noqa: E402  (already imported by sitecustomize; config still open)
+
+try:
+    jax.config.update("jax_platforms", "cpu")
+except Exception:
+    pass

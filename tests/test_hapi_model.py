@@ -1,0 +1,92 @@
+"""Model.fit end-to-end (the reference's LeNet/MNIST correctness gate,
+`python/paddle/tests/test_model.py`)."""
+import numpy as np
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+from paddle_tpu.io import DataLoader, TensorDataset
+from paddle_tpu.metric import Accuracy
+from paddle_tpu.vision.datasets import MNIST
+from paddle_tpu.vision.models import LeNet
+
+
+def _toy_classification(n=256, dim=16, classes=4, seed=0):
+    rng = np.random.RandomState(seed)
+    centers = rng.randn(classes, dim).astype("float32") * 3
+    y = rng.randint(0, classes, n)
+    x = centers[y] + rng.randn(n, dim).astype("float32")
+    return x.astype("float32"), y.astype("int64")
+
+
+def test_model_fit_linear_classifier():
+    paddle.seed(0)
+    x, y = _toy_classification()
+    ds = TensorDataset([x, y])
+    net = nn.Sequential(nn.Linear(16, 32), nn.ReLU(), nn.Linear(32, 4))
+    model = paddle.Model(net)
+    model.prepare(paddle.optimizer.Adam(0.01, parameters=net.parameters()),
+                  nn.CrossEntropyLoss(), Accuracy())
+    model.fit(ds, batch_size=32, epochs=3, verbose=0)
+    logs = model.evaluate(ds, batch_size=64, verbose=0)
+    assert logs["acc"] > 0.9, logs
+
+
+def test_model_fit_lenet_mnist_synthetic():
+    paddle.seed(1)
+    train = MNIST(mode="train")
+    model = paddle.Model(LeNet())
+    model.prepare(paddle.optimizer.Adam(0.001,
+                                        parameters=model.parameters()),
+                  nn.CrossEntropyLoss(), Accuracy())
+    model.fit(train, batch_size=64, epochs=1, verbose=0)
+    # synthetic labels are random → just assert the pipeline ran & loss finite
+    logs = model.evaluate(train, batch_size=64, verbose=0)
+    assert np.isfinite(logs["loss"])
+
+
+def test_model_save_load(tmp_path):
+    x, y = _toy_classification(64)
+    ds = TensorDataset([x, y])
+    net = nn.Sequential(nn.Linear(16, 4))
+    model = paddle.Model(net)
+    model.prepare(paddle.optimizer.SGD(0.1, parameters=net.parameters()),
+                  nn.CrossEntropyLoss())
+    model.fit(ds, batch_size=32, epochs=1, verbose=0)
+    p = str(tmp_path / "ckpt")
+    model.save(p)
+    w_before = net[0].weight.numpy().copy()
+    net[0].weight.set_value(np.zeros_like(w_before))
+    model.load(p)
+    np.testing.assert_allclose(net[0].weight.numpy(), w_before)
+
+
+def test_model_predict():
+    x, y = _toy_classification(64)
+    ds = TensorDataset([x, y])
+    net = nn.Sequential(nn.Linear(16, 4))
+    model = paddle.Model(net)
+    model.prepare(paddle.optimizer.SGD(0.1, parameters=net.parameters()),
+                  nn.CrossEntropyLoss())
+    out = model.predict(ds, batch_size=32, stack_outputs=True)
+    assert np.asarray(out).shape == (64, 4)
+
+
+def test_dataloader_workers():
+    x, y = _toy_classification(128)
+    ds = TensorDataset([x, y])
+    dl = DataLoader(ds, batch_size=16, num_workers=2, shuffle=True)
+    batches = list(dl)
+    assert len(batches) == 8
+    assert batches[0][0].shape == [16, 16]
+
+
+def test_lr_scheduler_steps_during_fit():
+    x, y = _toy_classification(64)
+    ds = TensorDataset([x, y])
+    net = nn.Sequential(nn.Linear(16, 4))
+    sched = paddle.optimizer.lr.StepDecay(0.1, step_size=1, gamma=0.5)
+    model = paddle.Model(net)
+    model.prepare(paddle.optimizer.SGD(sched, parameters=net.parameters()),
+                  nn.CrossEntropyLoss())
+    model.fit(ds, batch_size=32, epochs=1, verbose=0)
+    assert sched.last_epoch >= 2
