@@ -27,6 +27,9 @@ from . import io  # noqa: E402
 from . import metric  # noqa: E402
 from . import hapi  # noqa: E402
 from . import vision  # noqa: E402
+from . import distributed  # noqa: E402
+from . import parallel  # noqa: E402
+from .distributed.parallel import DataParallel  # noqa: E402
 from .hapi.model import Model  # noqa: E402
 from .hapi.model_summary import summary  # noqa: E402
 from .framework.io_state import load, save  # noqa: E402

@@ -1,0 +1,37 @@
+"""paddle.distributed (reference `python/paddle/distributed/`)."""
+from . import collective, fleet
+from .collective import (ReduceOp, all_gather, all_reduce, alltoall, barrier,
+                         broadcast, get_group, new_group, recv, reduce,
+                         reduce_scatter, scatter, send, shard_ctx, split,
+                         wait)
+from .env import (ParallelEnv, get_rank, get_world_size, init_parallel_env,
+                  is_initialized)
+from .parallel import DataParallel
+from .tensor_parallel import (ColumnParallelLinear, RowParallelLinear,
+                              VocabParallelEmbedding)
+
+
+def spawn(func, args=(), nprocs=-1, join=True, daemon=False, **options):
+    """reference `distributed/spawn.py:276`. TPU note: SPMD spans local
+    chips from one process, so nprocs>1 is only for multi-host-style
+    testing; it forks python processes wired with the PADDLE_* env."""
+    import multiprocessing as mp
+    import os
+    if nprocs in (-1, 0, 1):
+        func(*args)
+        return
+    ctx = mp.get_context("spawn")
+    procs = []
+    for rank in range(nprocs):
+        env = {"PADDLE_TRAINER_ID": str(rank),
+               "PADDLE_TRAINERS_NUM": str(nprocs)}
+
+        def target(rank=rank, env=env):
+            os.environ.update(env)
+            func(*args)
+        p = ctx.Process(target=target, daemon=daemon)
+        p.start()
+        procs.append(p)
+    if join:
+        for p in procs:
+            p.join()

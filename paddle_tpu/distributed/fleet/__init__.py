@@ -1,0 +1,24 @@
+from .fleet_base import Fleet, fleet
+from .role_maker import PaddleCloudRoleMaker, Role, RoleMakerBase, UserDefinedRoleMaker
+from .strategy import DistributedStrategy
+from .utils import HDFSClient, LocalFS, UtilBase
+
+# module-level facade functions (reference: `fleet` is used as a module)
+init = fleet.init
+distributed_optimizer = fleet.distributed_optimizer
+distributed_model = fleet.distributed_model
+build_sharded_train_step = fleet.build_sharded_train_step
+worker_num = fleet.worker_num
+is_first_worker = fleet.is_first_worker
+is_worker = fleet.is_worker
+is_server = fleet.is_server
+init_worker = fleet.init_worker
+init_server = fleet.init_server
+run_server = fleet.run_server
+stop_worker = fleet.stop_worker
+barrier_worker = fleet.barrier_worker
+save_persistables = fleet.save_persistables
+
+
+def worker_index():
+    return fleet.worker_index

@@ -1,0 +1,49 @@
+"""DataParallel + init_parallel_env surface (reference
+`fluid/dygraph/parallel.py:322` DataParallel, `imperative/reducer.cc`
+bucketed allreduce).
+
+TPU-native: there is no Reducer. Under SPMD the gradient allreduce is
+emitted by XLA from the dp-sharded batch; eager single-process training
+needs no comm at all. DataParallel here (a) shards params onto the mesh,
+(b) exposes the reference API (scale_loss / apply_collective_grads are
+no-ops kept for code compat)."""
+from __future__ import annotations
+
+from ..framework.tensor import Tensor
+from ..nn.layer.layers import Layer
+from .env import ParallelEnv, get_world_size, init_parallel_env
+
+__all__ = ["DataParallel", "ParallelEnv", "init_parallel_env"]
+
+
+class DataParallel(Layer):
+    def __init__(self, layers, strategy=None, comm_buffer_size=25,
+                 last_comm_buffer_size=1, find_unused_parameters=False,
+                 group=None):
+        super().__init__()
+        self._layers = layers
+        self.find_unused_parameters = find_unused_parameters
+        from ..parallel.mesh import get_mesh
+        from ..parallel.spmd import shard_params
+        if get_mesh() is not None:
+            shard_params(layers)
+
+    def forward(self, *inputs, **kwargs):
+        return self._layers(*inputs, **kwargs)
+
+    def scale_loss(self, loss):
+        # reference scales by 1/nranks before allreduce; XLA's mean over the
+        # dp-sharded batch already accounts for it.
+        return loss
+
+    def apply_collective_grads(self):
+        pass
+
+    def state_dict(self, *args, **kwargs):
+        return self._layers.state_dict(*args, **kwargs)
+
+    def set_state_dict(self, state_dict, *args, **kwargs):
+        return self._layers.set_state_dict(state_dict, *args, **kwargs)
+
+    load_dict = set_state_dict
+    set_dict = set_state_dict

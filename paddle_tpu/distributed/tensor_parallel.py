@@ -32,10 +32,15 @@ def mark_sharding(param, *spec):
 
 
 def constraint(x, *spec):
-    """with_sharding_constraint on a framework Tensor (no-op off-mesh)."""
+    """with_sharding_constraint on a framework Tensor (no-op off-mesh;
+    axes absent from the current mesh are dropped so TP layers run
+    unchanged on dp-only meshes)."""
     mesh = get_mesh()
     if mesh is None:
         return x
+    spec = tuple(s if (s is None or (s in mesh.axis_names
+                                     and mesh.shape[s] > 1)) else None
+                 for s in spec)
     sh = named_sharding(*spec)
 
     def impl(v):

@@ -1,0 +1,7 @@
+from .mesh import (DEFAULT_AXES, P, axis_size, create_mesh, get_mesh,
+                   mesh_scope, named_sharding, replicated, set_mesh)
+from .pipeline import gpipe_spmd, pipeline_forward
+from .ring_attention import (ring_attention, shard_map_ring_attention,
+                             ulysses_attention)
+from .spmd import (batch_sharding, make_sharded_train_step, param_sharding,
+                   shard_params, write_back, zero_sharding)

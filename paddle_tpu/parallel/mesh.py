@@ -44,9 +44,9 @@ def create_mesh(axes: Dict[str, int] = None, devices=None) -> Mesh:
         assert len(rest) == 1, "only one -1 axis allowed"
         axes[rest[0]] = n // known
         known = n
-    assert math.prod(axes.values()) == n, \
-        f"mesh {axes} does not cover {n} devices"
-    arr = np.asarray(devices).reshape(tuple(axes.values()))
+    need = math.prod(axes.values())
+    assert need <= n, f"mesh {axes} needs {need} devices, only {n} present"
+    arr = np.asarray(devices[:need]).reshape(tuple(axes.values()))
     mesh = Mesh(arr, tuple(axes.keys()))
     _state.mesh = mesh
     return mesh

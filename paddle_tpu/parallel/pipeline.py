@@ -1,0 +1,104 @@
+"""Pipeline parallelism (reference: PipelineOptimizer
+`fluid/optimizer.py:3718` + `fleet/meta_optimizers/pipeline_optimizer.py`
++ `framework/section_worker.cc:49-105` F-then-B microbatch schedule over
+send_v2/recv_v2).
+
+TPU-native redesign: stages live on the 'pp' mesh axis under shard_map;
+stage parameters are STACKED on a leading pp-sharded axis (each device
+holds its stage's slice), activations flow around the ring with
+lax.ppermute, and the GPipe F-then-B schedule is a lax.fori_loop over
+micro-steps. XLA overlaps the ppermute with stage compute (the analogue of
+the reference's separate comm stream).
+
+Requires homogeneous stages (same params/activation shapes per stage) —
+the standard TPU formulation for transformer stacks.
+"""
+from __future__ import annotations
+
+import functools
+from typing import Callable
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import PartitionSpec as P
+
+__all__ = ["gpipe_spmd", "pipeline_forward"]
+
+
+def pipeline_forward(stage_fn: Callable, stage_params, x, *, axis_name="pp",
+                     n_micro: int):
+    """Run inside shard_map over `axis_name`.
+
+    stage_fn(params, micro_x) -> micro_y : one stage's forward.
+    stage_params: THIS device's stage params (unstacked leaves).
+    x: [n_micro, mb, ...] microbatched input, replicated across pp
+       (only stage 0's reads matter).
+    Returns [n_micro, mb, ...] outputs valid on the LAST stage.
+
+    GPipe forward schedule: at step t, device d processes microbatch
+    t - d (if in range); activations hop d→d+1 each step. Total steps =
+    n_micro + pp - 1.
+    """
+    pp = lax.axis_size(axis_name)
+    d = lax.axis_index(axis_name)
+    steps = n_micro + pp - 1
+    mb_shape = x.shape[1:]
+
+    perm = [(i, (i + 1) % pp) for i in range(pp)]
+
+    def body(t, carry):
+        buf_in, outs = carry
+        # stage 0 injects microbatch t (if valid); others use ring input
+        mb_idx = jnp.clip(t, 0, n_micro - 1)
+        inject = lax.dynamic_index_in_dim(x, mb_idx, 0, keepdims=False)
+        cur = jnp.where(d == 0, inject, buf_in)
+        my_mb = t - d  # which microbatch this device processes now
+        active = (my_mb >= 0) & (my_mb < n_micro)
+        y = stage_fn(stage_params, cur)
+        y = jnp.where(active, y, jnp.zeros_like(y))
+        # last stage stores result
+        out_idx = jnp.clip(my_mb, 0, n_micro - 1)
+        store = (d == pp - 1) & active
+        prev = lax.dynamic_index_in_dim(outs, out_idx, 0, keepdims=False)
+        outs = lax.dynamic_update_index_in_dim(
+            outs, jnp.where(store, y, prev), out_idx, 0)
+        nxt = lax.ppermute(y, axis_name, perm)
+        return nxt, outs
+
+    buf0 = jnp.zeros(mb_shape, x.dtype)
+    outs0 = jnp.zeros((n_micro,) + mb_shape, x.dtype)
+    _, outs = lax.fori_loop(0, steps, body, (buf0, outs0))
+    return outs[None]  # [1, n_micro, ...] per stage; caller takes [-1]
+
+
+def gpipe_spmd(stage_fn: Callable, mesh, n_micro: int, axis_name="pp"):
+    """Wrap a homogeneous stage function into a pipelined forward over the
+    mesh's pp axis.
+
+    Usage:
+      fwd = gpipe_spmd(stage_fn, mesh, n_micro=4)
+      y = fwd(stacked_params, x)[-1]  # stacked_params leaves: [pp, ...]
+                                      # x: [n_micro, mb, ...]
+    Output is [pp, n_micro, ...]; index [-1] is the last stage's result.
+    Gradients flow through ppermute (its transpose is the reverse
+    permute), so jax.grad over this forward IS the backward schedule —
+    the reference needs hand-inserted send/recv grad ops
+    (`section_worker.cc`), here it's transposition.
+    """
+    inner = functools.partial(pipeline_forward, stage_fn,
+                              axis_name=axis_name, n_micro=n_micro)
+
+    def wrapper(stacked_params, x):
+        def shard_fn(params_slice, x_rep):
+            params_local = jax.tree_util.tree_map(
+                lambda a: jnp.squeeze(a, 0), params_slice)
+            return inner(params_local, x_rep)
+        param_specs = jax.tree_util.tree_map(
+            lambda _: P(axis_name), stacked_params)
+        return jax.shard_map(
+            shard_fn, mesh=mesh,
+            in_specs=(param_specs, P()),
+            out_specs=P(axis_name),
+            check_vma=False)(stacked_params, x)
+    return wrapper

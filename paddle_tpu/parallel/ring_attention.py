@@ -75,9 +75,11 @@ def ring_attention(q, k, v, axis_name: str = "sp", causal: bool = False,
         v_nxt = lax.ppermute(v_cur, axis_name, perm)
         return m_new, l_new, acc_new, k_nxt, v_nxt
 
-    m0 = jnp.full((B, H, S, 1), -1e30, jnp.float32)
-    l0 = jnp.zeros((B, H, S, 1), jnp.float32)
-    acc0 = jnp.zeros((B, H, S, D), jnp.float32)
+    # derive carries from q so they inherit the 'sp' varying manual axis
+    qf = q.astype(jnp.float32)
+    m0 = jnp.full_like(qf[..., :1], -1e30)
+    l0 = jnp.zeros_like(qf[..., :1])
+    acc0 = jnp.zeros_like(qf)
     m, l, acc, _, _ = lax.fori_loop(
         0, sp, body, (m0, l0, acc0, k.astype(jnp.float32),
                       v.astype(jnp.float32)))
@@ -117,7 +119,7 @@ def shard_map_ring_attention(q, k, v, mesh, causal=False, impl="ring"):
     """Convenience: run (ring|ulysses) attention over global arrays
     [B, H, S, D] sequence-sharded on 'sp'."""
     from jax.sharding import PartitionSpec as P
-    from jax.experimental.shard_map import shard_map
+    shard_map = jax.shard_map
     attn = ring_attention if impl == "ring" else ulysses_attention
     fn = shard_map(
         functools.partial(attn, axis_name="sp", causal=causal),

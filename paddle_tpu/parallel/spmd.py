@@ -167,7 +167,7 @@ def make_sharded_train_step(layer, optimizer, loss_fn: Callable,
         (lv, new_bufs), grads = jax.value_and_grad(loss_of, has_aux=True)(
             pv_, bv_, rng, inputs, labels)
         new_pv, new_opt = optimizer.apply_gradients_pytree(
-            grads, pv_, opt_state_, lr, step_no)
+            grads, pv_, opt_state_, lr, step_no + 1)
         new_state = {"params": new_pv, "buffers": new_bufs,
                      "opt_state": new_opt, "step_no": step_no + 1}
         return new_state, lv
