@@ -1,0 +1,85 @@
+"""Benchmark: ERNIE-base (L12/H768/A12, seq 128) full training step
+(fwd+bwd+AdamW fused in one XLA program), bf16 compute via AMP autocast —
+the PaddleNLP ERNIE-base finetune configuration from BASELINE.md.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+Runs on whatever accelerator jax exposes (the driver provides the TPU).
+"""
+from __future__ import annotations
+
+import json
+import time
+
+import numpy as np
+
+
+def main():
+    import jax
+    import jax.numpy as jnp
+    import paddle_tpu as paddle
+    import paddle_tpu.nn as nn
+    from paddle_tpu.framework.functional import functionalize
+    from paddle_tpu.framework.autograd import trace_mode
+    from paddle_tpu.framework.tensor import Tensor
+    from paddle_tpu.models import ErnieConfig, ErnieForSequenceClassification
+
+    BATCH, SEQ = 32, 128
+    paddle.seed(0)
+    cfg = ErnieConfig.base()
+    net = ErnieForSequenceClassification(cfg, num_classes=2)
+    opt = paddle.optimizer.AdamW(5e-5, parameters=net.parameters())
+    ce = nn.CrossEntropyLoss()
+
+    apply_fn, pv, bv = functionalize(net)
+    opt_state = {n: opt._init_state(v) for n, v in pv.items()}
+
+    def loss_fn(pv_, bv_, rng, ids, labels):
+        from paddle_tpu import amp
+        with trace_mode(), amp.auto_cast(level="O1", dtype="bfloat16"):
+            out, new_bufs = apply_fn(pv_, bv_, rng, True, ids)
+            lv = ce(Tensor(out), Tensor(labels))
+        return jnp.mean(lv._value.astype("float32")), new_bufs
+
+    def step(pv_, bv_, opt_state_, step_no, rng, ids, labels):
+        (lv, new_bufs), grads = jax.value_and_grad(
+            loss_fn, has_aux=True)(pv_, bv_, rng, ids, labels)
+        new_pv, new_opt = opt.apply_gradients_pytree(
+            grads, pv_, opt_state_, jnp.asarray(5e-5, "float32"),
+            step_no)
+        return lv, new_pv, new_bufs, new_opt
+
+    jit_step = jax.jit(step, donate_argnums=(0, 2))
+
+    rng_np = np.random.RandomState(0)
+    ids = jnp.asarray(rng_np.randint(0, cfg.vocab_size,
+                                     size=(BATCH, SEQ)).astype("int32"))
+    labels = jnp.asarray(rng_np.randint(0, 2, size=(BATCH,)).astype("int32"))
+    key = jax.random.PRNGKey(0)
+
+    # warmup (compile); float() forces a device→host sync (the axon tunnel
+    # does not implement block_until_ready faithfully)
+    step_no = jnp.asarray(1, "int32")
+    for i in range(3):
+        lv, pv, bv, opt_state = jit_step(pv, bv, opt_state, step_no + i,
+                                         key, ids, labels)
+    float(lv)
+
+    iters = 20
+    t0 = time.perf_counter()
+    for i in range(iters):
+        lv, pv, bv, opt_state = jit_step(pv, bv, opt_state,
+                                         step_no + 3 + i, key, ids, labels)
+    float(lv)
+    dt = time.perf_counter() - t0
+    samples_per_sec = BATCH * iters / dt
+
+    print(json.dumps({
+        "metric": "ernie_base_train_samples_per_sec_bs32_seq128_bf16",
+        "value": round(samples_per_sec, 2),
+        "unit": "samples/sec",
+        "vs_baseline": 1.0,
+    }))
+
+
+if __name__ == "__main__":
+    main()

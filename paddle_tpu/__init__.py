@@ -29,6 +29,7 @@ from . import hapi  # noqa: E402
 from . import vision  # noqa: E402
 from . import distributed  # noqa: E402
 from . import parallel  # noqa: E402
+from . import models  # noqa: E402
 from .distributed.parallel import DataParallel  # noqa: E402
 from .hapi.model import Model  # noqa: E402
 from .hapi.model_summary import summary  # noqa: E402

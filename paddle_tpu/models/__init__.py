@@ -1,0 +1,3 @@
+from .ernie import (ErnieConfig, ErnieForPretraining,
+                    ErnieForSequenceClassification, ErnieModel, tp_annotate)
+from .gpt import GPTConfig, GPTForCausalLM, GPTModel, MoEFeedForward

@@ -1,0 +1,181 @@
+"""ERNIE/BERT-family encoder (capability target: PaddleNLP ERNIE-base on
+the reference stack — built here from paddle_tpu.nn.TransformerEncoder;
+reference layer semantics per `python/paddle/nn/layer/transformer.py`).
+
+TPU-first: bf16-friendly (AMP autocast covers the MXU ops), flash-attention
+via F.scaled_dot_product_attention, and `tp_annotate` lays Megatron-style
+GSPMD partition specs onto the encoder weights so the same model runs
+dense, TP, or TP+DP+SP purely by mesh choice.
+"""
+from __future__ import annotations
+
+from .. import nn
+from ..framework.tensor import Tensor
+from ..nn import initializer as I
+from ..ops import creation, manipulation
+
+__all__ = ["ErnieConfig", "ErnieModel", "ErnieForSequenceClassification",
+           "ErnieForPretraining", "ErniePooler", "tp_annotate"]
+
+
+class ErnieConfig:
+    def __init__(self, vocab_size=30522, hidden_size=768,
+                 num_hidden_layers=12, num_attention_heads=12,
+                 intermediate_size=3072, hidden_act="gelu",
+                 hidden_dropout_prob=0.1, attention_probs_dropout_prob=0.1,
+                 max_position_embeddings=512, type_vocab_size=2,
+                 initializer_range=0.02, pad_token_id=0):
+        self.vocab_size = vocab_size
+        self.hidden_size = hidden_size
+        self.num_hidden_layers = num_hidden_layers
+        self.num_attention_heads = num_attention_heads
+        self.intermediate_size = intermediate_size
+        self.hidden_act = hidden_act
+        self.hidden_dropout_prob = hidden_dropout_prob
+        self.attention_probs_dropout_prob = attention_probs_dropout_prob
+        self.max_position_embeddings = max_position_embeddings
+        self.type_vocab_size = type_vocab_size
+        self.initializer_range = initializer_range
+        self.pad_token_id = pad_token_id
+
+    @classmethod
+    def base(cls):
+        return cls()
+
+    @classmethod
+    def tiny(cls):
+        return cls(vocab_size=1024, hidden_size=128, num_hidden_layers=2,
+                   num_attention_heads=4, intermediate_size=256,
+                   max_position_embeddings=128)
+
+
+class ErnieEmbeddings(nn.Layer):
+    def __init__(self, cfg: ErnieConfig):
+        super().__init__()
+        init = I.Normal(0.0, cfg.initializer_range)
+        self.word_embeddings = nn.Embedding(cfg.vocab_size, cfg.hidden_size,
+                                            weight_attr=init)
+        self.position_embeddings = nn.Embedding(cfg.max_position_embeddings,
+                                                cfg.hidden_size,
+                                                weight_attr=init)
+        self.token_type_embeddings = nn.Embedding(cfg.type_vocab_size,
+                                                  cfg.hidden_size,
+                                                  weight_attr=init)
+        self.layer_norm = nn.LayerNorm(cfg.hidden_size, epsilon=1e-12)
+        self.dropout = nn.Dropout(cfg.hidden_dropout_prob)
+
+    def forward(self, input_ids, token_type_ids=None, position_ids=None):
+        seq_len = input_ids.shape[1]
+        if position_ids is None:
+            position_ids = creation.arange(0, seq_len, dtype="int64")
+            position_ids = manipulation.expand(
+                manipulation.reshape(position_ids, [1, seq_len]),
+                [input_ids.shape[0], seq_len])
+        if token_type_ids is None:
+            token_type_ids = creation.zeros_like(input_ids)
+        emb = (self.word_embeddings(input_ids)
+               + self.position_embeddings(position_ids)
+               + self.token_type_embeddings(token_type_ids))
+        return self.dropout(self.layer_norm(emb))
+
+
+class ErniePooler(nn.Layer):
+    def __init__(self, hidden_size):
+        super().__init__()
+        self.dense = nn.Linear(hidden_size, hidden_size)
+        self.activation = nn.Tanh()
+
+    def forward(self, hidden_states):
+        return self.activation(self.dense(hidden_states[:, 0]))
+
+
+class ErnieModel(nn.Layer):
+    def __init__(self, cfg: ErnieConfig = None, **kwargs):
+        super().__init__()
+        cfg = cfg or ErnieConfig(**kwargs)
+        self.config = cfg
+        self.embeddings = ErnieEmbeddings(cfg)
+        enc_layer = nn.TransformerEncoderLayer(
+            cfg.hidden_size, cfg.num_attention_heads, cfg.intermediate_size,
+            dropout=cfg.hidden_dropout_prob, activation=cfg.hidden_act,
+            attn_dropout=cfg.attention_probs_dropout_prob, act_dropout=0.0)
+        self.encoder = nn.TransformerEncoder(enc_layer,
+                                             cfg.num_hidden_layers)
+        self.pooler = ErniePooler(cfg.hidden_size)
+
+    def forward(self, input_ids, token_type_ids=None, position_ids=None,
+                attention_mask=None):
+        emb = self.embeddings(input_ids, token_type_ids, position_ids)
+        if attention_mask is not None and attention_mask.ndim == 2:
+            # [B, S] 1/0 → additive [B, 1, 1, S]
+            am = manipulation.unsqueeze(attention_mask, [1, 2])
+            attention_mask = (1.0 - manipulation.cast(am, "float32")) * -1e4
+        out = self.encoder(emb, attention_mask)
+        pooled = self.pooler(out)
+        return out, pooled
+
+
+class ErnieForSequenceClassification(nn.Layer):
+    def __init__(self, cfg: ErnieConfig = None, num_classes=2, dropout=None,
+                 **kwargs):
+        super().__init__()
+        self.ernie = ErnieModel(cfg, **kwargs)
+        c = self.ernie.config
+        self.dropout = nn.Dropout(dropout if dropout is not None
+                                  else c.hidden_dropout_prob)
+        self.classifier = nn.Linear(c.hidden_size, num_classes)
+
+    def forward(self, input_ids, token_type_ids=None, position_ids=None,
+                attention_mask=None):
+        _, pooled = self.ernie(input_ids, token_type_ids, position_ids,
+                               attention_mask)
+        return self.classifier(self.dropout(pooled))
+
+
+class ErnieForPretraining(nn.Layer):
+    """MLM + NSP heads."""
+
+    def __init__(self, cfg: ErnieConfig = None, **kwargs):
+        super().__init__()
+        self.ernie = ErnieModel(cfg, **kwargs)
+        c = self.ernie.config
+        self.mlm_transform = nn.Linear(c.hidden_size, c.hidden_size)
+        self.mlm_norm = nn.LayerNorm(c.hidden_size, epsilon=1e-12)
+        self.mlm_bias = self.create_parameter([c.vocab_size], is_bias=True)
+        self.nsp = nn.Linear(c.hidden_size, 2)
+        self.act = nn.GELU()
+
+    def forward(self, input_ids, token_type_ids=None, position_ids=None,
+                attention_mask=None):
+        seq, pooled = self.ernie(input_ids, token_type_ids, position_ids,
+                                 attention_mask)
+        h = self.mlm_norm(self.act(self.mlm_transform(seq)))
+        # tied output embedding: h @ E^T (one more MXU matmul)
+        from ..ops.linalg import matmul
+        logits = matmul(h, self.ernie.embeddings.word_embeddings.weight,
+                        transpose_y=True) + self.mlm_bias
+        return logits, self.nsp(pooled)
+
+
+def tp_annotate(layer):
+    """Megatron-style GSPMD specs on a Transformer(-Encoder/Decoder) stack:
+    q/k/v & FFN-up weights column-parallel ('mp' on out dim), out_proj &
+    FFN-down row-parallel ('mp' on in dim), embeddings vocab-parallel.
+    The forward stays dense; XLA partitions (reference equivalent:
+    `distributed/collective.py:566` split + hand-inserted collectives)."""
+    from ..distributed.tensor_parallel import mark_sharding
+    for name, p in layer.named_parameters():
+        ln = name.lower()
+        if p.ndim == 2:
+            if any(k in ln for k in ("q_proj.weight", "k_proj.weight",
+                                     "v_proj.weight", "linear1.weight")):
+                mark_sharding(p, None, "mp")
+            elif any(k in ln for k in ("out_proj.weight", "linear2.weight")):
+                mark_sharding(p, "mp", None)
+            elif "word_embeddings.weight" in ln or "embed_tokens" in ln:
+                mark_sharding(p, "mp", None)
+        elif p.ndim == 1:
+            if any(k in ln for k in ("q_proj.bias", "k_proj.bias",
+                                     "v_proj.bias", "linear1.bias")):
+                mark_sharding(p, "mp")
+    return layer

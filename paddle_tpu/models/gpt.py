@@ -1,0 +1,176 @@
+"""GPT-style causal decoder (capability target: PaddleNLP GPT / ERNIE-3.0
+decoder stacks on the reference). TPU-first: causal flash attention,
+optional ring-attention sequence parallelism, optional MoE FFN with
+expert parallelism over the 'ep' mesh axis."""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from .. import nn
+from ..framework.tensor import Tensor, apply_op
+from ..nn import functional as F
+from ..nn import initializer as I
+from ..ops import creation, manipulation
+
+__all__ = ["GPTConfig", "GPTModel", "GPTForCausalLM", "MoEFeedForward"]
+
+
+class GPTConfig:
+    def __init__(self, vocab_size=50304, hidden_size=768, num_layers=12,
+                 num_heads=12, intermediate_size=3072,
+                 max_position_embeddings=1024, dropout=0.1,
+                 use_moe=False, num_experts=8, moe_top_k=1,
+                 initializer_range=0.02):
+        self.vocab_size = vocab_size
+        self.hidden_size = hidden_size
+        self.num_layers = num_layers
+        self.num_heads = num_heads
+        self.intermediate_size = intermediate_size
+        self.max_position_embeddings = max_position_embeddings
+        self.dropout = dropout
+        self.use_moe = use_moe
+        self.num_experts = num_experts
+        self.moe_top_k = moe_top_k
+        self.initializer_range = initializer_range
+
+    @classmethod
+    def tiny(cls, **kw):
+        d = dict(vocab_size=512, hidden_size=64, num_layers=2, num_heads=4,
+                 intermediate_size=128, max_position_embeddings=128)
+        d.update(kw)
+        return cls(**d)
+
+
+class CausalSelfAttention(nn.Layer):
+    def __init__(self, cfg: GPTConfig):
+        super().__init__()
+        self.num_heads = cfg.num_heads
+        self.head_dim = cfg.hidden_size // cfg.num_heads
+        self.q_proj = nn.Linear(cfg.hidden_size, cfg.hidden_size)
+        self.k_proj = nn.Linear(cfg.hidden_size, cfg.hidden_size)
+        self.v_proj = nn.Linear(cfg.hidden_size, cfg.hidden_size)
+        self.out_proj = nn.Linear(cfg.hidden_size, cfg.hidden_size)
+        self.dropout = cfg.dropout
+
+    def forward(self, x, use_ring=False):
+        b, s, e = x.shape
+        def shape(t):
+            t = manipulation.reshape(t, [b, s, self.num_heads, self.head_dim])
+            return manipulation.transpose(t, [0, 2, 1, 3])
+        q, k, v = shape(self.q_proj(x)), shape(self.k_proj(x)), \
+            shape(self.v_proj(x))
+        if use_ring:
+            from ..parallel.mesh import get_mesh
+            from ..parallel.ring_attention import shard_map_ring_attention
+            mesh = get_mesh()
+            out = apply_op(
+                "ring_attention",
+                lambda qq, kk, vv: shard_map_ring_attention(
+                    qq, kk, vv, mesh, causal=True), (q, k, v), {})
+        else:
+            out = F.scaled_dot_product_attention(
+                q, k, v, is_causal=True, dropout_p=self.dropout,
+                training=self.training)
+        out = manipulation.transpose(out, [0, 2, 1, 3])
+        out = manipulation.reshape(out, [b, s, e])
+        return self.out_proj(out)
+
+
+class MoEFeedForward(nn.Layer):
+    """Expert-parallel MoE FFN (new subsystem — absent in the reference;
+    designed GSPMD-style: expert weights [E, d, f] sharded over 'ep',
+    tokens dispatched with a dense one-hot combine so the whole layer is
+    einsums XLA can partition; top-1 switch routing)."""
+
+    def __init__(self, hidden_size, intermediate_size, num_experts,
+                 top_k=1):
+        super().__init__()
+        self.num_experts = num_experts
+        self.top_k = top_k
+        init = I.XavierUniform()
+        self.gate = nn.Linear(hidden_size, num_experts)
+        self.w_up = self.create_parameter(
+            [num_experts, hidden_size, intermediate_size],
+            default_initializer=init)
+        self.w_down = self.create_parameter(
+            [num_experts, intermediate_size, hidden_size],
+            default_initializer=init)
+        from ..distributed.tensor_parallel import mark_sharding
+        mark_sharding(self.w_up, "ep", None, None)
+        mark_sharding(self.w_down, "ep", None, None)
+
+    def forward(self, x):
+        def impl(h, wu, wd, gate_w, gate_b):
+            import jax
+            b, s, d = h.shape
+            logits = h @ gate_w + gate_b  # [b,s,E]
+            probs = jax.nn.softmax(logits, axis=-1)
+            idx = jnp.argmax(probs, axis=-1)  # top-1 switch
+            onehot = jax.nn.one_hot(idx, wu.shape[0], dtype=h.dtype)
+            gatev = jnp.sum(probs * onehot, axis=-1, keepdims=True)
+            # dense dispatch: [b,s,E,d] routed tokens (zero elsewhere)
+            up = jnp.einsum("bse,bsd,edf->bsef", onehot, h, wu)
+            act = jax.nn.gelu(up)
+            down = jnp.einsum("bsef,efd->bsd", act, wd)
+            return down * gatev
+        return apply_op("moe_ffn", impl,
+                        (x, self.w_up, self.w_down, self.gate.weight,
+                         self.gate.bias), {})
+
+
+class GPTBlock(nn.Layer):
+    def __init__(self, cfg: GPTConfig):
+        super().__init__()
+        self.ln1 = nn.LayerNorm(cfg.hidden_size)
+        self.attn = CausalSelfAttention(cfg)
+        self.ln2 = nn.LayerNorm(cfg.hidden_size)
+        if cfg.use_moe:
+            self.mlp = MoEFeedForward(cfg.hidden_size, cfg.intermediate_size,
+                                      cfg.num_experts, cfg.moe_top_k)
+        else:
+            self.mlp = nn.Sequential(
+                nn.Linear(cfg.hidden_size, cfg.intermediate_size),
+                nn.GELU(),
+                nn.Linear(cfg.intermediate_size, cfg.hidden_size))
+        self.dropout = nn.Dropout(cfg.dropout)
+
+    def forward(self, x, use_ring=False):
+        x = x + self.dropout(self.attn(self.ln1(x), use_ring=use_ring))
+        x = x + self.dropout(self.mlp(self.ln2(x)))
+        return x
+
+
+class GPTModel(nn.Layer):
+    def __init__(self, cfg: GPTConfig = None, **kwargs):
+        super().__init__()
+        cfg = cfg or GPTConfig(**kwargs)
+        self.config = cfg
+        init = I.Normal(0.0, cfg.initializer_range)
+        self.wte = nn.Embedding(cfg.vocab_size, cfg.hidden_size,
+                                weight_attr=init)
+        self.wpe = nn.Embedding(cfg.max_position_embeddings, cfg.hidden_size,
+                                weight_attr=init)
+        self.drop = nn.Dropout(cfg.dropout)
+        self.blocks = nn.LayerList([GPTBlock(cfg)
+                                    for _ in range(cfg.num_layers)])
+        self.ln_f = nn.LayerNorm(cfg.hidden_size)
+
+    def forward(self, input_ids, use_ring=False):
+        b, s = input_ids.shape
+        pos = creation.arange(0, s, dtype="int64")
+        pos = manipulation.reshape(pos, [1, s])
+        h = self.drop(self.wte(input_ids) + self.wpe(pos))
+        for blk in self.blocks:
+            h = blk(h, use_ring=use_ring)
+        return self.ln_f(h)
+
+
+class GPTForCausalLM(nn.Layer):
+    def __init__(self, cfg: GPTConfig = None, **kwargs):
+        super().__init__()
+        self.gpt = GPTModel(cfg, **kwargs)
+
+    def forward(self, input_ids, use_ring=False):
+        h = self.gpt(input_ids, use_ring=use_ring)
+        from ..ops.linalg import matmul
+        return matmul(h, self.gpt.wte.weight, transpose_y=True)
