@@ -1,0 +1,4 @@
+from .communicator import AsyncCommunicator, GeoCommunicator
+from .runtime import DistributedEmbedding, TheOnePSRuntime, the_one_ps
+from .service import PsClient, PsServer, TableConfig
+from .tables import DenseTable, SparseTable, native_available
