@@ -30,6 +30,15 @@ from . import vision  # noqa: E402
 from . import distributed  # noqa: E402
 from . import parallel  # noqa: E402
 from . import models  # noqa: E402
+from . import autograd  # noqa: E402
+from . import device  # noqa: E402
+from . import incubate  # noqa: E402
+from . import inference  # noqa: E402
+from . import onnx  # noqa: E402
+from . import profiler  # noqa: E402
+from . import quantization  # noqa: E402
+from . import text  # noqa: E402
+from . import utils  # noqa: E402
 from .distributed.parallel import DataParallel  # noqa: E402
 from .hapi.model import Model  # noqa: E402
 from .hapi.model_summary import summary  # noqa: E402

@@ -1,0 +1,64 @@
+"""paddle.autograd namespace (reference `python/paddle/autograd/`)."""
+from ..framework.autograd import backward, grad, is_grad_enabled, no_grad
+
+__all__ = ["backward", "grad", "no_grad", "is_grad_enabled", "PyLayer",
+           "PyLayerContext"]
+
+
+class PyLayerContext:
+    def __init__(self):
+        self._saved = ()
+        self.materialize_grads = True
+
+    def save_for_backward(self, *tensors):
+        self._saved = tensors
+
+    @property
+    def saved_tensor(self):
+        return self._saved
+
+    def saved_tensors(self):
+        return self._saved
+
+
+class PyLayerMeta(type):
+    pass
+
+
+class PyLayer(metaclass=PyLayerMeta):
+    """Custom autograd op (reference `autograd/py_layer.py`): user defines
+    static forward(ctx, *args) / backward(ctx, *grads); apply() records a
+    TapeNode whose pullback calls the user backward."""
+
+    @staticmethod
+    def forward(ctx, *args, **kwargs):
+        raise NotImplementedError
+
+    @staticmethod
+    def backward(ctx, *args):
+        raise NotImplementedError
+
+    @classmethod
+    def apply(cls, *args, **kwargs):
+        from ..framework.autograd import TapeNode, is_grad_enabled
+        from ..framework.tensor import Tensor
+        ctx = PyLayerContext()
+        out = cls.forward(ctx, *args, **kwargs)
+        single = not isinstance(out, (tuple, list))
+        outs = [out] if single else list(out)
+        in_tensors = [a for a in args
+                      if isinstance(a, Tensor) and not a.stop_gradient]
+        if is_grad_enabled() and in_tensors:
+            def vjp_fn(cots):
+                cots = cots if isinstance(cots, tuple) else (cots,)
+                grads = cls.backward(ctx, *[Tensor(c) for c in cots])
+                grads = grads if isinstance(grads, (tuple, list)) else \
+                    (grads,)
+                return [g._value if isinstance(g, Tensor) else g
+                        for g in grads]
+            for t in outs:
+                t.stop_gradient = False
+            node = TapeNode(cls.__name__, vjp_fn, in_tensors, outs)
+            for t in outs:
+                t._node = node
+        return out if single else tuple(outs)

@@ -1,0 +1,44 @@
+"""paddle.device namespace (reference `python/paddle/device.py`)."""
+from ..framework.place import (CPUPlace, CUDAPlace, TPUPlace, device_count,
+                               get_device, is_compiled_with_cuda,
+                               is_compiled_with_tpu, set_device)
+
+__all__ = ["set_device", "get_device", "CPUPlace", "CUDAPlace", "TPUPlace",
+           "device_count", "is_compiled_with_cuda", "is_compiled_with_tpu",
+           "cuda"]
+
+
+class cuda:
+    """Parity shim: paddle.device.cuda.* maps to the accelerator."""
+
+    @staticmethod
+    def device_count():
+        return device_count()
+
+    @staticmethod
+    def synchronize(device=None):
+        import jax
+        # XLA dataflow orders everything; an explicit fence:
+        jax.effects_barrier() if hasattr(jax, "effects_barrier") else None
+
+    @staticmethod
+    def empty_cache():
+        pass
+
+    @staticmethod
+    def max_memory_allocated(device=None):
+        import jax
+        try:
+            stats = jax.local_devices()[0].memory_stats()
+            return stats.get("peak_bytes_in_use", 0)
+        except Exception:
+            return 0
+
+    @staticmethod
+    def memory_allocated(device=None):
+        import jax
+        try:
+            stats = jax.local_devices()[0].memory_stats()
+            return stats.get("bytes_in_use", 0)
+        except Exception:
+            return 0

@@ -292,7 +292,22 @@ def apply_op(name: str, fn: Callable, args: Sequence[Any], kwargs: dict):
     return _wrap_outputs(name, out, vjp_fn, in_tensors)
 
 
+def _check_nan_inf(name, out):
+    """reference `framework/details/nan_inf_utils_detail.cc` — scan every
+    op output when FLAGS_check_nan_inf and abort naming the op."""
+    from .flags import flag
+    if not flag("FLAGS_check_nan_inf") or autograd.in_trace_mode():
+        return
+    for x in jax.tree_util.tree_leaves(out):
+        if hasattr(x, "dtype") and jnp.issubdtype(x.dtype, jnp.inexact):
+            if bool(jnp.any(~jnp.isfinite(x))):
+                raise FloatingPointError(
+                    f"Operator `{name}` output contains NaN/Inf "
+                    f"(FLAGS_check_nan_inf is enabled)")
+
+
 def _wrap_outputs(name, out, vjp_fn, in_tensors):
+    _check_nan_inf(name, out)
     single = not isinstance(out, (tuple, list))
     flat = [out] if single else list(out)
     sg = vjp_fn is None

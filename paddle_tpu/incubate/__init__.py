@@ -1,0 +1,11 @@
+"""paddle.incubate (reference `python/paddle/incubate/`): LookAhead,
+ModelAverage, GradientMerge, auto-checkpoint."""
+from . import autotune  # noqa: F401
+from .checkpoint import (AutoCheckpointChecker, TrainEpochRange,
+                         train_epoch_range)
+from .optimizers import (GradientMergeOptimizer, LookAhead, LookaheadOptimizer,
+                         ModelAverage, RecomputeOptimizer)
+
+__all__ = ["LookAhead", "LookaheadOptimizer", "ModelAverage",
+           "GradientMergeOptimizer", "RecomputeOptimizer",
+           "TrainEpochRange", "train_epoch_range", "AutoCheckpointChecker"]

@@ -1,0 +1,13 @@
+"""paddle.incubate.autotune (parity shim — XLA autotunes its own tilings;
+exposed so reference code calling set_config keeps working)."""
+from __future__ import annotations
+
+__all__ = ["set_config"]
+
+_config = {}
+
+
+def set_config(config=None):
+    if config:
+        _config.update(config)
+    return dict(_config)

@@ -1,0 +1,160 @@
+"""Wrapper optimizers (reference `fluid/optimizer.py`:
+LookaheadOptimizer:5230, GradientMergeOptimizer:5402,
+RecomputeOptimizer:4549; `incubate/optimizer/modelaverage.py`)."""
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..framework.tensor import Tensor
+from ..optimizer.optimizer import Optimizer
+
+__all__ = ["LookAhead", "LookaheadOptimizer", "ModelAverage",
+           "GradientMergeOptimizer", "RecomputeOptimizer"]
+
+
+class LookAhead(Optimizer):
+    """slow weights track fast weights every k steps."""
+
+    def __init__(self, inner_optimizer, alpha=0.5, k=5, name=None):
+        self.inner = inner_optimizer
+        self.alpha = alpha
+        self.k = k
+        self._slow = {}
+        self._steps = 0
+
+    def __getattr__(self, name):
+        return getattr(self.inner, name)
+
+    def step(self):
+        self.inner.step()
+        self._steps += 1
+        if self._steps % self.k:
+            return
+        for p in (self.inner._parameter_list or []):
+            key = id(p)
+            if key not in self._slow:
+                self._slow[key] = p._value
+            slow = self._slow[key] + self.alpha * (p._value -
+                                                   self._slow[key])
+            self._slow[key] = slow
+            p._value = slow
+
+    def minimize(self, loss, **kw):
+        loss.backward()
+        self.step()
+        return [], []
+
+    def clear_grad(self):
+        self.inner.clear_grad()
+
+
+LookaheadOptimizer = LookAhead
+
+
+class ModelAverage(Optimizer):
+    """EMA of parameters with apply/restore (reference
+    `incubate/optimizer/modelaverage.py`)."""
+
+    def __init__(self, average_window_rate=0.15, parameters=None,
+                 min_average_window=10000, max_average_window=10000,
+                 name=None):
+        super().__init__(0.0, parameters)
+        self._sums = {}
+        self._counts = {}
+        self._backup = {}
+
+    def step(self):
+        for p in (self._parameter_list or []):
+            k = id(p)
+            self._sums[k] = self._sums.get(k, 0) + p._value
+            self._counts[k] = self._counts.get(k, 0) + 1
+
+    def apply(self, executor=None, need_restore=True):
+        import contextlib
+
+        @contextlib.contextmanager
+        def ctx():
+            for p in (self._parameter_list or []):
+                k = id(p)
+                if k in self._sums and self._counts.get(k):
+                    self._backup[k] = p._value
+                    p._value = self._sums[k] / self._counts[k]
+            try:
+                yield
+            finally:
+                if need_restore:
+                    self.restore()
+        return ctx()
+
+    def restore(self, executor=None):
+        for p in (self._parameter_list or []):
+            k = id(p)
+            if k in self._backup:
+                p._value = self._backup.pop(k)
+
+
+class GradientMergeOptimizer:
+    """Accumulate grads for k steps, then apply (reference
+    `fluid/optimizer.py:5402` + gradient_merge meta-optimizer)."""
+
+    def __init__(self, inner_optimizer, k_steps=1, avg=True):
+        self.inner = inner_optimizer
+        self.k_steps = k_steps
+        self.avg = avg
+        self._acc = {}
+        self._count = 0
+
+    def __getattr__(self, name):
+        return getattr(self.inner, name)
+
+    def step(self):
+        self._count += 1
+        for p in (self.inner._parameter_list or []):
+            if p._grad is None:
+                continue
+            k = id(p)
+            self._acc[k] = self._acc.get(k, 0) + p._grad
+            p._grad = None
+        if self._count < self.k_steps:
+            return
+        for p in (self.inner._parameter_list or []):
+            k = id(p)
+            if k in self._acc:
+                g = self._acc[k]
+                p._grad = g / self.k_steps if self.avg else g
+        self.inner.step()
+        self.inner.clear_grad()
+        self._acc = {}
+        self._count = 0
+
+    def minimize(self, loss, **kw):
+        loss.backward()
+        self.step()
+        return [], []
+
+    def clear_grad(self):
+        self.inner.clear_grad()
+
+
+class RecomputeOptimizer:
+    """reference `fluid/optimizer.py:4549`. In this framework recompute is
+    a jit-level policy (jax.checkpoint in the SPMD step builder /
+    strategy.recompute); this wrapper exists for API compat and simply
+    forwards — eager mode has no stored activations to drop because the
+    tape stores vjp residuals XLA chose."""
+
+    def __init__(self, optimizer):
+        self.inner = optimizer
+
+    def __getattr__(self, name):
+        return getattr(self.inner, name)
+
+    def _set_checkpoints(self, checkpoints):
+        self._checkpoints = checkpoints
+
+    def step(self):
+        self.inner.step()
+
+    def minimize(self, loss, **kw):
+        return self.inner.minimize(loss, **kw)

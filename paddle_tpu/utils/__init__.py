@@ -1,0 +1,30 @@
+"""paddle.utils (reference `python/paddle/utils/`)."""
+from . import download, unique_name
+from .download import get_weights_path_from_url
+from .lazy_import import try_import
+
+__all__ = ["download", "get_weights_path_from_url", "try_import",
+           "unique_name", "deprecated", "run_check"]
+
+
+def deprecated(update_to="", since="", reason=""):
+    def deco(fn):
+        import functools
+        import warnings
+
+        @functools.wraps(fn)
+        def wrapper(*a, **k):
+            warnings.warn(f"{fn.__name__} is deprecated since {since}: "
+                          f"{reason}; use {update_to}", DeprecationWarning)
+            return fn(*a, **k)
+        return wrapper
+    return deco
+
+
+def run_check():
+    import jax
+    import paddle_tpu as paddle
+    x = paddle.ones([2, 2])
+    y = (x @ x).sum()
+    assert float(y) == 8.0
+    print(f"paddle_tpu is installed successfully! devices: {jax.devices()}")

@@ -1,0 +1,35 @@
+"""Pretrained weight fetch (reference `python/paddle/utils/download.py`).
+This image has zero egress: resolves from a local cache dir
+(~/.cache/paddle_tpu or $PADDLE_TPU_WEIGHTS_DIR) and raises with guidance
+when the file is absent instead of downloading."""
+from __future__ import annotations
+
+import os
+import os.path as osp
+
+__all__ = ["get_weights_path_from_url", "get_path_from_url", "WEIGHTS_HOME"]
+
+WEIGHTS_HOME = os.environ.get(
+    "PADDLE_TPU_WEIGHTS_DIR",
+    osp.expanduser("~/.cache/paddle_tpu/weights"))
+
+
+def get_weights_path_from_url(url: str, md5sum=None) -> str:
+    fname = osp.basename(url.split("?")[0])
+    local = osp.join(WEIGHTS_HOME, fname)
+    if osp.exists(local):
+        return local
+    raise FileNotFoundError(
+        f"pretrained weights {fname} not found in {WEIGHTS_HOME} and this "
+        f"environment has no network egress. Place the file there manually "
+        f"(source url: {url}).")
+
+
+def get_path_from_url(url, root_dir=None, md5sum=None, check_exist=True,
+                      decompress=True):
+    root_dir = root_dir or WEIGHTS_HOME
+    local = osp.join(root_dir, osp.basename(url.split("?")[0]))
+    if osp.exists(local):
+        return local
+    raise FileNotFoundError(f"{local} missing; no network egress "
+                            f"(source url: {url})")

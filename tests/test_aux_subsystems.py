@@ -1,0 +1,162 @@
+"""Aux subsystems: profiler, NaN check, auto-checkpoint, PyLayer,
+quantization, inference predictor, text datasets, incubate optimizers."""
+import os
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+
+
+def test_profiler_records_and_exports(tmp_path):
+    from paddle_tpu.profiler import (RecordEvent, export_chrome_tracing,
+                                     start_profiler, stop_profiler)
+    start_profiler()
+    with RecordEvent("my_op"):
+        paddle.ones([4]).sum()
+    rows = stop_profiler()
+    assert any(name == "my_op" for name, _ in rows)
+    start_profiler()
+    with RecordEvent("x"):
+        pass
+    p = str(tmp_path / "trace.json")
+    export_chrome_tracing(p)
+    assert os.path.exists(p)
+
+
+def test_nan_check_flag():
+    paddle.set_flags({"FLAGS_check_nan_inf": True})
+    try:
+        x = paddle.to_tensor([1.0, 0.0])
+        with pytest.raises(FloatingPointError):
+            paddle.log(x * 0 - 1.0)  # log(-1) = nan
+    finally:
+        paddle.set_flags({"FLAGS_check_nan_inf": False})
+
+
+def test_pylayer_custom_grad():
+    from paddle_tpu.autograd import PyLayer
+
+    class Double(PyLayer):
+        @staticmethod
+        def forward(ctx, x):
+            ctx.save_for_backward(x)
+            return x * 2
+
+        @staticmethod
+        def backward(ctx, g):
+            return g * 2
+
+    x = paddle.to_tensor([3.0], stop_gradient=False)
+    y = Double.apply(x)
+    y.backward()
+    np.testing.assert_allclose(x.grad.numpy(), [2.0])
+
+
+def test_auto_checkpoint_resume(tmp_path):
+    os.environ["PADDLE_CHECKPOINT_PATH"] = str(tmp_path)
+    os.environ["PADDLE_JOB_ID"] = "job1"
+    from paddle_tpu.incubate import TrainEpochRange
+    net = nn.Linear(2, 2)
+    r = TrainEpochRange(3, "t1").add(net)
+    seen = []
+    for e in r:
+        seen.append(e)
+        net.weight.set_value(np.full((2, 2), float(e), np.float32))
+    assert seen == [0, 1, 2]
+    # "restart": new range resumes past the end (no epochs to run)
+    net2 = nn.Linear(2, 2)
+    r2 = TrainEpochRange(3, "t1").add(net2)
+    assert r2.get() == 3
+    np.testing.assert_allclose(net2.weight.numpy(), 2.0)
+
+
+def test_quantization_qat_forward_backward():
+    from paddle_tpu.quantization import ImperativeQuantAware
+    net = nn.Sequential(nn.Linear(4, 8), nn.ReLU(), nn.Linear(8, 2))
+    ImperativeQuantAware().quantize(net)
+    x = paddle.randn([4, 4])
+    out = net(x)
+    out.sum().backward()
+    assert out.shape == [4, 2]
+    # fake-quant must round to the int grid
+    w = net[0].inner.weight
+    assert w.grad is not None
+
+
+def test_inference_predictor_roundtrip(tmp_path):
+    from paddle_tpu.inference import Config, create_predictor
+    from paddle_tpu.static import InputSpec
+
+    net = nn.Sequential(nn.Linear(4, 3), nn.Tanh())
+    path = str(tmp_path / "m")
+    paddle.jit.save(net, path, input_spec=[InputSpec([2, 4], "float32")])
+    pred = create_predictor(Config(path))
+    x = np.random.rand(2, 4).astype("float32")
+    (out,) = pred.run([x])
+    ref = net(paddle.to_tensor(x)).numpy()
+    np.testing.assert_allclose(out, ref, rtol=1e-5)
+
+
+def test_text_datasets():
+    from paddle_tpu.text import Imdb, UCIHousing, WMT14
+    ds = Imdb(mode="train")
+    doc, label = ds[0]
+    assert doc.dtype == np.int64
+    h = UCIHousing(mode="test")
+    x, y = h[0]
+    assert x.shape == (13,) and y.shape == (1,)
+    mt = WMT14(mode="train")
+    src, tin, tout = mt[0]
+    assert len(tin) == len(tout)
+
+
+def test_viterbi_decode():
+    from paddle_tpu.text import viterbi_decode
+    pot = paddle.to_tensor(np.random.RandomState(0).rand(2, 5, 3)
+                           .astype("float32"))
+    trans = paddle.to_tensor(np.random.RandomState(1).rand(3, 3)
+                             .astype("float32"))
+    score, path = viterbi_decode(pot, trans)
+    assert path.shape == [2, 5]
+    assert score.shape == [2]
+
+
+def test_gradient_merge_optimizer():
+    from paddle_tpu.incubate import GradientMergeOptimizer
+    net = nn.Linear(2, 2)
+    inner = paddle.optimizer.SGD(0.1, parameters=net.parameters())
+    gm = GradientMergeOptimizer(inner, k_steps=2)
+    w0 = net.weight.numpy().copy()
+    x = paddle.ones([1, 2])
+    (net(x).sum()).backward()
+    gm.step()
+    np.testing.assert_allclose(net.weight.numpy(), w0)  # not applied yet
+    (net(x).sum()).backward()
+    gm.step()
+    assert not np.allclose(net.weight.numpy(), w0)  # applied
+
+
+def test_lookahead():
+    from paddle_tpu.incubate import LookAhead
+    net = nn.Linear(2, 2)
+    inner = paddle.optimizer.SGD(0.1, parameters=net.parameters())
+    la = LookAhead(inner, alpha=0.5, k=2)
+    x = paddle.ones([1, 2])
+    for _ in range(4):
+        (net(x).sum()).backward()
+        la.step()
+        la.clear_grad()
+    assert np.isfinite(net.weight.numpy()).all()
+
+
+def test_device_namespace():
+    assert paddle.device.get_device() in ("cpu",) or ":" in \
+        paddle.device.get_device()
+    assert paddle.device.cuda.device_count() >= 1
+
+
+def test_utils_run_check(capsys):
+    paddle.utils.run_check()
+    assert "successfully" in capsys.readouterr().out
