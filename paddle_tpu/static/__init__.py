@@ -6,8 +6,9 @@ from .program import (Executor, Program, Variable, append_backward, data,
                       disable_static, enable_static, global_scope,
                       in_static_mode, program_guard, scope_guard)
 
-# nn re-exports used by static-style model code
-from .. import nn  # noqa: F401
+# static layer API (paddle.static.nn)
+from . import nn  # noqa: F401
+from .nn import cond, while_loop  # noqa: F401
 
 
 def save(program, model_path, **kwargs):

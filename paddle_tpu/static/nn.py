@@ -1,0 +1,176 @@
+"""paddle.static.nn — static-graph layer API (reference
+`python/paddle/static/nn/` re-exporting `fluid/layers/nn.py` fc/conv2d/…).
+Each builds the same Layers the dygraph API uses; in static mode their ops
+record into the current Program."""
+from __future__ import annotations
+
+from .. import nn as _nn
+from ..nn import functional as F
+
+__all__ = ["fc", "conv2d", "conv3d", "batch_norm", "embedding", "dropout",
+           "layer_norm", "conv2d_transpose", "cond", "while_loop",
+           "switch_case", "case"]
+
+
+def fc(x, size, num_flatten_dims=1, weight_attr=None, bias_attr=None,
+       activation=None, name=None):
+    from ..ops.manipulation import flatten, reshape
+    inp = x
+    if num_flatten_dims > 1 or len(x.shape) > 2:
+        inp = flatten(x, num_flatten_dims, -1) if num_flatten_dims >= 1 \
+            else x
+    layer = _nn.Linear(inp.shape[-1], size, weight_attr=weight_attr,
+                       bias_attr=bias_attr)
+    out = layer(inp)
+    if activation:
+        out = getattr(F, activation)(out)
+    return out
+
+
+def conv2d(input, num_filters, filter_size, stride=1, padding=0, dilation=1,
+           groups=1, param_attr=None, bias_attr=None, act=None,
+           data_format="NCHW", name=None, use_cudnn=True):
+    ch_axis = 1 if data_format == "NCHW" else -1
+    layer = _nn.Conv2D(input.shape[ch_axis], num_filters, filter_size,
+                       stride, padding, dilation, groups,
+                       weight_attr=param_attr, bias_attr=bias_attr,
+                       data_format=data_format)
+    out = layer(input)
+    if act:
+        out = getattr(F, act)(out)
+    return out
+
+
+def conv3d(input, num_filters, filter_size, stride=1, padding=0, dilation=1,
+           groups=1, param_attr=None, bias_attr=None, act=None,
+           data_format="NCDHW", name=None):
+    layer = _nn.Conv3D(input.shape[1], num_filters, filter_size, stride,
+                       padding, dilation, groups, weight_attr=param_attr,
+                       bias_attr=bias_attr, data_format=data_format)
+    out = layer(input)
+    if act:
+        out = getattr(F, act)(out)
+    return out
+
+
+def conv2d_transpose(input, num_filters, output_size=None, filter_size=None,
+                     stride=1, padding=0, dilation=1, groups=1,
+                     param_attr=None, bias_attr=None, act=None,
+                     data_format="NCHW", name=None):
+    layer = _nn.Conv2DTranspose(input.shape[1], num_filters, filter_size,
+                                stride, padding, dilation=dilation,
+                                groups=groups, weight_attr=param_attr,
+                                bias_attr=bias_attr,
+                                data_format=data_format)
+    out = layer(input)
+    if act:
+        out = getattr(F, act)(out)
+    return out
+
+
+def batch_norm(input, act=None, momentum=0.9, epsilon=1e-5,
+               param_attr=None, bias_attr=None, data_layout="NCHW",
+               is_test=False, name=None, **kwargs):
+    ch = input.shape[1 if data_layout == "NCHW" else -1]
+    layer = _nn.BatchNorm(ch, act=act, momentum=momentum, epsilon=epsilon,
+                          param_attr=param_attr, bias_attr=bias_attr,
+                          data_layout=data_layout)
+    if is_test:
+        layer.eval()
+    return layer(input)
+
+
+def layer_norm(input, scale=True, shift=True, begin_norm_axis=1,
+               epsilon=1e-5, param_attr=None, bias_attr=None, act=None,
+               name=None):
+    shape = input.shape[begin_norm_axis:]
+    layer = _nn.LayerNorm(shape, epsilon=epsilon,
+                          weight_attr=param_attr if scale else False,
+                          bias_attr=bias_attr if shift else False)
+    out = layer(input)
+    if act:
+        out = getattr(F, act)(out)
+    return out
+
+
+def embedding(input, size, is_sparse=False, padding_idx=None,
+              param_attr=None, dtype="float32", name=None):
+    layer = _nn.Embedding(size[0], size[1], padding_idx=padding_idx,
+                          sparse=is_sparse, weight_attr=param_attr)
+    return layer(input)
+
+
+def dropout(x, dropout_prob=0.5, is_test=False, name=None, **kwargs):
+    return F.dropout(x, dropout_prob, training=not is_test)
+
+
+# ---------------------------------------------------------------------------
+# control flow (reference `fluid/layers/control_flow.py` cond/While →
+# conditional_block_op / while_op). TPU-native: lax.cond / lax.while_loop —
+# the same restriction the reference's AST transformer enforces (both
+# branches traced; carried shapes static).
+# ---------------------------------------------------------------------------
+
+def cond(pred, true_fn=None, false_fn=None, name=None):
+    import jax
+    from ..framework.functional import tree_unwrap, tree_wrap
+    from ..framework.tensor import Tensor, apply_op
+
+    from ..framework.autograd import trace_mode
+
+    def impl(p):
+        def tf(_):
+            with trace_mode():
+                return tree_unwrap(true_fn())
+
+        def ff(_):
+            with trace_mode():
+                return tree_unwrap(false_fn())
+        return jax.lax.cond(p, tf, ff, operand=None)
+    return apply_op("cond", impl, (pred,), {})
+
+
+def while_loop(cond_fn, body_fn, loop_vars, is_test=False, name=None):
+    import jax
+    from ..framework.functional import tree_unwrap, tree_wrap
+    from ..framework.tensor import Tensor
+
+    from ..framework.autograd import trace_mode
+
+    raw = tree_unwrap(loop_vars)
+
+    def c(state):
+        with trace_mode():
+            out = cond_fn(*tree_wrap(state))
+        return out._value if isinstance(out, Tensor) else out
+
+    def b(state):
+        with trace_mode():
+            out = body_fn(*tree_wrap(state))
+        return tree_unwrap(out)
+
+    out = jax.lax.while_loop(c, b, tuple(raw))
+    return tree_wrap(out)
+
+
+def case(pred_fn_pairs, default=None, name=None):
+    for pred, fn in pred_fn_pairs:
+        if bool(pred):
+            return fn()
+    return default() if default else None
+
+
+def switch_case(branch_index, branch_fns, default=None, name=None):
+    import jax
+    from ..framework.functional import tree_unwrap
+    from ..framework.tensor import apply_op
+    fns = branch_fns
+    if isinstance(branch_fns, dict):
+        fns = [branch_fns[k] for k in sorted(branch_fns)]
+    elif fns and isinstance(fns[0], tuple):
+        fns = [f for _, f in sorted(fns)]
+
+    def impl(idx):
+        return jax.lax.switch(idx, [lambda _, f=f: tree_unwrap(f())
+                                    for f in fns], None)
+    return apply_op("switch_case", impl, (branch_index,), {})

@@ -1,0 +1,183 @@
+"""Custom C++ op extension (reference `paddle/fluid/extension/` +
+`framework/custom_operator.cc` PD_BUILD_OP dlopen loading).
+
+TPU-native: a custom op is a C function with a flat numpy ABI
+  void op(const float** inputs, const long** shapes, const int* ndims,
+          int n_inputs, float* output, const long* out_shape, int out_ndim)
+compiled with g++ and bound via ctypes. It enters the framework as a
+host-callback op (jax.pure_callback): jittable, with the computation
+running host-side — the honest TPU analogue of a CPU custom kernel. An
+optional `grad_source` provides the custom VJP the same way.
+"""
+from __future__ import annotations
+
+import ctypes
+import hashlib
+import os
+import subprocess
+import tempfile
+from typing import Callable, Optional, Sequence
+
+import numpy as np
+
+__all__ = ["load", "CppExtension", "CustomOp", "load_op_from_callable"]
+
+_TEMPLATE_HELP = """
+expected exported symbol signature (extern "C"):
+  void {name}(const float** ins, const long long** shapes,
+              const int* ndims, int n_in,
+              float* out, const long long* out_shape, int out_ndim);
+"""
+
+
+class CustomOp:
+    def __init__(self, name: str, fwd: Callable, out_shape_fn: Callable,
+                 bwd: Optional[Callable] = None):
+        self.name = name
+        self._fwd = fwd
+        self._out_shape_fn = out_shape_fn
+        self._bwd = bwd
+
+    def __call__(self, *tensors):
+        import jax
+        import jax.numpy as jnp
+        from ..framework.tensor import Tensor, apply_op
+
+        out_shape = self._out_shape_fn(
+            *[tuple(t.shape) for t in tensors])
+        sds = jax.ShapeDtypeStruct(tuple(out_shape), jnp.float32)
+        fwd = self._fwd
+        bwd = self._bwd
+
+        def host_fwd(*arrays):
+            return fwd(*[np.asarray(a, np.float32) for a in arrays])
+
+        if bwd is None:
+            def impl(*vals):
+                return jax.pure_callback(host_fwd, sds, *vals)
+            return apply_op(self.name, impl, tensors, {})
+
+        @jax.custom_vjp
+        def op(*vals):
+            return jax.pure_callback(host_fwd, sds, *vals)
+
+        def op_fwd(*vals):
+            return op(*vals), vals
+
+        def op_bwd(res, g):
+            shapes = [jax.ShapeDtypeStruct(v.shape, jnp.float32)
+                      for v in res]
+
+            def host_bwd(g_, *vals):
+                outs = bwd(np.asarray(g_, np.float32),
+                           *[np.asarray(v, np.float32) for v in vals])
+                return tuple(np.asarray(o, np.float32) for o in outs)
+            return jax.pure_callback(host_bwd, tuple(shapes), g, *res)
+
+        op.defvjp(op_fwd, op_bwd)
+
+        def impl(*vals):
+            return op(*vals)
+        return apply_op(self.name, impl, tensors, {})
+
+
+def load_op_from_callable(name, fwd, out_shape_fn, bwd=None):
+    """Register a python/numpy callable as a framework op (host callback)."""
+    return CustomOp(name, fwd, out_shape_fn, bwd)
+
+
+def _compile(sources: Sequence[str], extra_cxx_flags=()) -> str:
+    key = hashlib.sha1()
+    srcs = []
+    for s in sources:
+        with open(s, "rb") as f:
+            data = f.read()
+        key.update(data)
+        srcs.append(s)
+    build_dir = os.path.join(tempfile.gettempdir(), "paddle_tpu_ext")
+    os.makedirs(build_dir, exist_ok=True)
+    so = os.path.join(build_dir, f"ext_{key.hexdigest()[:16]}.so")
+    if not os.path.exists(so):
+        cmd = ["g++", "-O2", "-fPIC", "-shared", "-std=c++17", "-o", so,
+               *srcs, *extra_cxx_flags]
+        r = subprocess.run(cmd, capture_output=True, text=True)
+        if r.returncode != 0:
+            raise RuntimeError(f"custom op build failed:\n{r.stderr}\n"
+                               f"{_TEMPLATE_HELP}")
+    return so
+
+
+def load(name: str, sources: Sequence[str], out_shape_fn: Callable = None,
+         grad_symbol: Optional[str] = None, extra_cxx_flags=(),
+         verbose=False) -> CustomOp:
+    """Compile + load a custom C++ op (reference
+    `utils/cpp_extension.load`). `name` is the exported symbol."""
+    so = _compile(sources, extra_cxx_flags)
+    lib = ctypes.CDLL(so)
+    sym = getattr(lib, name)
+    sym.argtypes = [
+        ctypes.POINTER(ctypes.POINTER(ctypes.c_float)),
+        ctypes.POINTER(ctypes.POINTER(ctypes.c_longlong)),
+        ctypes.POINTER(ctypes.c_int), ctypes.c_int,
+        ctypes.POINTER(ctypes.c_float),
+        ctypes.POINTER(ctypes.c_longlong), ctypes.c_int,
+    ]
+    out_shape_fn = out_shape_fn or (lambda *shapes: shapes[0])
+
+    def fwd(*arrays):
+        arrays = [np.ascontiguousarray(a, np.float32) for a in arrays]
+        n = len(arrays)
+        ins = (ctypes.POINTER(ctypes.c_float) * n)(
+            *[a.ctypes.data_as(ctypes.POINTER(ctypes.c_float))
+              for a in arrays])
+        shape_arrs = [np.asarray(a.shape, np.longlong) for a in arrays]
+        shapes = (ctypes.POINTER(ctypes.c_longlong) * n)(
+            *[s.ctypes.data_as(ctypes.POINTER(ctypes.c_longlong))
+              for s in shape_arrs])
+        ndims = (ctypes.c_int * n)(*[a.ndim for a in arrays])
+        oshape = tuple(out_shape_fn(*[tuple(a.shape) for a in arrays]))
+        out = np.empty(oshape, np.float32)
+        oshape_arr = np.asarray(oshape, np.longlong)
+        sym(ins, shapes, ndims, n,
+            out.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+            oshape_arr.ctypes.data_as(ctypes.POINTER(ctypes.c_longlong)),
+            out.ndim)
+        return out
+
+    bwd = None
+    if grad_symbol:
+        gsym = getattr(lib, grad_symbol)
+        gsym.argtypes = sym.argtypes
+
+        def bwd(g, *arrays):  # noqa: F811
+            # grad symbol computes d/d(input0) only in this simple ABI;
+            # it receives [g, *forward_inputs]
+            full = [g] + list(arrays)
+            arrays2 = [np.ascontiguousarray(a, np.float32) for a in full]
+            n = len(arrays2)
+            ins = (ctypes.POINTER(ctypes.c_float) * n)(
+                *[a.ctypes.data_as(ctypes.POINTER(ctypes.c_float))
+                  for a in arrays2])
+            shape_arrs = [np.asarray(a.shape, np.longlong)
+                          for a in arrays2]
+            shapes = (ctypes.POINTER(ctypes.c_longlong) * n)(
+                *[s.ctypes.data_as(ctypes.POINTER(ctypes.c_longlong))
+                  for s in shape_arrs])
+            ndims = (ctypes.c_int * n)(*[a.ndim for a in arrays2])
+            out = np.empty(arrays[0].shape, np.float32)
+            oshape_arr = np.asarray(arrays[0].shape, np.longlong)
+            gsym(ins, shapes, ndims, n,
+                 out.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+                 oshape_arr.ctypes.data_as(
+                     ctypes.POINTER(ctypes.c_longlong)),
+                 out.ndim)
+            return (out,) + tuple(np.zeros_like(a) for a in arrays[1:])
+    return CustomOp(name, fwd, out_shape_fn, bwd)
+
+
+class CppExtension:
+    """setuptools-style descriptor (API parity)."""
+
+    def __init__(self, sources, **kwargs):
+        self.sources = sources
+        self.kwargs = kwargs

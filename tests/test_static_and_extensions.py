@@ -1,0 +1,117 @@
+"""static.nn layers, control flow, data feed pipeline, custom C++ op."""
+import os
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+
+
+def test_static_graph_mnist_style_training():
+    import paddle_tpu.static as static
+    paddle.enable_static()
+    try:
+        main, startup = static.Program(), static.Program()
+        with static.program_guard(main, startup):
+            x = static.data("x", [None, 8], "float32")
+            y = static.data("y", [None, 1], "float32")
+            h = static.nn.fc(x, 16, activation="relu")
+            pred = static.nn.fc(h, 1)
+            loss = paddle.mean((pred - y) ** 2)
+            opt = paddle.optimizer.Adam(0.01)
+            opt.minimize(loss)
+        exe = static.Executor()
+        exe.run(startup)
+        rng = np.random.RandomState(0)
+        xv = rng.rand(32, 8).astype("float32")
+        yv = (xv.sum(1, keepdims=True) / 4).astype("float32")
+        losses = []
+        for _ in range(30):
+            lv, = exe.run(main, feed={"x": xv, "y": yv}, fetch_list=[loss])
+            losses.append(float(lv))
+        assert losses[-1] < losses[0] * 0.5, losses[::10]
+    finally:
+        paddle.disable_static()
+
+
+def test_static_cond_while():
+    from paddle_tpu.static.nn import cond, while_loop
+    x = paddle.to_tensor(3.0)
+    out = cond(x > 2, lambda: x * 2, lambda: x - 1)
+    assert float(out) == 6.0
+
+    i = paddle.to_tensor(0)
+    s = paddle.to_tensor(0.0)
+    i2, s2 = while_loop(lambda i, s: i < 5,
+                        lambda i, s: (i + 1, s + 2.0), (i, s))
+    assert int(i2) == 5 and float(s2) == 10.0
+
+
+def test_inmemory_dataset_pipeline(tmp_path):
+    from paddle_tpu.distributed.fleet.dataset import (InMemoryDataset,
+                                                      MultiSlotDataGenerator)
+
+    class Gen(MultiSlotDataGenerator):
+        def generate_sample(self, line):
+            toks = line.split()
+            ids = [int(t) for t in toks[:-1]]
+            label = [float(toks[-1])]
+            yield [("ids", ids), ("label", label)]
+
+    raw = tmp_path / "raw.txt"
+    raw.write_text("1 2 3 0.5\n4 5 1.5\n6 7 8 9 2.5\n")
+    slot_file = str(tmp_path / "slots.txt")
+    Gen().run_from_files([str(raw)], slot_file)
+
+    ds = InMemoryDataset()
+    ds.init(batch_size=2, use_var=[("ids", "int64"), ("label", "float32")])
+    ds.set_filelist([slot_file])
+    ds.load_into_memory()
+    assert ds.get_memory_data_size() == 3
+    ds.local_shuffle()
+    batches = list(ds)
+    assert len(batches) == 2
+    ids, label = batches[0]
+    assert ids.dtype == np.int64 and label.dtype == np.float32
+    assert label.shape[1] == 1
+
+
+def test_custom_cpp_op(tmp_path):
+    src = tmp_path / "myop.cc"
+    src.write_text(r"""
+extern "C" void double_op(const float** ins, const long long** shapes,
+                          const int* ndims, int n_in, float* out,
+                          const long long* out_shape, int out_ndim) {
+  long long total = 1;
+  for (int i = 0; i < out_ndim; ++i) total *= out_shape[i];
+  for (long long i = 0; i < total; ++i) out[i] = ins[0][i] * 2.0f;
+}
+extern "C" void double_op_grad(const float** ins, const long long** shapes,
+                               const int* ndims, int n_in, float* out,
+                               const long long* out_shape, int out_ndim) {
+  long long total = 1;
+  for (int i = 0; i < out_ndim; ++i) total *= out_shape[i];
+  for (long long i = 0; i < total; ++i) out[i] = ins[0][i] * 2.0f;
+}
+""")
+    from paddle_tpu.utils import cpp_extension
+    op = cpp_extension.load("double_op", [str(src)],
+                            grad_symbol="double_op_grad")
+    x = paddle.to_tensor([1.0, 2.0, 3.0], stop_gradient=False)
+    y = op(x)
+    np.testing.assert_allclose(y.numpy(), [2, 4, 6])
+    y.sum().backward()
+    np.testing.assert_allclose(x.grad.numpy(), [2, 2, 2])
+
+
+def test_custom_python_op():
+    from paddle_tpu.utils.cpp_extension import load_op_from_callable
+    op = load_op_from_callable(
+        "sq", lambda a: a ** 2, lambda s: s,
+        bwd=lambda g, a: (2 * a * g,))
+    x = paddle.to_tensor([2.0, 3.0], stop_gradient=False)
+    y = op(x)
+    np.testing.assert_allclose(y.numpy(), [4, 9])
+    y.sum().backward()
+    np.testing.assert_allclose(x.grad.numpy(), [4, 6])
