@@ -1,4 +1,7 @@
+from . import dataset, metrics
+from .dataset import InMemoryDataset, MultiSlotDataGenerator, QueueDataset
 from .fleet_base import Fleet, fleet
+from .http_server import KVClient, KVServer
 from .role_maker import PaddleCloudRoleMaker, Role, RoleMakerBase, UserDefinedRoleMaker
 from .strategy import DistributedStrategy
 from .utils import HDFSClient, LocalFS, UtilBase

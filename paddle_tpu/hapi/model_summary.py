@@ -3,7 +3,48 @@ from __future__ import annotations
 
 import numpy as np
 
-__all__ = ["summary"]
+__all__ = ["summary", "flops"]
+
+
+def flops(net, input_size, custom_ops=None, print_detail=False):
+    """FLOPs estimate per layer type (reference `hapi/dynamic_flops.py`)."""
+    total = [0]
+    hooks = []
+
+    def conv_hook(layer, inputs, output):
+        x = inputs[0]
+        k = int(np.prod(layer._kernel_size))
+        cin = layer._in_channels // layer._groups
+        out_elems = int(np.prod(output.shape))
+        total[0] += 2 * out_elems * cin * k
+
+    def linear_hook(layer, inputs, output):
+        total[0] += 2 * int(np.prod(output.shape)) * layer._in_features
+
+    for layer in net.sublayers(include_self=True):
+        tn = type(layer).__name__
+        if tn in ("Conv2D", "Conv1D", "Conv3D"):
+            hooks.append(layer.register_forward_post_hook(conv_hook))
+        elif tn == "Linear":
+            hooks.append(layer.register_forward_post_hook(linear_hook))
+        elif custom_ops and tn in custom_ops:
+            fn = custom_ops[tn]
+            hooks.append(layer.register_forward_post_hook(
+                lambda l, i, o, fn=fn: total.__setitem__(
+                    0, total[0] + fn(l, i, o))))
+    from ..framework.tensor import Tensor
+    import jax.numpy as jnp
+    x = Tensor(jnp.zeros(tuple(input_size), "float32"))
+    was_training = net.training
+    net.eval()
+    net(x)
+    if was_training:
+        net.train()
+    for h in hooks:
+        h.remove()
+    if print_detail:
+        print(f"Total FLOPs: {total[0]:,}")
+    return total[0]
 
 
 def summary(net, input_size=None, dtypes=None, input=None):

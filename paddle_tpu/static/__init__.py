@@ -11,6 +11,53 @@ from . import nn  # noqa: F401
 from .nn import cond, while_loop  # noqa: F401
 
 
+def save_inference_model(path_prefix, feed_vars, fetch_vars, executor,
+                         program=None, **kwargs):
+    """reference `fluid/io.py:1199` save_inference_model — exports the
+    pruned feed→fetch computation as the StableHLO serving artifact
+    (.pdmodel) + weights (.pdiparams), loadable by inference.Predictor."""
+    import pickle
+
+    import jax
+    import numpy as np
+
+    from .program import _Lowered, default_main_program, global_scope
+    program = program or default_main_program()
+    feed_vars = feed_vars if isinstance(feed_vars, (list, tuple)) else \
+        [feed_vars]
+    fetch_vars = fetch_vars if isinstance(fetch_vars, (list, tuple)) else \
+        [fetch_vars]
+    lowered = _Lowered(program, [v.slot for v in fetch_vars])
+    scope = global_scope()
+    params = [np.asarray(scope[n]) for n in lowered.param_names]
+
+    def infer(*feeds):
+        outs = lowered(list(feeds), [jax.numpy.asarray(p) for p in params])
+        return tuple(outs) if len(outs) > 1 else outs[0]
+
+    sds = [jax.ShapeDtypeStruct(tuple(program.feed_vars[n]._value.shape),
+                                program.feed_vars[n]._value.dtype)
+           for n in lowered.feed_names]
+    exported = jax.export.export(jax.jit(infer))(*sds)
+    import os
+    os.makedirs(os.path.dirname(os.path.abspath(path_prefix)) or ".",
+                exist_ok=True)
+    with open(path_prefix + ".pdmodel", "wb") as f:
+        f.write(exported.serialize())
+    with open(path_prefix + ".pdiparams", "wb") as f:
+        pickle.dump({n: p for n, p in zip(lowered.param_names, params)}, f,
+                    protocol=4)
+    return [v.name for v in fetch_vars]
+
+
+def load_inference_model(path_prefix, executor=None, **kwargs):
+    """Returns (program_like, feed_names, fetch_names) where program_like
+    is directly callable / usable with inference.Predictor."""
+    from ..inference import Config, create_predictor
+    pred = create_predictor(Config(path_prefix))
+    return pred, pred.get_input_names(), ["output_0"]
+
+
 def save(program, model_path, **kwargs):
     import pickle
     import numpy as np

@@ -310,6 +310,25 @@ class Executor:
             return [np.asarray(o) for o in outs]
         return [Tensor(o) for o in outs]
 
+    def train_from_dataset(self, program, dataset, fetch_list=None,
+                           fetch_info=None, print_period=100, debug=False):
+        """reference `framework/trainer.h` MultiTrainer /
+        `executor.cc:152` RunFromDataset: drive the program from an
+        InMemoryDataset/QueueDataset batch stream."""
+        feed_names = sorted(program.feed_vars.keys())
+        results = []
+        for step, batch in enumerate(dataset):
+            feed = {n: b for n, b in zip(feed_names, batch)}
+            out = self.run(program, feed=feed, fetch_list=fetch_list or [])
+            if fetch_list:
+                results.append(out)
+            if debug and step % print_period == 0:
+                print(f"[train_from_dataset] step {step}: {out}")
+        return results
+
+    def infer_from_dataset(self, program, dataset, fetch_list=None, **kw):
+        return self.train_from_dataset(program, dataset, fetch_list, **kw)
+
     def _run_plain(self, program, scope):
         lowered = _Lowered(program, [])
         feed_arrays = [program.feed_vars[n]._value
