@@ -1,0 +1,88 @@
+"""paddle.nn.utils (reference `python/paddle/nn/utils/`): weight_norm,
+spectral_norm, parameters_to_vector/vector_to_parameters."""
+from __future__ import annotations
+
+import numpy as np
+import jax.numpy as jnp
+
+from ...framework.tensor import Parameter, Tensor
+from ..layer.layers import Layer
+
+__all__ = ["weight_norm", "remove_weight_norm", "spectral_norm",
+           "parameters_to_vector", "vector_to_parameters"]
+
+
+def _norm_except(w, dim):
+    axes = tuple(i for i in range(w.ndim) if i != dim)
+    return jnp.sqrt(jnp.sum(w * w, axis=axes, keepdims=True))
+
+
+def weight_norm(layer: Layer, name="weight", dim=0):
+    """Reparametrize weight = g * v / ||v|| via a forward-pre-hook
+    (reference `nn/utils/weight_norm_hook.py`)."""
+    w = getattr(layer, name)
+    dim = dim if dim is not None else 0
+    g = Parameter(_norm_except(w._value, dim), name=f"{name}_g")
+    v = Parameter(w._value, name=f"{name}_v")
+    del layer._parameters[name]
+    layer.add_parameter(f"{name}_g", g)
+    layer.add_parameter(f"{name}_v", v)
+
+    def hook(l, inputs):
+        vv = getattr(l, f"{name}_v")
+        gg = getattr(l, f"{name}_g")
+        w_new = vv * (gg / Tensor(_norm_except(vv._value, dim)))
+        object.__setattr__(l, name, w_new)
+        return None
+
+    handle = layer.register_forward_pre_hook(hook)
+    layer._weight_norm_handle = handle
+    layer._weight_norm_name = name
+    hook(layer, ())  # materialize once
+    return layer
+
+
+def remove_weight_norm(layer: Layer, name="weight"):
+    handle = getattr(layer, "_weight_norm_handle", None)
+    if handle is not None:
+        handle.remove()
+    w = getattr(layer, name)
+    val = w._value if isinstance(w, Tensor) else w
+    for pn in (f"{name}_g", f"{name}_v"):
+        layer._parameters.pop(pn, None)
+    layer.add_parameter(name, Parameter(val, name=name))
+    return layer
+
+
+def spectral_norm(layer: Layer, name="weight", n_power_iterations=1,
+                  eps=1e-12, dim=0):
+    from ..layer.norm import SpectralNorm
+    w = getattr(layer, name)
+    sn = SpectralNorm(tuple(w.shape), dim=dim,
+                      power_iters=n_power_iterations, eps=eps)
+    layer.add_sublayer(f"{name}_spectral_norm", sn)
+    orig = layer._parameters.pop(name)
+    layer.add_parameter(f"{name}_orig", orig)
+
+    def hook(l, inputs):
+        object.__setattr__(l, name,
+                           sn(getattr(l, f"{name}_orig")))
+        return None
+    layer.register_forward_pre_hook(hook)
+    hook(layer, ())
+    return layer
+
+
+def parameters_to_vector(parameters, name=None):
+    vals = [p._value.reshape(-1) for p in parameters]
+    return Tensor(jnp.concatenate(vals))
+
+
+def vector_to_parameters(vec, parameters, name=None):
+    off = 0
+    v = vec._value if isinstance(vec, Tensor) else jnp.asarray(vec)
+    for p in parameters:
+        n = int(np.prod(p.shape)) if p.shape else 1
+        p._value = v[off:off + n].reshape(tuple(p.shape)).astype(
+            p._value.dtype)
+        off += n

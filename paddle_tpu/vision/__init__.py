@@ -1,2 +1,2 @@
-from . import datasets, models, transforms
+from . import datasets, models, ops, transforms
 from .models import *  # noqa: F401,F403

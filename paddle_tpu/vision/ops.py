@@ -1,0 +1,212 @@
+"""Vision ops (reference `python/paddle/vision/ops.py` + detection ops in
+`paddle/fluid/operators/detection/`): nms, roi_align, yolo_box, box_coder,
+deform_conv2d (API parity subset for the detection model families)."""
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ..framework.tensor import Tensor, apply_op
+
+__all__ = ["nms", "roi_align", "roi_pool", "yolo_box", "box_coder",
+           "box_iou", "distribute_fpn_proposals"]
+
+
+def box_iou(boxes1, boxes2):
+    def impl(a, b):
+        area1 = (a[:, 2] - a[:, 0]) * (a[:, 3] - a[:, 1])
+        area2 = (b[:, 2] - b[:, 0]) * (b[:, 3] - b[:, 1])
+        lt = jnp.maximum(a[:, None, :2], b[None, :, :2])
+        rb = jnp.minimum(a[:, None, 2:], b[None, :, 2:])
+        wh = jnp.clip(rb - lt, 0, None)
+        inter = wh[..., 0] * wh[..., 1]
+        return inter / (area1[:, None] + area2[None, :] - inter + 1e-10)
+    return apply_op("box_iou", impl, (boxes1, boxes2), {})
+
+
+def nms(boxes, iou_threshold=0.3, scores=None, category_idxs=None,
+        categories=None, top_k=None):
+    """Greedy NMS (reference `operators/detection/nms_op` /
+    multiclass_nms). Dynamic output ⇒ eager (numpy) like the reference's
+    CPU path; scoring models run the box head on TPU, NMS on host."""
+    b = np.asarray(boxes.numpy() if isinstance(boxes, Tensor) else boxes)
+    s = (np.asarray(scores.numpy() if isinstance(scores, Tensor) else scores)
+         if scores is not None else np.arange(len(b))[::-1].astype("float32"))
+    cat = (np.asarray(category_idxs.numpy()
+                      if isinstance(category_idxs, Tensor) else category_idxs)
+           if category_idxs is not None else np.zeros(len(b), np.int64))
+
+    keep_all = []
+    for c in np.unique(cat):
+        idx = np.where(cat == c)[0]
+        order = idx[np.argsort(-s[idx])]
+        keep = []
+        while order.size:
+            i = order[0]
+            keep.append(i)
+            if order.size == 1:
+                break
+            rest = order[1:]
+            xx1 = np.maximum(b[i, 0], b[rest, 0])
+            yy1 = np.maximum(b[i, 1], b[rest, 1])
+            xx2 = np.minimum(b[i, 2], b[rest, 2])
+            yy2 = np.minimum(b[i, 3], b[rest, 3])
+            w = np.clip(xx2 - xx1, 0, None)
+            h = np.clip(yy2 - yy1, 0, None)
+            inter = w * h
+            a1 = (b[i, 2] - b[i, 0]) * (b[i, 3] - b[i, 1])
+            a2 = (b[rest, 2] - b[rest, 0]) * (b[rest, 3] - b[rest, 1])
+            iou = inter / (a1 + a2 - inter + 1e-10)
+            order = rest[iou <= iou_threshold]
+        keep_all.extend(keep)
+    keep_all = sorted(keep_all, key=lambda i: -s[i])
+    if top_k is not None:
+        keep_all = keep_all[:top_k]
+    return Tensor(jnp.asarray(np.asarray(keep_all, np.int64)))
+
+
+def roi_align(x, boxes, boxes_num, output_size, spatial_scale=1.0,
+              sampling_ratio=-1, aligned=True, name=None):
+    """RoIAlign via bilinear sampling (reference
+    `operators/roi_align_op`), static-shape and jittable."""
+    if isinstance(output_size, int):
+        output_size = (output_size, output_size)
+    oh, ow = output_size
+    sr = sampling_ratio if sampling_ratio > 0 else 2
+
+    def impl(feat, rois, rois_num):
+        # feat [N,C,H,W]; rois [R,4] in input coords; rois_num [N]
+        N, C, H, W = feat.shape
+        R = rois.shape[0]
+        batch_idx = jnp.repeat(jnp.arange(N), rois_num, axis=0,
+                               total_repeat_length=R)
+        offset = 0.5 if aligned else 0.0
+        x1 = rois[:, 0] * spatial_scale - offset
+        y1 = rois[:, 1] * spatial_scale - offset
+        x2 = rois[:, 2] * spatial_scale - offset
+        y2 = rois[:, 3] * spatial_scale - offset
+        bw = jnp.maximum(x2 - x1, 1e-6)
+        bh = jnp.maximum(y2 - y1, 1e-6)
+        # sample grid: [R, oh*sr, ow*sr]
+        ys = (y1[:, None] + (jnp.arange(oh * sr) + 0.5)[None, :]
+              * bh[:, None] / (oh * sr))
+        xs = (x1[:, None] + (jnp.arange(ow * sr) + 0.5)[None, :]
+              * bw[:, None] / (ow * sr))
+
+        def bilinear(r):
+            f = feat[batch_idx[r]]  # [C,H,W]
+            yy = jnp.clip(ys[r], 0, H - 1)
+            xx = jnp.clip(xs[r], 0, W - 1)
+            y0 = jnp.floor(yy).astype(jnp.int32)
+            x0 = jnp.floor(xx).astype(jnp.int32)
+            y1_ = jnp.minimum(y0 + 1, H - 1)
+            x1_ = jnp.minimum(x0 + 1, W - 1)
+            wy = yy - y0
+            wx = xx - x0
+            # gather [C, oh*sr, ow*sr]
+            def gat(yi, xi):
+                return f[:, yi][:, :, xi]
+            v = (gat(y0, x0) * (1 - wy)[None, :, None] * (1 - wx)[None, None, :]
+                 + gat(y1_, x0) * wy[None, :, None] * (1 - wx)[None, None, :]
+                 + gat(y0, x1_) * (1 - wy)[None, :, None] * wx[None, None, :]
+                 + gat(y1_, x1_) * wy[None, :, None] * wx[None, None, :])
+            v = v.reshape(C, oh, sr, ow, sr).mean(axis=(2, 4))
+            return v
+        return jax.vmap(bilinear)(jnp.arange(R))
+    return apply_op("roi_align", impl, (x, boxes, boxes_num), {})
+
+
+def roi_pool(x, boxes, boxes_num, output_size, spatial_scale=1.0, name=None):
+    return roi_align(x, boxes, boxes_num, output_size, spatial_scale,
+                     sampling_ratio=1, aligned=False)
+
+
+def yolo_box(x, img_size, anchors, class_num, conf_thresh=0.01,
+             downsample_ratio=32, clip_bbox=True, scale_x_y=1.0, name=None):
+    """reference `operators/detection/yolo_box_op`."""
+    na = len(anchors) // 2
+    anc = np.asarray(anchors, np.float32).reshape(na, 2)
+
+    def impl(feat, imgs):
+        N, C, H, W = feat.shape
+        feat = feat.reshape(N, na, 5 + class_num, H, W)
+        gx = jnp.arange(W, dtype=jnp.float32)
+        gy = jnp.arange(H, dtype=jnp.float32)
+        sx = jax.nn.sigmoid(feat[:, :, 0]) * scale_x_y - (scale_x_y - 1) / 2
+        sy = jax.nn.sigmoid(feat[:, :, 1]) * scale_x_y - (scale_x_y - 1) / 2
+        bx = (gx[None, None, None, :] + sx) / W
+        by = (gy[None, None, :, None] + sy) / H
+        bw = jnp.exp(feat[:, :, 2]) * anc[None, :, 0, None, None] / \
+            (W * downsample_ratio)
+        bh = jnp.exp(feat[:, :, 3]) * anc[None, :, 1, None, None] / \
+            (H * downsample_ratio)
+        conf = jax.nn.sigmoid(feat[:, :, 4])
+        probs = jax.nn.sigmoid(feat[:, :, 5:]) * conf[:, :, None]
+        imw = imgs[:, 1].astype(jnp.float32)
+        imh = imgs[:, 0].astype(jnp.float32)
+        x1 = (bx - bw / 2) * imw[:, None, None, None]
+        y1 = (by - bh / 2) * imh[:, None, None, None]
+        x2 = (bx + bw / 2) * imw[:, None, None, None]
+        y2 = (by + bh / 2) * imh[:, None, None, None]
+        if clip_bbox:
+            x1 = jnp.clip(x1, 0, imw[:, None, None, None] - 1)
+            y1 = jnp.clip(y1, 0, imh[:, None, None, None] - 1)
+            x2 = jnp.clip(x2, 0, imw[:, None, None, None] - 1)
+            y2 = jnp.clip(y2, 0, imh[:, None, None, None] - 1)
+        boxes = jnp.stack([x1, y1, x2, y2], axis=-1).reshape(N, -1, 4)
+        scores = probs.transpose(0, 1, 3, 4, 2).reshape(N, -1, class_num)
+        mask = scores.max(-1) >= conf_thresh
+        scores = jnp.where(mask[..., None], scores, 0.0)
+        return boxes, scores
+    return apply_op("yolo_box", impl, (x, img_size), {})
+
+
+def box_coder(prior_box, prior_box_var, target_box,
+              code_type="encode_center_size", box_normalized=True,
+              axis=0, name=None):
+    """reference `operators/detection/box_coder_op` (decode path)."""
+    def impl(prior, var, tgt):
+        pw = prior[:, 2] - prior[:, 0]
+        ph = prior[:, 3] - prior[:, 1]
+        pcx = prior[:, 0] + 0.5 * pw
+        pcy = prior[:, 1] + 0.5 * ph
+        if code_type == "decode_center_size":
+            tx, ty, tw, th = (tgt[..., 0], tgt[..., 1], tgt[..., 2],
+                              tgt[..., 3])
+            cx = var[..., 0] * tx * pw + pcx
+            cy = var[..., 1] * ty * ph + pcy
+            w = jnp.exp(var[..., 2] * tw) * pw
+            h = jnp.exp(var[..., 3] * th) * ph
+            return jnp.stack([cx - w / 2, cy - h / 2, cx + w / 2,
+                              cy + h / 2], axis=-1)
+        tw_ = tgt[:, 2] - tgt[:, 0]
+        th_ = tgt[:, 3] - tgt[:, 1]
+        tcx = tgt[:, 0] + 0.5 * tw_
+        tcy = tgt[:, 1] + 0.5 * th_
+        return jnp.stack([(tcx - pcx) / pw / var[..., 0],
+                          (tcy - pcy) / ph / var[..., 1],
+                          jnp.log(tw_ / pw) / var[..., 2],
+                          jnp.log(th_ / ph) / var[..., 3]], axis=-1)
+    return apply_op("box_coder", impl,
+                    (prior_box, prior_box_var, target_box), {})
+
+
+def distribute_fpn_proposals(fpn_rois, min_level, max_level, refer_level,
+                             refer_scale, rois_num=None, name=None):
+    """reference `operators/detection/distribute_fpn_proposals_op` —
+    eager (dynamic outputs)."""
+    rois = np.asarray(fpn_rois.numpy() if isinstance(fpn_rois, Tensor)
+                      else fpn_rois)
+    w = rois[:, 2] - rois[:, 0]
+    h = rois[:, 3] - rois[:, 1]
+    scale = np.sqrt(np.clip(w * h, 1e-6, None))
+    lvl = np.floor(np.log2(scale / refer_scale + 1e-6)) + refer_level
+    lvl = np.clip(lvl, min_level, max_level).astype(int)
+    outs, idxs = [], []
+    for l in range(min_level, max_level + 1):
+        sel = np.where(lvl == l)[0]
+        outs.append(Tensor(jnp.asarray(rois[sel])))
+        idxs.append(sel)
+    restore = np.argsort(np.concatenate(idxs)) if idxs else np.array([])
+    return outs, Tensor(jnp.asarray(restore.astype(np.int32)))
