@@ -74,6 +74,17 @@ class Model:
         if optimizer is not None and getattr(
                 optimizer, "_parameter_list", None) is None:
             optimizer._parameter_list = self.network.parameters()
+        # fleet-distributed: route training through the SPMD sharded step
+        # (reference `hapi/model.py:165` prepare_distributed_context)
+        try:
+            from ..distributed.fleet import fleet as _fleet
+            from ..parallel.mesh import get_mesh
+            mesh = get_mesh()
+            if _fleet._inited and mesh is not None and \
+                    mesh.devices.size > 1:
+                self._dist_ctx = _fleet
+        except Exception:
+            self._dist_ctx = None
         return self
 
     # -- internals ----------------------------------------------------------
@@ -123,6 +134,8 @@ class Model:
         return step
 
     def train_batch(self, inputs, labels=None, update=True):
+        if self._dist_ctx is not None:
+            return self._train_batch_sharded(inputs, labels)
         params = get_params(self.network)
         buffers = get_buffers(self.network)
         pv = {n: t._value for n, t in params.items()}
@@ -157,6 +170,28 @@ class Model:
         metrics = self._update_metrics(outs, labels)
         return (float(lv), metrics) if self._metrics else ([float(lv)],
                                                            metrics)
+
+    def _train_batch_sharded(self, inputs, labels):
+        """fleet path: one pjit'ed step over the mesh (dp/tp/zero per
+        strategy); params written back so eval/save see fresh values."""
+        import jax
+        from ..parallel.spmd import write_back
+        if not hasattr(self, "_sharded_step"):
+            def loss_fn(outs, lbs):
+                out = outs[0] if isinstance(outs, (list, tuple)) else outs
+                return self._loss_value(out, lbs)
+            self._sharded_step, self._sharded_state = \
+                self._dist_ctx.build_sharded_train_step(
+                    self.network, self._optimizer, loss_fn)
+        ins = [t._value if isinstance(t, Tensor) else jnp.asarray(t)
+               for t in _flatten_batch(inputs)]
+        lbs = [t._value if isinstance(t, Tensor) else jnp.asarray(t)
+               for t in _flatten_batch(labels or [])]
+        self._sharded_state, lv = self._sharded_step(
+            self._sharded_state, tuple(ins), tuple(lbs))
+        write_back(self.network, self._sharded_state)
+        outs = []  # sharded step doesn't return outputs; metrics use eval
+        return float(lv), []
 
     def eval_batch(self, inputs, labels=None):
         params = get_params(self.network)

@@ -1,0 +1,160 @@
+"""Flagship model tests + dygraph/compiled parity (reference
+`test_imperative_*` dual-mode loss-parity strategy)."""
+import numpy as np
+import pytest
+
+import jax
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+from paddle_tpu.models import (ErnieConfig, ErnieForPretraining,
+                               ErnieForSequenceClassification, ErnieModel,
+                               GPTConfig, GPTForCausalLM)
+
+
+def test_ernie_forward_shapes():
+    cfg = ErnieConfig.tiny()
+    m = ErnieModel(cfg)
+    m.eval()
+    ids = paddle.randint(0, cfg.vocab_size, [2, 16], dtype="int32")
+    seq, pooled = m(ids)
+    assert seq.shape == [2, 16, cfg.hidden_size]
+    assert pooled.shape == [2, cfg.hidden_size]
+
+
+def test_ernie_pretraining_heads():
+    cfg = ErnieConfig.tiny()
+    m = ErnieForPretraining(cfg)
+    m.eval()
+    ids = paddle.randint(0, cfg.vocab_size, [2, 8], dtype="int32")
+    mlm, nsp = m(ids)
+    assert mlm.shape == [2, 8, cfg.vocab_size]
+    assert nsp.shape == [2, 2]
+
+
+def test_ernie_cls_train_step():
+    cfg = ErnieConfig.tiny()
+    m = ErnieForSequenceClassification(cfg, num_classes=3)
+    opt = paddle.optimizer.AdamW(1e-3, parameters=m.parameters())
+    ce = nn.CrossEntropyLoss()
+    ids = paddle.randint(0, cfg.vocab_size, [4, 8], dtype="int32")
+    y = paddle.randint(0, 3, [4], dtype="int32")
+    losses = []
+    for _ in range(3):
+        loss = ce(m(ids), y)
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+        losses.append(float(loss))
+    assert losses[-1] < losses[0]
+
+
+def test_gpt_causal_lm():
+    cfg = GPTConfig.tiny()
+    m = GPTForCausalLM(cfg)
+    m.eval()
+    ids = paddle.randint(0, cfg.vocab_size, [2, 12], dtype="int32")
+    logits = m(ids)
+    assert logits.shape == [2, 12, cfg.vocab_size]
+
+
+def test_gpt_causality():
+    """Changing a future token must not change past logits."""
+    paddle.seed(5)
+    cfg = GPTConfig.tiny(dropout=0.0)
+    m = GPTForCausalLM(cfg)
+    m.eval()
+    rng = np.random.RandomState(0)
+    ids = rng.randint(0, cfg.vocab_size, (1, 10)).astype("int32")
+    l1 = m(paddle.to_tensor(ids)).numpy()
+    ids2 = ids.copy()
+    ids2[0, -1] = (ids2[0, -1] + 1) % cfg.vocab_size
+    l2 = m(paddle.to_tensor(ids2)).numpy()
+    np.testing.assert_allclose(l1[0, :-1], l2[0, :-1], atol=1e-4)
+    assert not np.allclose(l1[0, -1], l2[0, -1], atol=1e-4)
+
+
+def test_to_static_matches_dygraph():
+    paddle.seed(9)
+    net = nn.Sequential(nn.Linear(8, 16), nn.GELU(), nn.Linear(16, 4))
+    x = paddle.randn([4, 8])
+    eager = net(x).numpy()
+    sf = paddle.jit.to_static(net.forward)
+    compiled = sf(x).numpy()
+    np.testing.assert_allclose(eager, compiled, rtol=1e-5, atol=1e-6)
+
+
+def test_to_static_train_parity():
+    """Same losses dygraph vs to_static over optimizer steps (reference
+    dygraph/static parity tests)."""
+    def run(use_static):
+        paddle.seed(11)
+        net = nn.Sequential(nn.Linear(6, 12), nn.Tanh(), nn.Linear(12, 1))
+        opt = paddle.optimizer.SGD(0.1, parameters=net.parameters())
+        fwd = paddle.jit.to_static(net.forward) if use_static else net
+        rng = np.random.RandomState(1)
+        x = paddle.to_tensor(rng.rand(8, 6).astype("float32"))
+        y = paddle.to_tensor(rng.rand(8, 1).astype("float32"))
+        losses = []
+        for _ in range(4):
+            loss = nn.functional.mse_loss(fwd(x), y)
+            loss.backward()
+            opt.step()
+            opt.clear_grad()
+            losses.append(float(loss))
+        return losses
+    np.testing.assert_allclose(run(False), run(True), rtol=1e-4)
+
+
+def test_transformer_decoder_cache_generation():
+    paddle.seed(13)
+    dec_layer = nn.TransformerDecoderLayer(16, 4, 32, dropout=0.0)
+    dec = nn.TransformerDecoder(dec_layer, 2)
+    memory = paddle.randn([1, 6, 16])
+    cache = dec.gen_cache(memory)
+    out, cache = dec(paddle.randn([1, 1, 16]), memory, cache=cache)
+    out2, cache = dec(paddle.randn([1, 1, 16]), memory, cache=cache)
+    assert out.shape == [1, 1, 16]
+    # incremental cache grew to 2 steps
+    assert cache[0][0].k.shape[2] == 2
+
+
+def test_model_fit_with_fleet_sharded_step():
+    from paddle_tpu.distributed import fleet
+    from paddle_tpu.io import TensorDataset
+    from paddle_tpu.parallel.mesh import set_mesh
+    strategy = fleet.DistributedStrategy()
+    strategy.sharding = True
+    strategy.hybrid_configs = {"dp_degree": 8}
+    fleet.init(is_collective=True, strategy=strategy)
+    try:
+        rng = np.random.RandomState(3)
+        x = rng.randn(64, 8).astype("float32")
+        y = rng.randint(0, 4, 64).astype("int64")
+        net = nn.Sequential(nn.Linear(8, 16), nn.ReLU(), nn.Linear(16, 4))
+        model = paddle.Model(net)
+        opt = fleet.distributed_optimizer(
+            paddle.optimizer.Adam(0.01, parameters=net.parameters()))
+        model.prepare(opt, nn.CrossEntropyLoss())
+        assert model._dist_ctx is not None
+        model.fit(TensorDataset([x, y]), batch_size=32, epochs=2, verbose=0,
+                  drop_last=True)
+        # params were written back and are finite
+        for p in net.parameters():
+            assert np.isfinite(p.numpy()).all()
+    finally:
+        set_mesh(None)
+
+
+def test_amp_model_prepare():
+    from paddle_tpu.io import TensorDataset
+    rng = np.random.RandomState(4)
+    x = rng.randn(32, 8).astype("float32")
+    y = rng.randint(0, 2, 32).astype("int64")
+    net = nn.Sequential(nn.Linear(8, 2))
+    model = paddle.Model(net)
+    model.prepare(paddle.optimizer.Adam(0.01,
+                                        parameters=net.parameters()),
+                  nn.CrossEntropyLoss(), amp_configs="O1")
+    model.fit(TensorDataset([x, y]), batch_size=16, epochs=1, verbose=0)
+    assert np.isfinite(net[0].weight.numpy()).all()
