@@ -1,5 +1,6 @@
 """paddle.distributed (reference `python/paddle/distributed/`)."""
-from . import collective, fleet
+from . import collective, fleet, sharding
+from .sharding import group_sharded_parallel, save_group_sharded_model
 from .collective import (ReduceOp, all_gather, all_reduce, alltoall, barrier,
                          broadcast, get_group, new_group, recv, reduce,
                          reduce_scatter, scatter, send, shard_ctx, split,

@@ -134,7 +134,8 @@ class Fleet:
             zero_stage=(st.sharding_configs.get("stage", 1)
                         if st.sharding else 0),
             sp_axis="sp" if st.sequence_parallel else None,
-            recompute=st.recompute)
+            recompute=st.recompute,
+            grad_dtype=("float16" if st.fp16_allreduce else None))
 
     def minimize(self, loss, startup_program=None, parameter_list=None,
                  no_grad_set=None):

@@ -96,6 +96,10 @@ class Tensor:
             self._grad = value._value if isinstance(value, Tensor) else jnp.asarray(value)
 
     def _accumulate_grad(self, g):
+        for hook in getattr(self, "_grad_hooks", ()):
+            out = hook(Tensor(g))
+            if out is not None:
+                g = out._value if isinstance(out, Tensor) else jnp.asarray(out)
         self._grad = g if self._grad is None else self._grad + g
 
     # -- conversions --------------------------------------------------------
@@ -166,7 +170,20 @@ class Tensor:
         return self
 
     def register_hook(self, hook):
-        raise NotImplementedError("tensor hooks land with the hook subsystem")
+        """Grad hook (reference `imperative/hooks.h`): called with the
+        gradient Tensor during backward; a returned Tensor replaces it."""
+        if not hasattr(self, "_grad_hooks"):
+            self._grad_hooks = []
+        self._grad_hooks.append(hook)
+
+        class _Removable:
+            def __init__(self, hooks, h):
+                self._hooks, self._h = hooks, h
+
+            def remove(self):
+                if self._h in self._hooks:
+                    self._hooks.remove(self._h)
+        return _Removable(self._grad_hooks, hook)
 
     def pin_memory(self):
         return self

@@ -120,7 +120,7 @@ def shard_params(layer, mesh=None):
 def make_sharded_train_step(layer, optimizer, loss_fn: Callable,
                             mesh=None, zero_stage=1, dp_axis="dp",
                             sp_axis=None, recompute=False,
-                            donate=True):
+                            donate=True, grad_dtype=None):
     """Returns (step, state) where
       state = {params, buffers, opt_state, step_no}
       step(state, inputs, labels, lr, rng) -> (state, loss)
@@ -166,6 +166,14 @@ def make_sharded_train_step(layer, optimizer, loss_fn: Callable,
                                          state["step_no"])
         (lv, new_bufs), grads = jax.value_and_grad(loss_of, has_aux=True)(
             pv_, bv_, rng, inputs, labels)
+        if grad_dtype is not None:
+            # fp16/bf16-allreduce strategy (reference
+            # fp16_allreduce_optimizer.py): compress grads before the
+            # (XLA-inserted) dp allreduce, restore for the update
+            from ..framework.dtype import to_jax_dtype
+            gd = to_jax_dtype(grad_dtype)
+            grads = jax.tree_util.tree_map(
+                lambda g, p: g.astype(gd).astype(p.dtype), grads, pv_)
         new_pv, new_opt = optimizer.apply_gradients_pytree(
             grads, pv_, opt_state_, lr, step_no + 1)
         new_state = {"params": new_pv, "buffers": new_bufs,

@@ -1,0 +1,99 @@
+#!/usr/bin/env python
+"""Single-op benchmark harness (reference
+`paddle/fluid/operators/benchmark/op_tester.cc` + tools/test_op_benchmark.sh
+CI gate). Measures per-op latency on the attached accelerator and writes a
+JSON report usable as a PR-regression gate.
+
+  python tools/op_bench.py                 # standard suite
+  python tools/op_bench.py --op matmul     # one op
+  python tools/op_bench.py --compare a.json b.json   # regression check
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+
+import numpy as np
+
+
+def _suite():
+    import paddle_tpu as paddle
+
+    def t(shape, dtype="float32", seed=0):
+        rng = np.random.RandomState(seed)
+        return paddle.to_tensor(rng.rand(*shape).astype(dtype))
+
+    big = (1024, 1024)
+    return {
+        "matmul": lambda: paddle.matmul(t(big), t(big, seed=1)),
+        "add": lambda: t(big) + t(big, seed=1),
+        "softmax": lambda: paddle.nn.functional.softmax(t(big)),
+        "layer_norm": lambda: paddle.nn.functional.layer_norm(
+            t((64, 1024)), 1024),
+        "conv2d": lambda: paddle.nn.functional.conv2d(
+            t((8, 64, 56, 56)), t((64, 64, 3, 3), seed=1), padding=1),
+        "reduce_sum": lambda: paddle.sum(t(big)),
+        "transpose": lambda: paddle.transpose(t(big), [1, 0]),
+        "gelu": lambda: paddle.nn.functional.gelu(t(big)),
+        "embedding": lambda: paddle.nn.functional.embedding(
+            paddle.randint(0, 30000, [32, 128]), t((30000, 256))),
+        "sdpa": lambda: paddle.nn.functional.scaled_dot_product_attention(
+            t((4, 8, 256, 64)), t((4, 8, 256, 64), seed=1),
+            t((4, 8, 256, 64), seed=2)),
+    }
+
+
+def bench_one(fn, warmup=3, iters=20):
+    for _ in range(warmup):
+        out = fn()
+    float(np.asarray(out.numpy()).reshape(-1)[0])
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        out = fn()
+    float(np.asarray(out.numpy()).reshape(-1)[0])
+    return (time.perf_counter() - t0) / iters * 1000  # ms
+
+
+def compare(path_a, path_b, threshold=1.15):
+    with open(path_a) as f:
+        a = json.load(f)
+    with open(path_b) as f:
+        b = json.load(f)
+    failed = []
+    for op, ms in b.items():
+        base = a.get(op)
+        if base and ms > base * threshold:
+            failed.append((op, base, ms))
+    if failed:
+        for op, base, ms in failed:
+            print(f"REGRESSION {op}: {base:.3f}ms -> {ms:.3f}ms")
+        return 1
+    print("no op perf regressions")
+    return 0
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--op", default=None)
+    ap.add_argument("--out", default=None)
+    ap.add_argument("--compare", nargs=2, default=None)
+    args = ap.parse_args()
+    if args.compare:
+        sys.exit(compare(*args.compare))
+    suite = _suite()
+    if args.op:
+        suite = {args.op: suite[args.op]}
+    results = {}
+    for name, fn in suite.items():
+        ms = bench_one(fn)
+        results[name] = ms
+        print(f"{name:<16}{ms:>10.3f} ms")
+    if args.out:
+        with open(args.out, "w") as f:
+            json.dump(results, f, indent=1)
+
+
+if __name__ == "__main__":
+    main()
