@@ -52,4 +52,63 @@ def in_dynamic_mode():
     return not _in_static()
 
 
+def in_dygraph_mode():
+    return not _in_static()
+
+
+def enable_dygraph(place=None):
+    disable_static(place)
+
+
+def disable_dygraph():
+    enable_static()
+
+
+# ---- legacy / compat surface -------------------------------------------
+from .framework.place import (  # noqa: E402
+    CUDAPinnedPlace, NPUPlace, XPUPlace, get_cudnn_version,
+    is_compiled_with_npu, is_compiled_with_xpu,
+)
+from .framework.random import (  # noqa: E402
+    get_cuda_rng_state, get_rng_state, set_cuda_rng_state, set_rng_state,
+)
+from .hapi import callbacks  # noqa: E402
+from .hapi.model_summary import flops  # noqa: E402
+from .ops.legacy import (  # noqa: E402
+    LoDTensor, LoDTensorArray, get_default_dtype, set_default_dtype,
+    set_printoptions,
+)
+from .static.program import data  # noqa: E402
+
+VarBase = Tensor  # reference 2.0: paddle.Tensor is the pybind VarBase
+
+
+def monkey_patch_math_varbase():
+    """No-op: operator overloads are bound at import (ops.tensor_methods);
+    the reference needed an explicit patch pass over pybind VarBase."""
+
+
+def monkey_patch_variable():
+    """No-op: static Variables share the Tensor method surface here."""
+
+
+def _inplace_fn(name):
+    def fn(x, *args, **kwargs):
+        return getattr(x, name)(*args, **kwargs)
+    fn.__name__ = name
+    return fn
+
+
+reshape_ = _inplace_fn("reshape_")
+scatter_ = _inplace_fn("scatter_")
+squeeze_ = _inplace_fn("squeeze_")
+unsqueeze_ = _inplace_fn("unsqueeze_")
+tanh_ = _inplace_fn("tanh_")
+clip_ = _inplace_fn("clip_")
+scale_ = _inplace_fn("scale_")
+flatten_ = _inplace_fn("flatten_")
+exp_ = _inplace_fn("exp_")
+sqrt_ = _inplace_fn("sqrt_")
+
+
 __version__ = "0.1.0"

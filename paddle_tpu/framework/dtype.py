@@ -73,7 +73,7 @@ _STR_ALIASES = {"bool": np.bool_, "bfloat16": jnp.bfloat16}
 def convert_dtype(dtype) -> DType:
     """Normalize any dtype spec (str / numpy / jax / DType) to a DType."""
     if dtype is None:
-        return float32
+        return default_float_dtype()
     if isinstance(dtype, DType):
         return dtype
     if isinstance(dtype, str) and dtype in _BY_NAME:
@@ -101,5 +101,19 @@ def is_integer_dtype(dtype) -> bool:
     return jnp.issubdtype(to_jax_dtype(dtype), jnp.integer)
 
 
+_default_float: DType = None  # set below
+
+
 def default_float_dtype() -> DType:
-    return float32
+    return _default_float if _default_float is not None else float32
+
+
+def set_default_float_dtype(d) -> None:
+    """Backs paddle.set_default_dtype; only float dtypes are legal
+    (reference: `python/paddle/framework/framework.py` set_default_dtype)."""
+    dt = convert_dtype(d)
+    if not is_floating_point_dtype(dt):
+        raise TypeError(
+            f"set_default_dtype only supports float dtypes, got {dt}")
+    global _default_float
+    _default_float = dt

@@ -12,9 +12,11 @@ import threading
 import jax
 
 __all__ = [
-    "Place", "CPUPlace", "TPUPlace", "CUDAPlace", "set_device", "get_device",
+    "Place", "CPUPlace", "TPUPlace", "CUDAPlace", "CUDAPinnedPlace",
+    "XPUPlace", "NPUPlace", "set_device", "get_device",
     "default_place", "device_for", "is_compiled_with_cuda",
-    "is_compiled_with_tpu", "device_count",
+    "is_compiled_with_tpu", "is_compiled_with_xpu", "is_compiled_with_npu",
+    "device_count", "get_cudnn_version",
 ]
 
 
@@ -81,6 +83,49 @@ class CUDAPlace(Place):
             if p in plats:
                 return p
         return "cpu"
+
+
+class CUDAPinnedPlace(Place):
+    """Pinned host memory (`platform/place.h` CUDAPinnedPlace). On TPU the
+    host side is plain CPU memory — jax manages pinned staging internally —
+    so this is the CPU place kept for API parity."""
+    kind = "cpu"
+
+    def __init__(self):
+        super().__init__(0)
+
+    def __repr__(self):
+        return "CUDAPinnedPlace"
+
+
+class XPUPlace(Place):
+    """Kunlun XPU place in the reference; maps to the accelerator place."""
+    kind = "xpu"
+
+    def _platform(self):
+        plats = {d.platform for d in jax.devices()}
+        for p in ("tpu", "axon", "gpu"):
+            if p in plats:
+                return p
+        return "cpu"
+
+
+class NPUPlace(XPUPlace):
+    kind = "npu"
+
+
+def is_compiled_with_xpu():
+    return False
+
+
+def is_compiled_with_npu():
+    return False
+
+
+def get_cudnn_version():
+    """No cuDNN on TPU; reference returns None when not compiled with CUDA
+    (`python/paddle/device.py` get_cudnn_version)."""
+    return None
 
 
 class _State(threading.local):

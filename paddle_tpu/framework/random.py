@@ -14,7 +14,9 @@ import threading
 
 import jax
 
-__all__ = ["seed", "get_rng_key", "rng_scope", "default_seed"]
+__all__ = ["seed", "get_rng_key", "rng_scope", "default_seed",
+           "get_rng_state", "set_rng_state", "get_cuda_rng_state",
+           "set_cuda_rng_state"]
 
 default_seed = 0
 
@@ -47,6 +49,25 @@ def seed(s: int):
 def get_rng_key():
     """Draw a fresh subkey from the innermost scope (stateful split)."""
     return _state.stack[-1].next_key()
+
+
+def get_rng_state():
+    """Snapshot the innermost generator state (reference:
+    `paddle.get_cuda_rng_state`, `framework/generator.cc` GetState). The
+    state is the raw PRNG key array — one generator per host thread, not
+    per device: JAX keys are device-agnostic."""
+    return [_state.stack[-1].key]
+
+
+def set_rng_state(states):
+    _state.stack[-1].key = states[0] if isinstance(states, (list, tuple)) \
+        else states
+
+
+# API-parity aliases: there is no CUDA here; the "cuda" generator is the
+# accelerator generator, which is the same functional key.
+get_cuda_rng_state = get_rng_state
+set_cuda_rng_state = set_rng_state
 
 
 @contextlib.contextmanager

@@ -1,7 +1,7 @@
 """Op library: the TPU-native replacement for the reference's 496-op
 `paddle/fluid/operators/` — every op is a pure jnp/lax function lowered by
 XLA (no hand-written kernels except Pallas hot ops)."""
-from . import creation, linalg, logic, manipulation, math, random_ops, reduction, search
+from . import creation, legacy, linalg, logic, manipulation, math, random_ops, reduction, search
 from .creation import *  # noqa: F401,F403
 from .linalg import *  # noqa: F401,F403
 from .logic import *  # noqa: F401,F403
@@ -10,5 +10,6 @@ from .math import *  # noqa: F401,F403
 from .random_ops import *  # noqa: F401,F403
 from .reduction import *  # noqa: F401,F403
 from .search import *  # noqa: F401,F403
+from .legacy import *  # noqa: F401,F403  (last: axis-aware elementwise_* win)
 
 from . import tensor_methods  # noqa: F401  (patches Tensor)

@@ -1,0 +1,274 @@
+"""Legacy (fluid-era) op aliases and tensor-array ops.
+
+Reference surface: `python/paddle/fluid/layers/tensor.py` (fill_constant,
+create_array/array_write/array_read, reverse, has_inf/has_nan),
+`python/paddle/fluid/layers/nn.py` (reduce_* / elementwise_* families,
+crop_tensor, shape, rank), `python/paddle/fluid/lod_tensor.py` (LoDTensor).
+TPU-native design: all of these are thin jnp compositions over the modern op
+library — one lowering path, no separate legacy kernels; LoD is carried as an
+explicit offsets list next to a dense padded array (XLA needs static shapes).
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..framework import dtype as _dtype_mod
+from ..framework.tensor import Tensor, apply_op, to_tensor
+from . import creation, manipulation, math as _math, reduction
+
+__all__ = [
+    "add_n", "broadcast_shape", "crop_tensor", "fill_constant",
+    "elementwise_add", "elementwise_sub", "elementwise_mul", "elementwise_div",
+    "elementwise_floordiv", "elementwise_mod", "elementwise_pow",
+    "elementwise_max", "elementwise_min",
+    "reduce_sum", "reduce_mean", "reduce_max", "reduce_min", "reduce_prod",
+    "reduce_all", "reduce_any", "has_inf", "has_nan", "rank", "shape",
+    "reverse", "scatter_nd", "get_tensor_from_selected_rows",
+    "merge_selected_rows", "create_array", "array_write", "array_read",
+    "array_length", "tensor_array_to_tensor", "LoDTensor", "LoDTensorArray",
+    "set_printoptions", "get_default_dtype", "set_default_dtype",
+    "create_parameter", "create_global_var",
+]
+
+
+# --------------------------------------------------------------------------
+# default dtype (paddle.set_default_dtype)
+
+def set_default_dtype(d):
+    _dtype_mod.set_default_float_dtype(d)
+
+
+def get_default_dtype():
+    return _dtype_mod.default_float_dtype().name
+
+
+def set_printoptions(precision=None, threshold=None, edgeitems=None,
+                     sci_mode=None, linewidth=None):
+    """Reference: `python/paddle/tensor/to_string.py`. Maps onto numpy's
+    printoptions — Tensor repr prints via numpy."""
+    kw = {}
+    if precision is not None:
+        kw["precision"] = precision
+    if threshold is not None:
+        kw["threshold"] = threshold
+    if edgeitems is not None:
+        kw["edgeitems"] = edgeitems
+    if linewidth is not None:
+        kw["linewidth"] = linewidth
+    if sci_mode is not None:
+        kw["suppress"] = not sci_mode
+    np.set_printoptions(**kw)
+
+
+# --------------------------------------------------------------------------
+# elementwise_* / reduce_* legacy names
+
+def _axis_broadcast(x, y, axis):
+    """fluid elementwise ops allowed mid-rank broadcast via `axis`."""
+    xv = x._value if isinstance(x, Tensor) else jnp.asarray(x)
+    yv = y._value if isinstance(y, Tensor) else jnp.asarray(y)
+    if axis != -1 and yv.ndim < xv.ndim:
+        shape = [1] * xv.ndim
+        shape[axis:axis + yv.ndim] = yv.shape
+        y = manipulation.reshape(y, shape)
+    return x, y
+
+
+def _elementwise(name, fn):
+    def op(x, y, axis=-1, act=None, name=None):
+        x, y = _axis_broadcast(x, y, axis)
+        out = apply_op(f"elementwise_{name}", fn, (x, y), {})
+        if act is not None:
+            from ..nn import functional as F
+            out = getattr(F, act)(out)
+        return out
+    op.__name__ = f"elementwise_{name}"
+    return op
+
+
+elementwise_add = _elementwise("add", jnp.add)
+elementwise_sub = _elementwise("sub", jnp.subtract)
+elementwise_mul = _elementwise("mul", jnp.multiply)
+elementwise_div = _elementwise("div", jnp.divide)
+elementwise_floordiv = _elementwise("floordiv", jnp.floor_divide)
+elementwise_mod = _elementwise("mod", jnp.mod)
+elementwise_pow = _elementwise("pow", jnp.power)
+elementwise_max = _elementwise("max", jnp.maximum)
+elementwise_min = _elementwise("min", jnp.minimum)
+
+
+def _reduce(new_fn):
+    def op(input, dim=None, keep_dim=False, name=None):
+        return new_fn(input, axis=dim, keepdim=keep_dim)
+    return op
+
+
+reduce_sum = _reduce(reduction.sum)
+reduce_mean = _reduce(reduction.mean)
+reduce_max = _reduce(reduction.max)
+reduce_min = _reduce(reduction.min)
+reduce_prod = _reduce(reduction.prod)
+reduce_all = _reduce(reduction.all)
+reduce_any = _reduce(reduction.any)
+
+
+# --------------------------------------------------------------------------
+# misc tensor ops
+
+def add_n(inputs, name=None):
+    if isinstance(inputs, Tensor):
+        return inputs
+    def impl(*vs):
+        out = vs[0]
+        for v in vs[1:]:
+            out = out + v
+        return out
+    return apply_op("add_n", impl, tuple(inputs), {})
+
+
+def broadcast_shape(x_shape, y_shape):
+    return list(np.broadcast_shapes(tuple(x_shape), tuple(y_shape)))
+
+
+def fill_constant(shape, dtype, value, force_cpu=False, out=None, name=None):
+    t = creation.full(shape, value, dtype=dtype)
+    if out is not None:
+        out.set_value(t._value)
+        return out
+    return t
+
+
+def crop_tensor(x, shape=None, offsets=None, name=None):
+    xshape = list(x.shape)
+    shape = list(shape) if shape is not None else xshape
+    shape = [xshape[i] if s in (-1, None) else int(s)
+             for i, s in enumerate(shape)]
+    offsets = list(offsets) if offsets is not None else [0] * len(xshape)
+    def impl(v):
+        sl = tuple(slice(int(o), int(o) + int(s))
+                   for o, s in zip(offsets, shape))
+        return v[sl]
+    return apply_op("crop_tensor", impl, (x,), {})
+
+
+def has_inf(x, name=None):
+    return apply_op("has_inf", lambda v: jnp.isinf(v).any(), (x,), {})
+
+
+def has_nan(x, name=None):
+    return apply_op("has_nan", lambda v: jnp.isnan(v).any(), (x,), {})
+
+
+def rank(input, name=None):
+    return to_tensor(np.asarray(input.ndim, np.int32))
+
+
+def shape(input, name=None):
+    return to_tensor(np.asarray(input.shape, np.int32))
+
+
+def reverse(x, axis, name=None):
+    return manipulation.flip(x, axis)
+
+
+def scatter_nd(index, updates, shape, name=None):
+    zeros = creation.zeros(shape, dtype=updates.dtype)
+    return manipulation.scatter_nd_add(zeros, index, updates)
+
+
+def get_tensor_from_selected_rows(x, name=None):
+    """SelectedRows (`framework/selected_rows.h`) was CUDA-side sparse-row
+    storage; here sparse grads are dense-with-zero-rows, so this is identity."""
+    return x
+
+
+def merge_selected_rows(x, name=None):
+    return x
+
+
+def create_parameter(shape, dtype, name=None, attr=None,
+                     is_bias=False, default_initializer=None):
+    """Reference: `python/paddle/fluid/layers/tensor.py` create_parameter."""
+    from ..framework.tensor import Parameter
+    from ..nn import initializer as init
+    ini = default_initializer
+    if ini is None:
+        ini = init.Constant(0.0) if is_bias else init.XavierNormal()
+    val = ini(shape, dtype)
+    v = val._value if isinstance(val, Tensor) else jnp.asarray(val)
+    return Parameter(v, name=name)
+
+
+def create_global_var(shape, value, dtype, persistable=False,
+                      force_cpu=False, name=None):
+    from ..static import program as _prog
+    t = creation.full(shape, value, dtype=dtype)
+    t.persistable = persistable
+    if name:
+        t.name = name
+    return t
+
+
+# --------------------------------------------------------------------------
+# tensor arrays (reference: LoDTensorArray + layers/control_flow array ops)
+
+class LoDTensorArray(list):
+    """Python-list-backed tensor array. The reference used a C++
+    vector<LoDTensor> variable type for while-loop state; under XLA, loop
+    state must be a fixed pytree, so eager mode keeps a list and
+    `tensor_array_to_tensor` materialises it for compiled code."""
+
+
+class LoDTensor(Tensor):
+    """Dense tensor + LoD offsets (`framework/lod_tensor.h:114`). Kept for
+    API parity; variable-length batches on TPU use padded dense + mask."""
+
+    def __init__(self, value=None, lod=None):
+        if value is None:
+            value = np.zeros((0,), np.float32)
+        super().__init__(jnp.asarray(value))
+        self._lod = lod or []
+
+    def lod(self):
+        return self._lod
+
+    def set_lod(self, lod):
+        self._lod = lod
+
+    def recursive_sequence_lengths(self):
+        return [[b - a for a, b in zip(level[:-1], level[1:])]
+                for level in self._lod]
+
+
+def create_array(dtype="float32", initialized_list=None):
+    arr = LoDTensorArray()
+    if initialized_list:
+        arr.extend(initialized_list)
+    return arr
+
+
+def array_write(x, i, array=None):
+    if array is None:
+        array = create_array()
+    idx = int(i)
+    while len(array) <= idx:
+        array.append(None)
+    array[idx] = x
+    return array
+
+
+def array_read(array, i):
+    return array[int(i)]
+
+
+def array_length(array):
+    return to_tensor(np.asarray(len(array), np.int64))
+
+
+def tensor_array_to_tensor(input, axis=1, use_stack=False, name=None):
+    op = manipulation.stack if use_stack else manipulation.concat
+    out = op(list(input), axis=axis)
+    sizes = np.asarray([t.shape[axis] if not use_stack else 1
+                        for t in input], np.int32)
+    return out, to_tensor(sizes)

@@ -134,3 +134,60 @@ def _iter(self):
 
 
 Tensor.__iter__ = _iter
+
+
+# --------------------------------------------------------------------------
+# in-place variants (reference: `reshape_`, `scatter_`, `tanh_`… — eager-only
+# mutation; under XLA "in-place" is adopt-the-new-functional-value, with
+# donation letting the compiler reuse the buffer)
+
+def _make_inplace(fn):
+    def method(self, *args, **kwargs):
+        out = fn(self, *args, **kwargs)
+        self._value = out._value
+        self._node = out._node
+        if out._node is not None:
+            self.stop_gradient = False
+        return self
+    return method
+
+
+_INPLACE = {
+    "reshape_": manipulation.reshape,
+    "squeeze_": manipulation.squeeze,
+    "unsqueeze_": manipulation.unsqueeze,
+    "flatten_": manipulation.flatten,
+    "scatter_": manipulation.scatter,
+    "clip_": math.clip,
+    "scale_": math.scale,
+    "tanh_": math.tanh,
+    "exp_": math.exp,
+    "sqrt_": math.sqrt,
+    "rsqrt_": math.rsqrt,
+    "reciprocal_": math.reciprocal,
+    "round_": math.round,
+    "floor_": math.floor,
+    "ceil_": math.ceil,
+    "abs_": math.abs,
+    "subtract_": math.subtract,
+    "add_": math.add,
+    "multiply_": math.multiply,
+}
+
+for _name, _fn in _INPLACE.items():
+    if not hasattr(Tensor, _name):
+        setattr(Tensor, _name, _make_inplace(_fn))
+
+
+def _zero_(self):
+    self._value = jnp.zeros_like(self._value)
+    return self
+
+
+def _fill_(self, value):
+    self._value = jnp.full_like(self._value, value)
+    return self
+
+
+Tensor.zero_ = _zero_
+Tensor.fill_ = _fill_
