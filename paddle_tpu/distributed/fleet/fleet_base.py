@@ -129,13 +129,25 @@ class Fleet:
         from ...parallel.spmd import make_sharded_train_step
         st = self._strategy or DistributedStrategy()
         opt = getattr(optimizer, "user_defined_optimizer", optimizer)
+        if st.localsgd or st.adaptive_localsgd:
+            from ...parallel.localsgd import make_local_train_step
+            cfg = (st.adaptive_localsgd_configs if st.adaptive_localsgd
+                   else st.localsgd_configs)
+            return make_local_train_step(
+                layer, opt, loss_fn, mesh=get_mesh(),
+                k_steps=cfg.get("init_k_steps", cfg.get("k_steps", 4)),
+                begin_step=cfg.get("begin_step", 1),
+                adaptive=st.adaptive_localsgd)
         return make_sharded_train_step(
             layer, opt, loss_fn, mesh=get_mesh(),
             zero_stage=(st.sharding_configs.get("stage", 1)
                         if st.sharding else 0),
             sp_axis="sp" if st.sequence_parallel else None,
             recompute=st.recompute,
-            grad_dtype=("float16" if st.fp16_allreduce else None))
+            grad_dtype=("float16" if st.fp16_allreduce else None),
+            dgc=st.dgc,
+            dgc_momentum=st.dgc_configs.get("momentum", 0.9),
+            dgc_sparsity=st.dgc_configs.get("sparsity", 0.999))
 
     def minimize(self, loss, startup_program=None, parameter_list=None,
                  no_grad_set=None):

@@ -43,7 +43,12 @@ class DistributedStrategy:
         self.lamb = False
         self.lars = False
         self.dgc = False
+        self.dgc_configs = _Cfg(momentum=0.9, sparsity=0.999,
+                                rampup_step=1)
         self.localsgd = False
+        self.localsgd_configs = _Cfg(k_steps=4, begin_step=1)
+        self.adaptive_localsgd = False
+        self.adaptive_localsgd_configs = _Cfg(init_k_steps=4, begin_step=1)
         self.fp16_allreduce = False
         self.a_sync = False
         self.a_sync_configs = _Cfg(k_steps=0, geo=False)

@@ -25,7 +25,10 @@ from ..framework.functional import functionalize, get_buffers, get_params
 from ..framework.tensor import Tensor
 
 __all__ = ["to_static", "declarative", "save", "load", "TranslatedLayer",
-           "not_to_static"]
+           "not_to_static", "ProgramTranslator", "enable_to_static",
+           "dy2static"]
+
+from .dy2static import ProgramTranslator, ast_transform, enable_to_static
 
 
 def _split_tensors(args, kwargs):
@@ -51,6 +54,9 @@ class StaticFunction:
         elif isinstance(function, Layer):
             self._layer = function
             self._function = function.forward
+        # dygraph_to_static AST pass: data-dependent python control flow
+        # becomes lax.cond/while_loop (reference ast_transformer.py)
+        self._function = ast_transform(self._function)
         self._apply_fn = None
         self._fwd_cache: Dict[Any, Callable] = {}
         self._bwd_cache: Dict[Any, Callable] = {}

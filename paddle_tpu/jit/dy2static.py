@@ -1,0 +1,468 @@
+"""Dygraph→static AST transpiler (reference
+`fluid/dygraph/dygraph_to_static/` — `ast_transformer.py`,
+`convert_operators.py`, `program_translator.py:756 ProgramTranslator`).
+
+The reference rewrites Python source so data-dependent control flow becomes
+graph ops (`while_op`, `conditional_block_op`).  The TPU-native equivalent
+rewrites the same constructs into *runtime-dispatched converter calls* that
+lower to `lax.cond` / `lax.while_loop` when the predicate is a traced
+value, and run plain Python otherwise:
+
+  * ``if``/ternary on a traced pred      → `lax.cond`
+  * ``while`` with a traced condition    → `lax.while_loop`
+  * ``for i in range(traced_n)``         → while-loop lowering
+  * ``and`` / ``or`` / ``not`` on tensors → `logical_and/or/not`
+
+Static control flow (python bools, static ranges) is untouched — XLA
+prefers unrolled/static structure, so only genuinely data-dependent
+branches pay for `lax` control-flow ops.
+"""
+from __future__ import annotations
+
+import ast
+import functools
+import inspect
+import textwrap
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..framework.tensor import Tensor
+
+__all__ = ["ast_transform", "ProgramTranslator", "enable_to_static",
+           "convert_ifelse", "convert_while", "convert_bool_op",
+           "convert_not", "range_cond"]
+
+_ENABLED = True
+
+
+def enable_to_static(flag=True):
+    global _ENABLED
+    _ENABLED = bool(flag)
+
+
+class ProgramTranslator:
+    """reference `program_translator.py:756` — global on/off switch."""
+    _instance = None
+
+    def __new__(cls):
+        if cls._instance is None:
+            cls._instance = super().__new__(cls)
+        return cls._instance
+
+    def enable(self, flag):
+        enable_to_static(flag)
+
+    @property
+    def enable_to_static(self):
+        return _ENABLED
+
+
+# ---------------------------------------------------------------------------
+# runtime converters
+# ---------------------------------------------------------------------------
+
+def _raw(x):
+    return x._value if isinstance(x, Tensor) else x
+
+
+def _is_traced(x):
+    return isinstance(_raw(x), jax.core.Tracer)
+
+
+def _unwrap_tree(t):
+    return jax.tree_util.tree_map(
+        lambda x: x._value if isinstance(x, Tensor) else x, t,
+        is_leaf=lambda x: isinstance(x, Tensor))
+
+
+def _wrap_tree(t):
+    def one(x):
+        if isinstance(x, (jax.Array, jax.core.Tracer)):
+            return Tensor(x)
+        return x
+    return jax.tree_util.tree_map(one, t)
+
+
+class _Undefined:
+    """Placeholder for names not yet bound before a branch/loop assigns
+    them (reference `dygraph_to_static/utils.py` UndefinedVar)."""
+
+    def __repr__(self):
+        return "<dy2static undefined>"
+
+
+UNDEF = _Undefined()
+
+
+def convert_ifelse(pred, true_fn, false_fn, init=()):
+    """`if` with runtime dispatch (reference convert_ifelse).  Branch fns
+    receive `init` (the pre-branch values of every name either branch
+    assigns) so rebinding inside them never shadows the closure."""
+    p = _raw(pred)
+    if isinstance(p, jax.core.Tracer):
+        # UNDEF placeholders can't ride the cond operand — route them
+        # around it statically (the branch that uses one must assign it)
+        leaves, treedef = jax.tree_util.tree_flatten(
+            _unwrap_tree(init),
+            is_leaf=lambda x: isinstance(x, _Undefined))
+        idx = [i for i, l in enumerate(leaves)
+               if not isinstance(l, _Undefined)]
+
+        def runner(fn):
+            def run(op_leaves):
+                ls = list(leaves)
+                for i, v in zip(idx, op_leaves):
+                    ls[i] = v
+                rebuilt = jax.tree_util.tree_unflatten(treedef, ls)
+                return _unwrap_tree(fn(_wrap_tree(rebuilt)))
+            return run
+        out = lax.cond(jnp.asarray(p).astype(bool).reshape(()),
+                       runner(true_fn), runner(false_fn),
+                       [leaves[i] for i in idx])
+        return _wrap_tree(out)
+    return true_fn(init) if p else false_fn(init)
+
+
+def convert_while(cond_fn, body_fn, init):
+    """`while` with runtime dispatch (reference convert_while_loop)."""
+    c = cond_fn(init)
+    if _is_traced(c):
+        undef = [l for l in jax.tree_util.tree_leaves(
+            init, is_leaf=lambda x: isinstance(x, _Undefined))
+            if isinstance(l, _Undefined)]
+        if undef:
+            raise ValueError(
+                "dy2static: a variable assigned only inside a traced "
+                "`while`/`for` cannot be loop-carried — initialize it "
+                "before the loop (lax.while_loop needs a fixed carry)")
+
+        def cond_w(carry):
+            r = _raw(cond_fn(_wrap_tree(carry)))
+            return jnp.asarray(r).astype(bool).reshape(())
+
+        def body_w(carry):
+            return _unwrap_tree(body_fn(_wrap_tree(carry)))
+        return _wrap_tree(lax.while_loop(cond_w, body_w,
+                                         _unwrap_tree(init)))
+    vars_ = init
+    while True:
+        cv = _raw(cond_fn(vars_))
+        if not bool(cv):
+            return vars_
+        vars_ = body_fn(vars_)
+
+
+def convert_bool_op(op, *operand_fns):
+    """`and`/`or` preserving python short-circuit for concrete values and
+    lowering to elementwise logical ops for traced ones."""
+    val = operand_fns[0]()
+    for f in operand_fns[1:]:
+        v = _raw(val)
+        if isinstance(v, jax.core.Tracer):
+            r = _raw(f())
+            a = jnp.asarray(v).astype(bool)
+            b = jnp.asarray(r).astype(bool)
+            val = Tensor(jnp.logical_and(a, b) if op == "and"
+                         else jnp.logical_or(a, b))
+        elif op == "and":
+            if not v:
+                return val
+            val = f()
+        else:
+            if v:
+                return val
+            val = f()
+    return val
+
+
+def convert_not(x):
+    v = _raw(x)
+    if isinstance(v, jax.core.Tracer):
+        return Tensor(jnp.logical_not(jnp.asarray(v).astype(bool)))
+    return not v
+
+
+def range_cond(i, stop, step):
+    """Direction-aware `for ... in range(...)` continuation test."""
+    iv, sv, stv = _raw(i), _raw(stop), _raw(step)
+    if any(isinstance(v, jax.core.Tracer) for v in (iv, sv, stv)):
+        iv = jnp.asarray(iv)
+        fwd = jnp.logical_and(jnp.asarray(stv) > 0, iv < jnp.asarray(sv))
+        bwd = jnp.logical_and(jnp.asarray(stv) < 0, iv > jnp.asarray(sv))
+        return Tensor(jnp.logical_or(fwd, bwd))
+    return (iv < sv) if stv > 0 else (iv > sv)
+
+
+# ---------------------------------------------------------------------------
+# static analysis helpers
+# ---------------------------------------------------------------------------
+
+_SKIP_SCOPES = (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef,
+                ast.Lambda)
+
+
+def _stored_names(stmts):
+    """Names assigned at the top scope of `stmts` (nested defs excluded)."""
+    out = []
+
+    def walk(node):
+        if isinstance(node, _SKIP_SCOPES):
+            return
+        if isinstance(node, ast.Name) and isinstance(node.ctx,
+                                                     (ast.Store, ast.Del)):
+            if not node.id.startswith("__dy2s"):
+                out.append(node.id)
+        for child in ast.iter_child_nodes(node):
+            walk(child)
+    for s in stmts:
+        walk(s)
+    seen, uniq = set(), []
+    for n in out:
+        if n not in seen:
+            seen.add(n)
+            uniq.append(n)
+    return uniq
+
+
+def _contains(stmts, types):
+    for s in stmts:
+        for node in ast.walk(s):
+            if isinstance(node, types) and not isinstance(node,
+                                                          _SKIP_SCOPES):
+                return True
+    return False
+
+
+def _name(id_, ctx=None):
+    return ast.Name(id=id_, ctx=ctx or ast.Load())
+
+
+def _tuple_of(names, ctx=None):
+    return ast.Tuple(elts=[_name(n, ctx or ast.Load()) for n in names],
+                     ctx=ctx or ast.Load())
+
+
+def _jst_attr(fn_name):
+    return ast.Attribute(value=_name("_jst"), attr=fn_name, ctx=ast.Load())
+
+
+def _make_fn(name, args, body):
+    return ast.FunctionDef(
+        name=name,
+        args=ast.arguments(posonlyargs=[], args=[ast.arg(arg=a)
+                                                 for a in args],
+                           kwonlyargs=[], kw_defaults=[], defaults=[]),
+        body=body, decorator_list=[], returns=None, type_params=[])
+
+
+def _guard(name):
+    """`try: name / except NameError: name = _jst.UNDEF` — lets possibly
+    unbound names ride the init tuple (reference UndefinedVar filling)."""
+    return ast.parse(
+        f"try:\n    {name}\nexcept NameError:\n"
+        f"    {name} = _jst.UNDEF").body[0]
+
+
+# ---------------------------------------------------------------------------
+# the AST transformer
+# ---------------------------------------------------------------------------
+
+class Dy2StaticTransformer(ast.NodeTransformer):
+    """One bottom-up pass over a function body (reference splits this into
+    14 transformer modules; the converter-dispatch design needs only the
+    control-flow and boolean rewrites)."""
+
+    def __init__(self):
+        self._n = 0
+
+    def _uid(self):
+        self._n += 1
+        return self._n
+
+    # -- boolean operators --------------------------------------------------
+    def visit_BoolOp(self, node):
+        self.generic_visit(node)
+        op = "and" if isinstance(node.op, ast.And) else "or"
+        lambdas = [ast.Lambda(
+            args=ast.arguments(posonlyargs=[], args=[], kwonlyargs=[],
+                               kw_defaults=[], defaults=[]),
+            body=v) for v in node.values]
+        return ast.Call(func=_jst_attr("convert_bool_op"),
+                        args=[ast.Constant(value=op)] + lambdas, keywords=[])
+
+    def visit_UnaryOp(self, node):
+        self.generic_visit(node)
+        if isinstance(node.op, ast.Not):
+            return ast.Call(func=_jst_attr("convert_not"),
+                            args=[node.operand], keywords=[])
+        return node
+
+    def visit_IfExp(self, node):
+        self.generic_visit(node)
+        mk = lambda b: ast.Lambda(
+            args=ast.arguments(posonlyargs=[], args=[], kwonlyargs=[],
+                               kw_defaults=[], defaults=[]), body=b)
+        return ast.Call(func=_jst_attr("convert_ifelse"),
+                        args=[node.test, mk(node.body), mk(node.orelse)],
+                        keywords=[])
+
+    # -- if / else ----------------------------------------------------------
+    def visit_If(self, node):
+        self.generic_visit(node)
+        uid = self._uid()
+        body, orelse = node.body, node.orelse or [ast.Pass()]
+
+        has_ret_b = _contains(body, ast.Return)
+        has_ret_o = _contains(orelse, ast.Return)
+        op = f"__dy2s_op_{uid}"
+        if has_ret_b or has_ret_o:
+            # only the both-branches-end-in-return shape is convertible
+            if (has_ret_b and has_ret_o
+                    and isinstance(body[-1], ast.Return)
+                    and isinstance(orelse[-1], ast.Return)
+                    and not _contains(body[:-1], ast.Return)
+                    and not _contains(orelse[:-1], ast.Return)):
+                tfn = _make_fn(f"__dy2s_true_{uid}", [op],
+                               body[:-1] + [ast.Return(
+                                   value=body[-1].value
+                                   or ast.Constant(value=None))])
+                ffn = _make_fn(f"__dy2s_false_{uid}", [op],
+                               orelse[:-1] + [ast.Return(
+                                   value=orelse[-1].value
+                                   or ast.Constant(value=None))])
+                call = ast.Call(func=_jst_attr("convert_ifelse"),
+                                args=[node.test,
+                                      _name(tfn.name), _name(ffn.name)],
+                                keywords=[])
+                return [tfn, ffn, ast.Return(value=call)]
+            return node  # mixed return shape: leave as python `if`
+
+        assigned = _stored_names(body + orelse)
+        ret = lambda: (_tuple_of(assigned) if assigned
+                       else ast.Tuple(elts=[], ctx=ast.Load()))
+        unpack = lambda: ([ast.Assign(
+            targets=[_tuple_of(assigned, ast.Store())],
+            value=_name(op))] if assigned else [])
+        tfn = _make_fn(f"__dy2s_true_{uid}", [op],
+                       unpack() + body + [ast.Return(value=ret())])
+        ffn = _make_fn(f"__dy2s_false_{uid}", [op],
+                       unpack() + orelse + [ast.Return(value=ret())])
+        call = ast.Call(func=_jst_attr("convert_ifelse"),
+                        args=[node.test, _name(tfn.name), _name(ffn.name),
+                              ret()], keywords=[])
+        guards = [_guard(n) for n in assigned]
+        if assigned:
+            out = ast.Assign(targets=[_tuple_of(assigned, ast.Store())],
+                             value=call)
+        else:
+            out = ast.Expr(value=call)
+        return guards + [tfn, ffn, out]
+
+    # -- while --------------------------------------------------------------
+    def visit_While(self, node):
+        self.generic_visit(node)
+        if node.orelse or _contains(node.body, (ast.Break, ast.Continue)):
+            return node  # break/continue: python-only semantics
+        uid = self._uid()
+        carried = _stored_names(node.body)
+        return self._lower_loop(uid, node.test, node.body, carried)
+
+    def _lower_loop(self, uid, test, body, carried):
+        var = f"__dy2s_vars_{uid}"
+        unpack = lambda: ([ast.Assign(
+            targets=[_tuple_of(carried, ast.Store())],
+            value=_name(var))] if carried else [])
+        tup = lambda: (_tuple_of(carried) if carried
+                       else ast.Tuple(elts=[], ctx=ast.Load()))
+        cond_fn = _make_fn(f"__dy2s_cond_{uid}", [var],
+                           unpack() + [ast.Return(value=test)])
+        body_fn = _make_fn(f"__dy2s_body_{uid}", [var],
+                           unpack() + body + [ast.Return(value=tup())])
+        call = ast.Call(func=_jst_attr("convert_while"),
+                        args=[_name(cond_fn.name), _name(body_fn.name),
+                              tup()], keywords=[])
+        guards = [_guard(n) for n in carried]
+        if carried:
+            out = ast.Assign(targets=[_tuple_of(carried, ast.Store())],
+                             value=call)
+        else:
+            out = ast.Expr(value=call)
+        return guards + [cond_fn, body_fn, out]
+
+    # -- for i in range(...) -------------------------------------------------
+    def visit_For(self, node):
+        self.generic_visit(node)
+        if (node.orelse
+                or _contains(node.body, (ast.Break, ast.Continue))
+                or not isinstance(node.target, ast.Name)
+                or not (isinstance(node.iter, ast.Call)
+                        and isinstance(node.iter.func, ast.Name)
+                        and node.iter.func.id == "range"
+                        and not node.iter.keywords
+                        and 1 <= len(node.iter.args) <= 3)):
+            return node
+        uid = self._uid()
+        a = node.iter.args
+        start = a[0] if len(a) >= 2 else ast.Constant(value=0)
+        stop = a[1] if len(a) >= 2 else a[0]
+        step = a[2] if len(a) == 3 else ast.Constant(value=1)
+        i = node.target.id
+        s_stop, s_step = f"__dy2s_stop_{uid}", f"__dy2s_step_{uid}"
+        pre = [ast.Assign(targets=[_name(s_stop, ast.Store())], value=stop),
+               ast.Assign(targets=[_name(s_step, ast.Store())], value=step),
+               ast.Assign(targets=[_name(i, ast.Store())], value=start)]
+        test = ast.Call(func=_jst_attr("range_cond"),
+                        args=[_name(i), _name(s_stop), _name(s_step)],
+                        keywords=[])
+        incr = ast.Assign(
+            targets=[_name(i, ast.Store())],
+            value=ast.BinOp(left=_name(i), op=ast.Add(),
+                            right=_name(s_step)))
+        seen = set()
+        carried = [n for n in _stored_names(node.body) + [i]
+                   if not (n in seen or seen.add(n))]
+        return pre + self._lower_loop(uid, test, node.body + [incr],
+                                      carried)
+
+
+# ---------------------------------------------------------------------------
+# entry
+# ---------------------------------------------------------------------------
+
+def ast_transform(fn):
+    """Rewrite `fn`'s control flow into converter calls.  Falls back to the
+    original function when source is unavailable or the rewrite fails."""
+    if not _ENABLED or getattr(fn, "_not_to_static", False):
+        return fn
+    bound_self = getattr(fn, "__self__", None)
+    raw = fn.__func__ if inspect.ismethod(fn) else fn
+    if getattr(raw, "__dy2static_transformed__", False):
+        return fn
+    try:
+        src = textwrap.dedent(inspect.getsource(raw))
+        tree = ast.parse(src)
+        fdef = tree.body[0]
+        if not isinstance(fdef, ast.FunctionDef):
+            return fn
+        fdef.decorator_list = []
+        Dy2StaticTransformer().visit(fdef)
+        ast.fix_missing_locations(tree)
+        ns = dict(raw.__globals__)
+        from . import dy2static as _jst_mod
+        ns["_jst"] = _jst_mod
+        if raw.__closure__:
+            ns.update(zip(raw.__code__.co_freevars,
+                          (c.cell_contents for c in raw.__closure__)))
+        code = compile(tree, filename=f"<dy2static:{raw.__qualname__}>",
+                       mode="exec")
+        exec(code, ns)
+        new_fn = ns[fdef.name]
+    except Exception:
+        return fn
+    functools.update_wrapper(new_fn, raw)
+    new_fn.__dy2static_transformed__ = True
+    if bound_self is not None:
+        return new_fn.__get__(bound_self, type(bound_self))
+    return new_fn

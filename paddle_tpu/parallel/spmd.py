@@ -120,7 +120,9 @@ def shard_params(layer, mesh=None):
 def make_sharded_train_step(layer, optimizer, loss_fn: Callable,
                             mesh=None, zero_stage=1, dp_axis="dp",
                             sp_axis=None, recompute=False,
-                            donate=True, grad_dtype=None):
+                            donate=True, grad_dtype=None,
+                            dgc=False, dgc_momentum=0.9,
+                            dgc_sparsity=0.999):
     """Returns (step, state) where
       state = {params, buffers, opt_state, step_no}
       step(state, inputs, labels, lr, rng) -> (state, loss)
@@ -166,6 +168,13 @@ def make_sharded_train_step(layer, optimizer, loss_fn: Callable,
                                          state["step_no"])
         (lv, new_bufs), grads = jax.value_and_grad(loss_of, has_aux=True)(
             pv_, bv_, rng, inputs, labels)
+        new_dgc = None
+        if dgc:
+            # DGC on the global gradient: top-k + momentum correction +
+            # error feedback (see compression.py for the dataflow note)
+            from .compression import dgc_compress
+            grads, new_dgc = dgc_compress(grads, state["dgc"],
+                                          dgc_momentum, dgc_sparsity)
         if grad_dtype is not None:
             # fp16/bf16-allreduce strategy (reference
             # fp16_allreduce_optimizer.py): compress grads before the
@@ -178,12 +187,22 @@ def make_sharded_train_step(layer, optimizer, loss_fn: Callable,
             grads, pv_, opt_state_, lr, step_no + 1)
         new_state = {"params": new_pv, "buffers": new_bufs,
                      "opt_state": new_opt, "step_no": step_no + 1}
+        if new_dgc is not None:
+            new_state["dgc"] = new_dgc
         return new_state, lv
 
     state_sharding = {
         "params": p_shard, "buffers": {n: repl for n in bv},
         "opt_state": o_shard, "step_no": repl,
     }
+    if dgc:
+        from .compression import dgc_init
+        dgc_state = dgc_init(pv)
+        dgc_shard = {n: {"u": p_shard[n], "v": p_shard[n]}
+                     for n in dgc_state}
+        dgc_state = jax.tree_util.tree_map(
+            lambda v, s: jax.device_put(v, s), dgc_state, dgc_shard)
+        state_sharding["dgc"] = dgc_shard
     jit_step = jax.jit(
         step_fn,
         out_shardings=(state_sharding, repl),
@@ -191,6 +210,8 @@ def make_sharded_train_step(layer, optimizer, loss_fn: Callable,
 
     state = {"params": pv, "buffers": bv, "opt_state": opt_state,
              "step_no": jnp.zeros((), "int32")}
+    if dgc:
+        state["dgc"] = dgc_state
 
     def step(state, inputs, labels, lr=None, rng=None):
         inputs = tuple(

@@ -1,0 +1,139 @@
+"""dygraph→static AST transpiler tests (reference
+`dygraph_to_static/test_ifelse.py`, `test_loop.py`, `test_logical.py` —
+same eager-vs-to_static parity contract)."""
+import numpy as np
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+from paddle_tpu.jit import to_static
+from paddle_tpu.jit.dy2static import (ProgramTranslator, ast_transform,
+                                      enable_to_static)
+
+
+def _t(a):
+    return paddle.to_tensor(np.asarray(a, dtype="float32"))
+
+
+def test_data_dependent_if():
+    def f(x):
+        if x.sum() > 0:
+            y = x * 2.0
+        else:
+            y = x - 1.0
+        return y
+
+    sf = to_static(f)
+    for arr in ([1.0, 2.0], [-3.0, -4.0]):
+        x = _t(arr)
+        np.testing.assert_allclose(sf(x).numpy(), f(x).numpy())
+
+
+def test_if_both_branches_return():
+    def f(x):
+        if x.mean() > 1.0:
+            return x * 10.0
+        else:
+            return x + 100.0
+
+    sf = to_static(f)
+    np.testing.assert_allclose(sf(_t([5.0])).numpy(), [50.0])
+    np.testing.assert_allclose(sf(_t([0.0])).numpy(), [100.0])
+
+
+def test_data_dependent_while():
+    def f(x):
+        s = x
+        while s.sum() < 100.0:
+            s = s * 2.0
+        return s
+
+    sf = to_static(f)
+    x = _t([1.0, 2.0])
+    np.testing.assert_allclose(sf(x).numpy(), f(x).numpy())
+
+
+def test_for_over_tensor_range():
+    def f(x, n):
+        acc = x * 0.0
+        for i in range(n):
+            acc = acc + x + (i * 0.0)
+        return acc
+
+    sf = to_static(f)
+    x = _t([1.0, 3.0])
+    n = paddle.to_tensor(np.asarray(4, dtype="int32"))
+    np.testing.assert_allclose(sf(x, n).numpy(), [4.0, 12.0])
+
+
+def test_static_for_stays_python():
+    def f(x):
+        acc = x
+        for i in range(3):
+            acc = acc + 1.0
+        return acc
+
+    sf = to_static(f)
+    np.testing.assert_allclose(sf(_t([1.0])).numpy(), [4.0])
+
+
+def test_bool_ops_on_tensors():
+    def f(x):
+        if (x.sum() > 0.0) and (x.mean() < 10.0):
+            return x + 1.0
+        else:
+            return x - 1.0
+
+    sf = to_static(f)
+    np.testing.assert_allclose(sf(_t([1.0])).numpy(), [2.0])
+    np.testing.assert_allclose(sf(_t([-1.0])).numpy(), [-2.0])
+    np.testing.assert_allclose(sf(_t([50.0])).numpy(), [49.0])
+
+
+def test_python_bool_short_circuit_preserved():
+    calls = []
+
+    def g():
+        calls.append(1)
+        return True
+
+    def f(flag):
+        return bool(flag and g())
+
+    tf = ast_transform(f)
+    assert tf(False) is False
+    assert calls == []
+    assert tf(True) is True
+    assert calls == [1]
+
+
+def test_nested_if_in_layer_forward():
+    class Net(nn.Layer):
+        def __init__(self):
+            super().__init__()
+            self.fc = nn.Linear(4, 4)
+
+        def forward(self, x):
+            h = self.fc(x)
+            if h.sum() > 0:
+                h = h * 2.0
+            else:
+                h = h * 0.5
+            return h
+
+    paddle.seed(3)
+    net = Net()
+    x = _t(np.ones((2, 4)))
+    eager = net(x).numpy()
+    net.forward = to_static(net.forward)
+    np.testing.assert_allclose(net(x).numpy(), eager, rtol=1e-6)
+
+
+def test_program_translator_disable():
+    ProgramTranslator().enable(False)
+    try:
+        def f(x):
+            return x * 1.0
+        assert ast_transform(f) is f
+    finally:
+        enable_to_static(True)
+    assert ProgramTranslator().enable_to_static
