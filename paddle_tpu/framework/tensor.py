@@ -376,8 +376,6 @@ def to_tensor(data, dtype=None, place=None, stop_gradient=True):
         arr = np.asarray(data)
         if dtype is None and arr.dtype == np.float64:
             arr = arr.astype(np.float32)  # paddle default float32
-        if dtype is None and arr.dtype == np.int64 and False:
-            pass
         v = jnp.asarray(arr)
     if dtype is not None:
         v = v.astype(to_jax_dtype(dtype))

@@ -31,8 +31,8 @@ from jax import lax
 from ..framework.tensor import Tensor
 
 __all__ = ["ast_transform", "ProgramTranslator", "enable_to_static",
-           "convert_ifelse", "convert_while", "convert_bool_op",
-           "convert_not", "range_cond"]
+           "convert_ifelse", "convert_while", "convert_for_range",
+           "convert_bool_op", "convert_not"]
 
 _ENABLED = True
 
@@ -96,12 +96,23 @@ class _Undefined:
 UNDEF = _Undefined()
 
 
-def convert_ifelse(pred, true_fn, false_fn, init=()):
+def convert_ifelse(pred, true_fn, false_fn, init=(), single=None):
     """`if` with runtime dispatch (reference convert_ifelse).  Branch fns
     receive `init` (the pre-branch values of every name either branch
-    assigns) so rebinding inside them never shadows the closure."""
+    assigns) so rebinding inside them never shadows the closure.
+
+    ``single`` marks init slots whose name is assigned in only ONE branch.
+    When such a name is also unbound before the `if`, the two branches
+    would return mismatched structures under `lax.cond` — those slots are
+    kept branch-local and stay undefined after the if, matching Python's
+    untaken-branch behavior."""
     p = _raw(pred)
     if isinstance(p, jax.core.Tracer):
+        init = tuple(init)
+        single = tuple(single) if single is not None \
+            else (False,) * len(init)
+        dropped = {j for j in range(len(init))
+                   if single[j] and isinstance(init[j], _Undefined)}
         # UNDEF placeholders can't ride the cond operand — route them
         # around it statically (the branch that uses one must assign it)
         leaves, treedef = jax.tree_util.tree_flatten(
@@ -116,12 +127,20 @@ def convert_ifelse(pred, true_fn, false_fn, init=()):
                 for i, v in zip(idx, op_leaves):
                     ls[i] = v
                 rebuilt = jax.tree_util.tree_unflatten(treedef, ls)
-                return _unwrap_tree(fn(_wrap_tree(rebuilt)))
+                out = fn(_wrap_tree(rebuilt))
+                if dropped:
+                    out = tuple(0 if j in dropped else v
+                                for j, v in enumerate(tuple(out)))
+                return _unwrap_tree(out)
             return run
         out = lax.cond(jnp.asarray(p).astype(bool).reshape(()),
                        runner(true_fn), runner(false_fn),
                        [leaves[i] for i in idx])
-        return _wrap_tree(out)
+        res = _wrap_tree(out)
+        if dropped:
+            res = tuple(UNDEF if j in dropped else v
+                        for j, v in enumerate(tuple(res)))
+        return res
     return true_fn(init) if p else false_fn(init)
 
 
@@ -184,15 +203,68 @@ def convert_not(x):
     return not v
 
 
-def range_cond(i, stop, step):
-    """Direction-aware `for ... in range(...)` continuation test."""
-    iv, sv, stv = _raw(i), _raw(stop), _raw(step)
-    if any(isinstance(v, jax.core.Tracer) for v in (iv, sv, stv)):
-        iv = jnp.asarray(iv)
-        fwd = jnp.logical_and(jnp.asarray(stv) > 0, iv < jnp.asarray(sv))
-        bwd = jnp.logical_and(jnp.asarray(stv) < 0, iv > jnp.asarray(sv))
-        return Tensor(jnp.logical_or(fwd, bwd))
-    return (iv < sv) if stv > 0 else (iv > sv)
+def convert_for_range(start, stop, step, body_fn, init):
+    """``for i in range(...)`` with runtime dispatch.  The loop variable is
+    element 0 of ``init`` and of the carry ``body_fn`` receives/returns.
+
+    Concrete bounds run a plain Python ``for`` (exact CPython semantics:
+    the loop variable keeps its last-iterated value, an empty range leaves
+    it untouched).  Traced bounds lower to ``lax.while_loop`` over a
+    precomputed trip count, with the loop variable reconstructed as
+    ``start + k*step`` — never the post-loop overshoot value."""
+    sv, tv, pv = _raw(start), _raw(stop), _raw(step)
+    init = tuple(init)
+    if not any(isinstance(v, jax.core.Tracer) for v in (sv, tv, pv)):
+        vars_ = init
+        for iv in range(int(sv), int(tv), int(pv)):
+            vars_ = tuple(body_fn((iv,) + tuple(vars_[1:])))
+        return vars_
+    undef = [l for l in jax.tree_util.tree_leaves(
+        list(init[1:]), is_leaf=lambda x: isinstance(x, _Undefined))
+        if isinstance(l, _Undefined)]
+    if undef:
+        raise ValueError(
+            "dy2static: a variable assigned only inside a traced `for` "
+            "cannot be loop-carried — initialize it before the loop "
+            "(lax.while_loop needs a fixed carry)")
+    start_a = jnp.asarray(sv)
+    if not jnp.issubdtype(start_a.dtype, jnp.integer):
+        start_a = start_a.astype("int32")
+    stop_a = jnp.asarray(tv).astype(start_a.dtype)
+    step_a = jnp.asarray(pv).astype(start_a.dtype)
+    # integer ceil-division trip count (exact; float32 loses precision
+    # past 2**24): ceil((stop-start)/step) == -((start-stop)//step).
+    # step==0 (ValueError in CPython) degenerates to zero iterations; the
+    # divisor is swapped to 1 because XLA evaluates both where() branches.
+    safe_step = jnp.where(step_a == 0, jnp.ones_like(step_a), step_a)
+    n_iter = jnp.where(
+        step_a == 0, 0,
+        jnp.maximum(-((start_a - stop_a) // safe_step), 0))
+    # the loop-var carry slot must match iv's dtype; a pre-bound value is
+    # cast in, and restored after the loop for the n_iter==0 case
+    i0 = start_a if isinstance(init[0], _Undefined) \
+        else jnp.asarray(_raw(init[0])).astype(start_a.dtype)
+    carry0 = (jnp.asarray(0, "int32"),
+              _unwrap_tree((i0,) + tuple(init[1:])))
+
+    def cond_w(c):
+        return c[0] < n_iter.astype(c[0].dtype)
+
+    def body_w(c):
+        k, vars_ = c
+        iv = start_a + k.astype(start_a.dtype) * step_a
+        new_vars = _unwrap_tree(tuple(body_fn(
+            _wrap_tree((iv,) + tuple(vars_[1:])))))
+        return k + jnp.asarray(1, "int32"), new_vars
+
+    _, out = lax.while_loop(cond_w, body_w, carry0)
+    out = list(out)
+    if not isinstance(init[0], _Undefined):
+        # empty traced range must leave the pre-bound loop var untouched
+        # (including non-integer values the carry slot had to truncate)
+        orig = jnp.asarray(_raw(init[0]))
+        out[0] = jnp.where(n_iter > 0, out[0].astype(orig.dtype), orig)
+    return _wrap_tree(tuple(out))
 
 
 # ---------------------------------------------------------------------------
@@ -201,21 +273,36 @@ def range_cond(i, stop, step):
 
 _SKIP_SCOPES = (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef,
                 ast.Lambda)
+# comprehensions own their iteration targets in py3 — scope boundaries too
+_COMPREHENSION_SCOPES = (ast.ListComp, ast.SetComp, ast.DictComp,
+                         ast.GeneratorExp)
 
 
 def _stored_names(stmts):
-    """Names assigned at the top scope of `stmts` (nested defs excluded)."""
+    """Names assigned at the top scope of `stmts` (nested defs and
+    comprehension iteration variables excluded; walrus targets inside
+    comprehensions DO bind in the enclosing scope — PEP 572)."""
     out = []
 
-    def walk(node):
+    def walk(node, in_comp=False):
         if isinstance(node, _SKIP_SCOPES):
             return
-        if isinstance(node, ast.Name) and isinstance(node.ctx,
-                                                     (ast.Store, ast.Del)):
+        if isinstance(node, _COMPREHENSION_SCOPES):
+            for child in ast.iter_child_nodes(node):
+                walk(child, True)
+            return
+        if isinstance(node, ast.NamedExpr):
+            if (isinstance(node.target, ast.Name)
+                    and not node.target.id.startswith("__dy2s")):
+                out.append(node.target.id)
+            walk(node.value, in_comp)
+            return
+        if (not in_comp and isinstance(node, ast.Name)
+                and isinstance(node.ctx, (ast.Store, ast.Del))):
             if not node.id.startswith("__dy2s"):
                 out.append(node.id)
         for child in ast.iter_child_nodes(node):
-            walk(child)
+            walk(child, in_comp)
     for s in stmts:
         walk(s)
     seen, uniq = set(), []
@@ -301,9 +388,13 @@ class Dy2StaticTransformer(ast.NodeTransformer):
 
     def visit_IfExp(self, node):
         self.generic_visit(node)
+        # convert_ifelse always calls branch fns with one arg (the init
+        # tuple) — the lambdas must accept and ignore it
         mk = lambda b: ast.Lambda(
-            args=ast.arguments(posonlyargs=[], args=[], kwonlyargs=[],
-                               kw_defaults=[], defaults=[]), body=b)
+            args=ast.arguments(posonlyargs=[],
+                               args=[ast.arg(arg="__dy2s_op")],
+                               kwonlyargs=[], kw_defaults=[], defaults=[]),
+            body=b)
         return ast.Call(func=_jst_attr("convert_ifelse"),
                         args=[node.test, mk(node.body), mk(node.orelse)],
                         keywords=[])
@@ -340,6 +431,9 @@ class Dy2StaticTransformer(ast.NodeTransformer):
             return node  # mixed return shape: leave as python `if`
 
         assigned = _stored_names(body + orelse)
+        b_names = set(_stored_names(body))
+        o_names = set(_stored_names(orelse))
+        single = [(n in b_names) != (n in o_names) for n in assigned]
         ret = lambda: (_tuple_of(assigned) if assigned
                        else ast.Tuple(elts=[], ctx=ast.Load()))
         unpack = lambda: ([ast.Assign(
@@ -351,7 +445,13 @@ class Dy2StaticTransformer(ast.NodeTransformer):
                        unpack() + orelse + [ast.Return(value=ret())])
         call = ast.Call(func=_jst_attr("convert_ifelse"),
                         args=[node.test, _name(tfn.name), _name(ffn.name),
-                              ret()], keywords=[])
+                              ret()],
+                        keywords=[ast.keyword(
+                            arg="single",
+                            value=ast.Tuple(
+                                elts=[ast.Constant(value=s)
+                                      for s in single],
+                                ctx=ast.Load()))] if assigned else [])
         guards = [_guard(n) for n in assigned]
         if assigned:
             out = ast.Assign(targets=[_tuple_of(assigned, ast.Store())],
@@ -409,27 +509,49 @@ class Dy2StaticTransformer(ast.NodeTransformer):
         stop = a[1] if len(a) >= 2 else a[0]
         step = a[2] if len(a) == 3 else ast.Constant(value=1)
         i = node.target.id
-        s_stop, s_step = f"__dy2s_stop_{uid}", f"__dy2s_step_{uid}"
-        pre = [ast.Assign(targets=[_name(s_stop, ast.Store())], value=stop),
-               ast.Assign(targets=[_name(s_step, ast.Store())], value=step),
-               ast.Assign(targets=[_name(i, ast.Store())], value=start)]
-        test = ast.Call(func=_jst_attr("range_cond"),
-                        args=[_name(i), _name(s_stop), _name(s_step)],
-                        keywords=[])
-        incr = ast.Assign(
-            targets=[_name(i, ast.Store())],
-            value=ast.BinOp(left=_name(i), op=ast.Add(),
-                            right=_name(s_step)))
-        seen = set()
-        carried = [n for n in _stored_names(node.body) + [i]
-                   if not (n in seen or seen.add(n))]
-        return pre + self._lower_loop(uid, test, node.body + [incr],
-                                      carried)
+        # carry layout: loop var first, then everything the body assigns
+        carried = [i] + [n for n in _stored_names(node.body) if n != i]
+        var = f"__dy2s_vars_{uid}"
+        unpack = [ast.Assign(targets=[_tuple_of(carried, ast.Store())],
+                             value=_name(var))]
+        body_fn = _make_fn(f"__dy2s_body_{uid}", [var],
+                           unpack + node.body
+                           + [ast.Return(value=_tuple_of(carried))])
+        call = ast.Call(func=_jst_attr("convert_for_range"),
+                        args=[start, stop, step, _name(body_fn.name),
+                              _tuple_of(carried)], keywords=[])
+        guards = [_guard(n) for n in carried]
+        out = ast.Assign(targets=[_tuple_of(carried, ast.Store())],
+                         value=call)
+        return guards + [body_fn, out]
 
 
 # ---------------------------------------------------------------------------
 # entry
 # ---------------------------------------------------------------------------
+
+class _RewriteZeroArgSuper(ast.NodeTransformer):
+    """``super()`` → ``super(__class__, self)``.  Zero-arg super() relies
+    on the implicit ``__class__`` cell that only class-body-compiled
+    functions get; the recompiled function must reference it explicitly
+    so it closes over the factory parameter instead."""
+
+    def __init__(self, self_name):
+        self._self = self_name
+
+    def _stop(self, node):  # nested scopes have a different `self`
+        return node
+
+    visit_FunctionDef = visit_AsyncFunctionDef = _stop
+    visit_ClassDef = visit_Lambda = _stop
+
+    def visit_Call(self, node):
+        self.generic_visit(node)
+        if (isinstance(node.func, ast.Name) and node.func.id == "super"
+                and not node.args and not node.keywords):
+            node.args = [_name("__class__"), _name(self._self)]
+        return node
+
 
 def ast_transform(fn):
     """Rewrite `fn`'s control flow into converter calls.  Falls back to the
@@ -447,18 +569,29 @@ def ast_transform(fn):
         if not isinstance(fdef, ast.FunctionDef):
             return fn
         fdef.decorator_list = []
+        freevars = raw.__code__.co_freevars
+        # rewrite super() BEFORE control-flow lowering so the explicit
+        # super(__class__, self) form rides into generated branch fns
+        # (which would otherwise feed their carry tuple as super()'s obj)
+        if "__class__" in freevars and fdef.args.args:
+            _RewriteZeroArgSuper(fdef.args.args[0].arg).generic_visit(fdef)
         Dy2StaticTransformer().visit(fdef)
-        ast.fix_missing_locations(tree)
         ns = dict(raw.__globals__)
         from . import dy2static as _jst_mod
         ns["_jst"] = _jst_mod
-        if raw.__closure__:
-            ns.update(zip(raw.__code__.co_freevars,
-                          (c.cell_contents for c in raw.__closure__)))
+        if freevars:
+            # rebuild the closure with real cells: compile the transformed
+            # def inside a factory taking every freevar as a parameter
+            factory = _make_fn("__dy2s_factory", list(freevars),
+                               [fdef, ast.Return(value=_name(fdef.name))])
+            tree.body = [factory]
+        ast.fix_missing_locations(tree)
         code = compile(tree, filename=f"<dy2static:{raw.__qualname__}>",
                        mode="exec")
         exec(code, ns)
-        new_fn = ns[fdef.name]
+        new_fn = (ns["__dy2s_factory"](*(c.cell_contents
+                                         for c in raw.__closure__))
+                  if freevars else ns[fdef.name])
     except Exception:
         return fn
     functools.update_wrapper(new_fn, raw)

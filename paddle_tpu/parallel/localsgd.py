@@ -128,7 +128,7 @@ def make_local_train_step(layer, optimizer, loss_fn: Callable, mesh=None,
                                   avg_loss, state_["loss0"])
                 lr0 = jnp.where(jnp.logical_and(do_sync, first),
                                 lr, state_["lr0"])
-                next_k = jnp.floor(jnp.sqrt(
+                next_k = jnp.ceil(jnp.sqrt(
                     lr0 * avg_loss / (lr * jnp.maximum(loss0, 1e-12))
                     * float(k_steps)))
                 next_k = jnp.clip(next_k, 1, max_k_steps).astype("int32")

@@ -137,3 +137,166 @@ def test_program_translator_disable():
     finally:
         enable_to_static(True)
     assert ProgramTranslator().enable_to_static
+
+
+# ---------------------------------------------------------------------------
+# round-2 regressions (advisor findings)
+# ---------------------------------------------------------------------------
+
+def test_ternary_expression():
+    """IfExp lambdas must accept convert_ifelse's init argument."""
+    def f(x):
+        y = x * 2.0 if x.sum() > 0 else x * -1.0
+        return y
+
+    sf = to_static(f)
+    np.testing.assert_allclose(sf(_t([1.0, 2.0])).numpy(), [2.0, 4.0])
+    np.testing.assert_allclose(sf(_t([-1.0, -2.0])).numpy(), [1.0, 2.0])
+
+
+def test_static_for_loop_var_value_after_loop():
+    """After `for i in range(3)`, CPython leaves i == 2 (not 3)."""
+    def f(x):
+        i = -1.0
+        for i in range(3):
+            x = x + 1.0
+        return x + i
+
+    sf = to_static(f)
+    # eager: x=1+3=4, i=2 → 6
+    np.testing.assert_allclose(sf(_t([1.0])).numpy(), [6.0])
+
+
+def test_empty_static_range_leaves_loop_var_untouched():
+    def f(x):
+        i = 7.0
+        for i in range(0):
+            x = x + 100.0
+        return x + i
+
+    sf = to_static(f)
+    np.testing.assert_allclose(sf(_t([1.0])).numpy(), [8.0])
+
+
+def test_traced_for_loop_var_no_overshoot():
+    # loop var after a traced range(n) keeps CPython's n-1 last value
+    def g(x, n):
+        i = 0
+        for i in range(n):
+            x = x + 0.0
+        return x * 0.0 + i
+
+    sg = to_static(g)
+    n = paddle.to_tensor(np.asarray(4, dtype="int32"))
+    np.testing.assert_allclose(sg(_t([1.0]), n).numpy(), [3.0])
+
+
+def test_zero_arg_super_in_transformed_method():
+    class Base(nn.Layer):
+        def forward(self, x):
+            return x + 1.0
+
+    class Child(Base):
+        def forward(self, x):
+            y = super().forward(x)
+            if y.sum() > 0:
+                y = y * 2.0
+            return y
+
+    net = Child()
+    x = _t([1.0, 2.0])
+    eager = net(x).numpy()
+    net.forward = to_static(net.forward)
+    np.testing.assert_allclose(net(x).numpy(), eager)
+    np.testing.assert_allclose(eager, [4.0, 6.0])
+
+
+def test_closure_freevar_in_transformed_fn():
+    scale = _t([3.0])
+
+    def f(x):
+        if x.sum() > 0:
+            y = x * scale
+        else:
+            y = x
+        return y
+
+    sf = to_static(f)
+    np.testing.assert_allclose(sf(_t([2.0])).numpy(), [6.0])
+
+
+def test_comprehension_in_branch_not_carried():
+    def f(x):
+        if x.sum() > 0:
+            parts = [x * float(k) for k in range(1, 3)]
+            y = parts[0] + parts[1]
+        else:
+            y = x
+        return y
+
+    sf = to_static(f)
+    np.testing.assert_allclose(sf(_t([1.0])).numpy(), [3.0])
+    np.testing.assert_allclose(sf(_t([-1.0])).numpy(), [-1.0])
+
+
+def test_zero_arg_super_inside_converted_branch():
+    """super() moved into a generated branch fn must not bind the carry
+    tuple as its obj."""
+    class Base2(nn.Layer):
+        def forward(self, x):
+            return x + 1.0
+
+    class Child2(Base2):
+        def forward(self, x):
+            if x.sum() > 0:
+                y = super().forward(x)
+            else:
+                y = x * 0.0
+            return y
+
+    net = Child2()
+    xs = [_t([1.0, 2.0]), _t([-1.0, -2.0])]
+    eager = [net(x).numpy() for x in xs]
+    net.forward = to_static(net.forward)
+    for x, e in zip(xs, eager):
+        np.testing.assert_allclose(net(x).numpy(), e)
+
+
+def test_walrus_in_comprehension_is_carried():
+    def f(x):
+        if x.sum() > 0:
+            parts = [(y := x * float(k)) for k in range(1, 3)]
+            out = parts[0] + parts[1]
+        else:
+            y = x
+            out = x
+        return out + y
+
+    sf = to_static(f)
+    # true: parts=[x,2x], y=2x, out=3x → 5x; false: out+y = 2x
+    np.testing.assert_allclose(sf(_t([1.0])).numpy(), [5.0])
+    np.testing.assert_allclose(sf(_t([-1.0])).numpy(), [-2.0])
+
+
+def test_traced_for_prebound_float_loop_var():
+    def f(x, n):
+        i = 0.5
+        for i in range(n):
+            x = x + 1.0
+        return x + i
+
+    sf = to_static(f)
+    n = paddle.to_tensor(np.asarray(3, dtype="int32"))
+    np.testing.assert_allclose(sf(_t([0.0]), n).numpy(), [5.0])
+
+
+def test_empty_traced_range_restores_prebound_loop_var():
+    def f(x, n):
+        i = 0.5
+        for i in range(n):
+            x = x + 1.0
+        return x + i
+
+    sf = to_static(f)
+    n0 = paddle.to_tensor(np.asarray(0, dtype="int32"))
+    np.testing.assert_allclose(sf(_t([1.0]), n0).numpy(), [1.5])
