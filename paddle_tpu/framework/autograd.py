@@ -88,10 +88,10 @@ class TapeNode:
     """
 
     __slots__ = ("id", "name", "vjp_fn", "inputs", "out_refs", "out_avals",
-                 "__weakref__")
+                 "out_is_seq", "__weakref__")
 
     def __init__(self, name: str, vjp_fn, inputs: Sequence[Any],
-                 out_tensors: Sequence[Any]):
+                 out_tensors: Sequence[Any], out_is_seq: bool = None):
         self.id = next(_node_counter)
         self.name = name
         self.vjp_fn = vjp_fn
@@ -99,6 +99,11 @@ class TapeNode:
         self.out_refs = [weakref.ref(t) for t in out_tensors]
         self.out_avals = [(t._value.shape, t._value.dtype)
                           for t in out_tensors]
+        # whether vjp_fn expects a tuple cotangent even for ONE output
+        # (jax.vjp is strict about the output pytree; a 1-tuple output
+        # needs a 1-tuple cotangent)
+        self.out_is_seq = (len(out_tensors) > 1 if out_is_seq is None
+                           else out_is_seq)
 
     def __repr__(self):
         return f"TapeNode<{self.name}#{self.id}>"
@@ -163,7 +168,7 @@ def backward(tensor, grad_tensor=None, retain_graph: bool = False) -> None:
             outs.append(g)
         if not any_grad:
             continue
-        in_grads = node.vjp_fn(tuple(outs) if len(outs) > 1 else outs[0])
+        in_grads = node.vjp_fn(tuple(outs) if node.out_is_seq else outs[0])
         for t, g in zip(node.inputs, in_grads):
             if g is None:
                 continue
@@ -233,7 +238,7 @@ def grad(outputs, inputs, grad_outputs=None, retain_graph=None,
             outs.append(g)
         if not any_grad or node.vjp_fn is None:
             continue
-        in_grads = node.vjp_fn(tuple(outs) if len(outs) > 1 else outs[0])
+        in_grads = node.vjp_fn(tuple(outs) if node.out_is_seq else outs[0])
         for t, g in zip(node.inputs, in_grads):
             if g is None:
                 continue

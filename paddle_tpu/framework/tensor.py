@@ -301,12 +301,17 @@ def apply_op(name: str, fn: Callable, args: Sequence[Any], kwargs: dict):
         full = list(raw_args)
         for p, v in zip(diff_pos, dvals):
             full[p] = v
-        return fn(*full, **raw_kwargs)
+        out = fn(*full, **raw_kwargs)
+        # canonicalize sequence outputs (incl. NamedTuples like
+        # jnp.linalg's SVDResult) to a plain tuple so the backward walk
+        # can feed jax.vjp a matching cotangent pytree
+        return tuple(out) if isinstance(out, (tuple, list)) else out
 
     primals = [raw_args[p] for p in diff_pos]
     out, vjp_fn = jax.vjp(closed, *primals)
     in_tensors = [args[p] for p in diff_pos]
-    return _wrap_outputs(name, out, vjp_fn, in_tensors)
+    return _wrap_outputs(name, out, vjp_fn, in_tensors,
+                         out_is_seq=isinstance(out, tuple))
 
 
 def _check_nan_inf(name, out):
@@ -323,7 +328,7 @@ def _check_nan_inf(name, out):
                     f"(FLAGS_check_nan_inf is enabled)")
 
 
-def _wrap_outputs(name, out, vjp_fn, in_tensors):
+def _wrap_outputs(name, out, vjp_fn, in_tensors, out_is_seq=None):
     _check_nan_inf(name, out)
     single = not isinstance(out, (tuple, list))
     flat = [out] if single else list(out)
@@ -331,7 +336,8 @@ def _wrap_outputs(name, out, vjp_fn, in_tensors):
     tensors = [x if isinstance(x, Tensor) else Tensor(x, stop_gradient=sg)
                for x in flat]
     if vjp_fn is not None:
-        node = TapeNode(name, vjp_fn, in_tensors, tensors)
+        node = TapeNode(name, vjp_fn, in_tensors, tensors,
+                        out_is_seq=out_is_seq)
         for t in tensors:
             t._node = node
             t.stop_gradient = False

@@ -463,8 +463,9 @@ class Dy2StaticTransformer(ast.NodeTransformer):
     # -- while --------------------------------------------------------------
     def visit_While(self, node):
         self.generic_visit(node)
-        if node.orelse or _contains(node.body, (ast.Break, ast.Continue)):
-            return node  # break/continue: python-only semantics
+        if node.orelse or _contains(node.body,
+                                    (ast.Break, ast.Continue, ast.Return)):
+            return node  # break/continue/return: python-only semantics
         uid = self._uid()
         carried = _stored_names(node.body)
         return self._lower_loop(uid, node.test, node.body, carried)
@@ -495,7 +496,8 @@ class Dy2StaticTransformer(ast.NodeTransformer):
     def visit_For(self, node):
         self.generic_visit(node)
         if (node.orelse
-                or _contains(node.body, (ast.Break, ast.Continue))
+                or _contains(node.body,
+                             (ast.Break, ast.Continue, ast.Return))
                 or not isinstance(node.target, ast.Name)
                 or not (isinstance(node.iter, ast.Call)
                         and isinstance(node.iter.func, ast.Name)

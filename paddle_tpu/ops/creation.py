@@ -141,11 +141,15 @@ def triu_indices(row, col=None, offset=0, dtype="int64"):
 
 
 def assign(x, output=None):
-    v = x._value if isinstance(x, Tensor) else jnp.asarray(np.asarray(x))
     if output is not None:
+        v = x._value if isinstance(x, Tensor) else jnp.asarray(np.asarray(x))
         output.set_value(v)
         return output
-    return Tensor(v)
+    if isinstance(x, Tensor):
+        # grad op of assign is identity (reference assign_op grad maker)
+        from ..framework.tensor import apply_op
+        return apply_op("assign", lambda v: v, (x,), {})
+    return Tensor(jnp.asarray(np.asarray(x)))
 
 
 def clone(x, name=None):

@@ -222,10 +222,13 @@ def index_put(x, indices, value, accumulate=False, name=None):
 
 
 def masked_select(x, mask, name=None):
-    # dynamic output shape ⇒ eager-only (documented)
-    v = np.asarray(x._value)
+    # dynamic output shape ⇒ the mask must be concrete (eager-only), but
+    # once known the pick indices are static — gradient flows via take
     m = np.asarray(mask._value if isinstance(mask, Tensor) else mask)
-    return Tensor(jnp.asarray(v[m]))
+    m = np.broadcast_to(m, np.shape(x._value))
+    picks = np.flatnonzero(m.reshape(-1))
+    return apply_op("masked_select",
+                    lambda v: jnp.take(v.reshape(-1), picks), (x,), {})
 
 
 def masked_fill(x, mask, value, name=None):

@@ -27,7 +27,7 @@ _METHOD_SOURCES = [
                     "unbind",
                     "repeat_interleave", "take_along_axis", "put_along_axis",
                     "unique", "nonzero", "diagonal", "masked_fill",
-                    "moveaxis"]),
+                    "moveaxis", "t"]),
     (linalg, ["matmul", "mm", "bmm", "dot", "norm", "dist", "cross",
               "cholesky", "inverse", "det", "matrix_power", "mv"]),
     (logic, ["equal", "not_equal", "less_than", "less_equal", "greater_than",

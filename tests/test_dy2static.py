@@ -300,3 +300,20 @@ def test_empty_traced_range_restores_prebound_loop_var():
     sf = to_static(f)
     n0 = paddle.to_tensor(np.asarray(0, dtype="int32"))
     np.testing.assert_allclose(sf(_t([1.0]), n0).numpy(), [1.5])
+
+
+def test_return_inside_loop_falls_back_to_python():
+    def f(x):
+        for i in range(3):
+            return x + float(i)
+        return x * 100.0
+
+    sf = to_static(f)
+    np.testing.assert_allclose(sf(_t([1.0])).numpy(), [1.0])
+
+    def g(x):
+        while True:
+            return x * 2.0
+
+    sg = to_static(g)
+    np.testing.assert_allclose(sg(_t([1.0])).numpy(), [2.0])

@@ -1,0 +1,556 @@
+"""OpTest-style numeric gradient verification (reference
+`python/paddle/fluid/tests/unittests/op_test.py:238` — `check_grad:1335`
+compares analytic grads against `get_numeric_gradient:101` central
+finite differences).
+
+Every case runs the op through the PUBLIC eager API (tape autograd over
+jax.vjp) and compares `Tensor.grad` against central differences of a
+random-projection scalar loss computed through the same public API.
+"""
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn.functional as F
+
+# float32 everywhere (jax x64 off): central differences with eps=1e-3 on
+# O(1) values leave ~1e-3 absolute noise; tolerances account for that.
+EPS = 1e-3
+RTOL = 1e-2
+ATOL = 2e-3
+
+
+def _rs(seed=0):
+    return np.random.RandomState(seed)
+
+
+class C:
+    """One gradient-check case."""
+
+    def __init__(self, name, fn, arrays, diff=(0,), kwargs=None, sel=None,
+                 eps=EPS, rtol=RTOL, atol=ATOL, int_inputs=()):
+        self.name = name
+        self.fn = fn
+        self.arrays = arrays
+        self.diff = tuple(diff)
+        self.kwargs = kwargs or {}
+        self.sel = sel or (lambda o: o)
+        self.eps = eps
+        self.rtol = rtol
+        self.atol = atol
+        self.int_inputs = set(int_inputs)
+
+    def tensors(self, arrays, grad=False):
+        ts = []
+        for i, a in enumerate(arrays):
+            t = paddle.to_tensor(a)
+            if grad and i in self.diff:
+                t.stop_gradient = False
+            ts.append(t)
+        return ts
+
+    def run_forward(self, arrays):
+        return self.sel(self.fn(*self.tensors(arrays), **self.kwargs))
+
+
+def _loss_np(case, arrays, cot):
+    out = case.run_forward(arrays).numpy().astype(np.float64)
+    return float((out * cot).sum())
+
+
+def _numeric_grad(case, idx, cot):
+    arrays = [a.copy() for a in case.arrays]
+    base = arrays[idx]
+    flat = base.reshape(-1)
+    g = np.zeros(flat.size, dtype=np.float64)
+    for k in range(flat.size):
+        orig = flat[k]
+        flat[k] = orig + case.eps
+        lp = _loss_np(case, arrays, cot)
+        flat[k] = orig - case.eps
+        lm = _loss_np(case, arrays, cot)
+        flat[k] = orig
+        g[k] = (lp - lm) / (2.0 * case.eps)
+    return g.reshape(base.shape)
+
+
+def check_grad(case):
+    out0 = case.run_forward(case.arrays)
+    cot = _rs(7).uniform(0.5, 1.5, size=out0.shape).astype("float64")
+
+    ts = case.tensors(case.arrays, grad=True)
+    out = case.sel(case.fn(*ts, **case.kwargs))
+    loss = (out * paddle.to_tensor(cot.astype("float32"))).sum()
+    loss.backward()
+
+    for i in case.diff:
+        assert ts[i].grad is not None, \
+            f"{case.name}: no grad flowed to input {i}"
+        ana = ts[i].grad.numpy().astype(np.float64)
+        num = _numeric_grad(case, i, cot)
+        np.testing.assert_allclose(
+            ana, num, rtol=case.rtol, atol=case.atol,
+            err_msg=f"{case.name}: analytic vs numeric grad of input {i}")
+
+
+# ---------------------------------------------------------------------------
+# input generators
+# ---------------------------------------------------------------------------
+
+def x_gen(shape=(3, 4), lo=-2.0, hi=2.0, seed=0, margin=0.15):
+    """Uniform values with |x| >= margin (away from kinks at 0)."""
+    a = _rs(seed).uniform(lo, hi, size=shape).astype("float32")
+    a = np.where(np.abs(a) < margin, np.sign(a) * margin + a, a)
+    return a
+
+
+def pos(shape=(3, 4), lo=0.5, hi=3.0, seed=0):
+    return _rs(seed).uniform(lo, hi, size=shape).astype("float32")
+
+
+def unit(shape=(3, 4), seed=0, bound=0.8):
+    return _rs(seed).uniform(-bound, bound, size=shape).astype("float32")
+
+
+def distinct(shape=(3, 4), seed=0, scale=0.37):
+    """All-distinct values (safe for max/min/sort/topk grads)."""
+    n = int(np.prod(shape))
+    v = (np.arange(n, dtype="float32") - n / 2.0) * scale
+    return v[_rs(seed).permutation(n)].reshape(shape)
+
+
+def spd(n=4, seed=0):
+    b = _rs(seed).randn(n, n).astype("float32")
+    return (b @ b.T + n * np.eye(n, dtype="float32")).astype("float32")
+
+
+def idx(shape, high, seed=3):
+    return _rs(seed).randint(0, high, size=shape).astype("int64")
+
+
+# ---------------------------------------------------------------------------
+# the op table
+# ---------------------------------------------------------------------------
+
+P = paddle
+CASES = []
+
+
+def add(name, fn, arrays, **kw):
+    CASES.append(C(name, fn, arrays, **kw))
+
+
+# ---- unary elementwise (smooth / away from kinks) -------------------------
+add("abs", P.abs, [x_gen()])
+add("acos", P.acos, [unit()])
+add("acosh", P.acosh, [pos(lo=1.3, hi=3.0)])
+add("asin", P.asin, [unit()])
+add("asinh", P.asinh, [x_gen()])
+add("atan", P.atan, [x_gen()])
+add("atanh", P.atanh, [unit()])
+add("cos", P.cos, [x_gen()])
+add("cosh", P.cosh, [x_gen()])
+add("digamma", P.digamma, [pos()])
+add("erf", P.erf, [x_gen()])
+add("erfinv", P.erfinv, [unit()])
+add("exp", P.exp, [x_gen(lo=-1.5, hi=1.5)])
+add("expm1", P.expm1, [x_gen(lo=-1.5, hi=1.5)])
+add("frac", P.frac, [x_gen() + 0.5], atol=5e-3)
+add("lgamma", P.lgamma, [pos()])
+add("log", P.log, [pos()])
+add("log10", P.log10, [pos()])
+add("log1p", P.log1p, [pos()])
+add("log2", P.log2, [pos()])
+add("logit", P.logit, [_rs(0).uniform(0.2, 0.8, (3, 4)).astype("float32")])
+add("nan_to_num", P.nan_to_num, [x_gen()])
+add("neg", P.neg, [x_gen()])
+add("reciprocal", P.reciprocal, [pos()])
+add("rsqrt", P.rsqrt, [pos()])
+add("sigmoid", P.sigmoid, [x_gen()])
+add("sin", P.sin, [x_gen()])
+add("sinh", P.sinh, [x_gen()])
+add("sqrt", P.sqrt, [pos()])
+add("square", P.square, [x_gen()])
+add("stanh", P.stanh, [x_gen()])
+add("tan", P.tan, [unit()])
+add("tanh", P.tanh, [x_gen()])
+add("scale", P.scale, [x_gen()], kwargs={"scale": 2.5, "bias": 0.5})
+add("clip", P.clip, [x_gen()], kwargs={"min": -1.9, "max": 1.9})
+add("pow", P.pow, [pos()], kwargs={"y": 2.3})
+add("lerp", P.lerp, [x_gen(seed=1), x_gen(seed=2),
+                     _rs(3).uniform(0.2, 0.8, (3, 4)).astype("float32")],
+    diff=(0, 1, 2))
+add("logaddexp", P.logaddexp, [x_gen(seed=1), x_gen(seed=2)], diff=(0, 1))
+
+# ---- binary elementwise ----------------------------------------------------
+add("add", P.add, [x_gen(seed=1), x_gen(seed=2)], diff=(0, 1))
+add("subtract", P.subtract, [x_gen(seed=1), x_gen(seed=2)], diff=(0, 1))
+add("multiply", P.multiply, [x_gen(seed=1), x_gen(seed=2)], diff=(0, 1))
+add("divide", P.divide, [x_gen(seed=1), pos(seed=2)], diff=(0, 1))
+add("add_broadcast", P.add, [x_gen((3, 4), seed=1), x_gen((4,), seed=2)],
+    diff=(0, 1))
+add("mul_broadcast", P.multiply,
+    [x_gen((2, 3, 4), seed=1), x_gen((3, 1), seed=2)], diff=(0, 1))
+add("maximum", P.maximum, [distinct(seed=1), distinct(seed=2, scale=0.41)],
+    diff=(0, 1))
+add("minimum", P.minimum, [distinct(seed=1), distinct(seed=2, scale=0.41)],
+    diff=(0, 1))
+add("fmax", P.fmax, [distinct(seed=1), distinct(seed=2, scale=0.41)],
+    diff=(0, 1))
+add("fmin", P.fmin, [distinct(seed=1), distinct(seed=2, scale=0.41)],
+    diff=(0, 1))
+add("atan2", P.atan2, [x_gen(seed=1), pos(seed=2)], diff=(0, 1))
+add("mod_x", P.mod, [x_gen(seed=1) * 3, pos(seed=2, lo=2.0, hi=4.0)],
+    diff=(0,))
+add("elementwise_pow", P.elementwise_pow, [pos(seed=1), x_gen(seed=2)],
+    diff=(0, 1))
+add("heaviside_y", P.heaviside, [distinct(seed=1), x_gen(seed=2)],
+    diff=(1,))
+
+# ---- reductions ------------------------------------------------------------
+add("sum", P.sum, [x_gen()])
+add("sum_axis", P.sum, [x_gen((2, 3, 4))], kwargs={"axis": 1})
+add("sum_keepdim", P.sum, [x_gen((2, 3, 4))],
+    kwargs={"axis": [0, 2], "keepdim": True})
+add("mean", P.mean, [x_gen()])
+add("mean_axis", P.mean, [x_gen((2, 3, 4))], kwargs={"axis": [1, 2]})
+add("max", P.max, [distinct()])
+add("max_axis", P.max, [distinct((2, 3, 4))], kwargs={"axis": 2})
+add("min", P.min, [distinct()])
+add("min_axis", P.min, [distinct((2, 3, 4))], kwargs={"axis": 0})
+add("amax", P.amax, [distinct()])
+add("amin", P.amin, [distinct()])
+add("prod", P.prod, [x_gen(lo=0.5, hi=1.5)])
+add("prod_axis", P.prod, [x_gen((2, 3, 4), lo=0.5, hi=1.5)],
+    kwargs={"axis": 1})
+add("logsumexp", P.logsumexp, [x_gen()])
+add("std", P.std, [x_gen()])
+add("var", P.var, [x_gen()])
+add("nansum", P.nansum, [x_gen()])
+add("nanmean", P.nanmean, [x_gen()])
+add("norm_fro", P.norm, [x_gen()])
+add("norm_1", P.norm, [x_gen()], kwargs={"p": 1})
+add("dist", P.dist, [x_gen(seed=1), x_gen(seed=2)], diff=(0, 1))
+add("median", P.median, [distinct((3, 5))])
+add("nanmedian", P.nanmedian, [distinct((3, 5))])
+add("trace", P.trace, [x_gen((4, 4))])
+add("cumsum", P.cumsum, [x_gen()], kwargs={"axis": 1})
+add("cumprod", P.cumprod, [x_gen(lo=0.5, hi=1.5)], kwargs={"dim": 1})
+add("cummax", lambda x: P.cummax(x, axis=1)[0], [distinct()])
+add("diff", P.diff, [x_gen()], kwargs={"axis": 1})
+
+# ---- matmul family ---------------------------------------------------------
+add("matmul", P.matmul, [x_gen((3, 4), seed=1), x_gen((4, 5), seed=2)],
+    diff=(0, 1))
+add("matmul_batched", P.matmul,
+    [x_gen((2, 3, 4), seed=1), x_gen((2, 4, 5), seed=2)], diff=(0, 1))
+add("matmul_trans", P.matmul,
+    [x_gen((4, 3), seed=1), x_gen((4, 5), seed=2)],
+    kwargs={"transpose_x": True}, diff=(0, 1))
+add("mm", P.mm, [x_gen((3, 4), seed=1), x_gen((4, 2), seed=2)], diff=(0, 1))
+add("bmm", P.bmm, [x_gen((2, 3, 4), seed=1), x_gen((2, 4, 3), seed=2)],
+    diff=(0, 1))
+add("dot", P.dot, [x_gen((5,), seed=1), x_gen((5,), seed=2)], diff=(0, 1))
+add("mv", P.mv, [x_gen((3, 4), seed=1), x_gen((4,), seed=2)], diff=(0, 1))
+add("inner", P.inner, [x_gen((3, 4), seed=1), x_gen((2, 4), seed=2)],
+    diff=(0, 1))
+add("outer", P.outer, [x_gen((3,), seed=1), x_gen((4,), seed=2)],
+    diff=(0, 1))
+add("addmm", P.addmm,
+    [x_gen((3, 2), seed=0), x_gen((3, 4), seed=1), x_gen((4, 2), seed=2)],
+    diff=(0, 1, 2))
+add("kron", P.kron, [x_gen((2, 2), seed=1), x_gen((2, 3), seed=2)],
+    diff=(0, 1))
+add("cross", P.cross, [x_gen((3, 3), seed=1), x_gen((3, 3), seed=2)],
+    diff=(0, 1))
+add("multi_dot", lambda a, b, c: P.multi_dot([a, b, c]),
+    [x_gen((2, 3), seed=1), x_gen((3, 4), seed=2), x_gen((4, 2), seed=3)],
+    diff=(0, 1, 2))
+add("tensordot", P.tensordot,
+    [x_gen((2, 3, 4), seed=1), x_gen((3, 4, 2), seed=2)],
+    kwargs={"axes": 2}, diff=(0, 1))
+add("einsum", lambda a, b: P.einsum("ij,jk->ik", a, b),
+    [x_gen((3, 4), seed=1), x_gen((4, 2), seed=2)], diff=(0, 1))
+add("matrix_power", P.matrix_power, [x_gen((3, 3)) * 0.5],
+    kwargs={"n": 2})
+
+# ---- linalg ----------------------------------------------------------------
+add("cholesky", P.cholesky, [spd()], rtol=2e-2, atol=5e-3)
+add("inverse", P.inverse, [spd()], rtol=2e-2, atol=5e-3)
+add("det", P.det, [spd(3)], rtol=2e-2, atol=5e-3)
+add("slogdet", lambda x: P.slogdet(x)[1], [spd(3)], rtol=2e-2, atol=5e-3)
+add("solve", P.solve, [spd(3), x_gen((3, 2), seed=5)], diff=(0, 1),
+    rtol=2e-2, atol=5e-3)
+add("triangular_solve", P.triangular_solve,
+    [np.tril(spd(3)).astype("float32"), x_gen((3, 2), seed=5)],
+    kwargs={"upper": False}, diff=(0, 1), rtol=2e-2, atol=5e-3)
+add("svd_s", lambda x: P.svd(x)[1], [distinct((3, 4), scale=0.9)],
+    rtol=2e-2, atol=5e-3)
+add("eigvalsh", P.eigvalsh,
+    [(distinct((4, 4), scale=0.5) + distinct((4, 4), scale=0.5).T
+      + 4 * np.eye(4, dtype="float32")).astype("float32")],
+    rtol=2e-2, atol=5e-3)
+add("pinv", P.pinv, [distinct((3, 4), scale=0.9)], rtol=3e-2, atol=8e-3)
+
+# ---- shape / routing -------------------------------------------------------
+add("reshape", P.reshape, [x_gen((3, 4))], kwargs={"shape": [2, 6]})
+add("flatten", P.flatten, [x_gen((2, 3, 4))])
+add("squeeze", P.squeeze, [x_gen((3, 1, 4))], kwargs={"axis": 1})
+add("unsqueeze", P.unsqueeze, [x_gen()], kwargs={"axis": 0})
+add("transpose", P.transpose, [x_gen((2, 3, 4))],
+    kwargs={"perm": [2, 0, 1]})
+add("t", P.t, [x_gen((3, 4))])
+add("flip", P.flip, [x_gen()], kwargs={"axis": [0]})
+add("roll", P.roll, [x_gen()], kwargs={"shifts": 2, "axis": 1})
+add("rot90", P.rot90, [x_gen()])
+add("moveaxis", P.moveaxis, [x_gen((2, 3, 4))],
+    kwargs={"source": 0, "destination": 2})
+add("concat", lambda a, b: P.concat([a, b], axis=1),
+    [x_gen((3, 2), seed=1), x_gen((3, 4), seed=2)], diff=(0, 1))
+add("stack", lambda a, b: P.stack([a, b], axis=0),
+    [x_gen(seed=1), x_gen(seed=2)], diff=(0, 1))
+add("split", lambda x: P.split(x, 2, axis=1)[0], [x_gen((3, 4))])
+add("chunk", lambda x: P.chunk(x, 2, axis=0)[1], [x_gen((4, 3))])
+add("unbind", lambda x: P.unbind(x, axis=0)[1], [x_gen((3, 4))])
+add("unstack", lambda x: P.unstack(x, axis=0)[0], [x_gen((3, 4))])
+add("tile", P.tile, [x_gen()], kwargs={"repeat_times": [2, 1]})
+add("expand", P.expand, [x_gen((1, 4))], kwargs={"shape": [3, 4]})
+add("broadcast_to", P.broadcast_to, [x_gen((1, 4))],
+    kwargs={"shape": [3, 4]})
+add("expand_as", P.expand_as, [x_gen((1, 4), seed=1), x_gen((3, 4), seed=2)],
+    diff=(0,))
+add("pad", P.pad, [x_gen()], kwargs={"pad": [1, 1, 0, 2]})
+add("tril", P.tril, [x_gen((4, 4))])
+add("triu", P.triu, [x_gen((4, 4))])
+add("diag", P.diag, [x_gen((4,))])
+add("diagflat", P.diagflat, [x_gen((3,))])
+add("diagonal", P.diagonal, [x_gen((3, 3))])
+add("slice", lambda x: x[1:3, 0:2], [x_gen((4, 4))])
+add("strided_slice", P.strided_slice, [x_gen((4, 6))],
+    kwargs={"axes": [1], "starts": [0], "ends": [6], "strides": [2]})
+add("reverse", P.reverse, [x_gen()], kwargs={"axis": 0})
+add("repeat_interleave", P.repeat_interleave, [x_gen()],
+    kwargs={"repeats": 2, "axis": 1})
+add("crop", P.crop, [x_gen((4, 4))],
+    kwargs={"shape": [2, 2], "offsets": [1, 1]})
+
+# ---- indexing / scatter-gather --------------------------------------------
+add("gather", P.gather, [x_gen((5, 3)), idx((4,), 5)], diff=(0,))
+add("gather_nd", P.gather_nd,
+    [x_gen((3, 4)), np.array([[0, 1], [2, 3]], dtype="int64")], diff=(0,))
+add("index_select", P.index_select, [x_gen((5, 3)), idx((3,), 5)],
+    diff=(0,))
+add("index_sample", P.index_sample, [x_gen((3, 5)), idx((3, 2), 5)],
+    diff=(0,))
+add("index_add", lambda x, i, v: P.index_add(x, i, 0, v),
+    [x_gen((5, 3), seed=1),
+     np.array([0, 2], dtype="int64"), x_gen((2, 3), seed=2)],
+    diff=(0, 2))
+add("take_along_axis", P.take_along_axis,
+    [x_gen((3, 5)), idx((3, 2), 5)], kwargs={"axis": 1}, diff=(0,))
+add("put_along_axis", P.put_along_axis,
+    [x_gen((3, 5), seed=1),
+     np.stack([np.arange(3)] * 1, 1).astype("int64"),
+     x_gen((3, 1), seed=2)],
+    kwargs={"axis": 1}, diff=(0, 2))
+add("scatter", P.scatter,
+    [x_gen((5, 3), seed=1), np.array([1, 3], dtype="int64"),
+     x_gen((2, 3), seed=2)], diff=(0, 2))
+add("scatter_nd_add", P.scatter_nd_add,
+    [x_gen((5, 3), seed=1), np.array([[1], [3]], dtype="int64"),
+     x_gen((2, 3), seed=2)], diff=(0, 2))
+add("masked_select", P.masked_select,
+    [x_gen((3, 4)), (distinct((3, 4), seed=9) > 0)], diff=(0,))
+add("masked_fill", P.masked_fill,
+    [x_gen((3, 4)), (distinct((3, 4), seed=9) > 0),
+     np.float32(1.5)], diff=(0,))
+add("where", P.where,
+    [(distinct((3, 4), seed=9) > 0), x_gen(seed=1), x_gen(seed=2)],
+    diff=(1, 2))
+add("multiplex", lambda a, b, i: P.multiplex([a, b], i),
+    [x_gen((3, 4), seed=1), x_gen((3, 4), seed=2),
+     idx((3, 1), 2)], diff=(0, 1))
+
+# ---- sort / topk -----------------------------------------------------------
+add("sort", P.sort, [distinct()], kwargs={"axis": 1})
+add("topk_v", lambda x: P.topk(x, k=2, axis=1)[0], [distinct()])
+add("kthvalue_v", lambda x: P.kthvalue(x, k=2, axis=1)[0], [distinct()])
+
+# ---- activations (functional) ---------------------------------------------
+add("relu", F.relu, [x_gen()])
+add("relu6", F.relu6, [x_gen(lo=-3, hi=8)])
+add("leaky_relu", F.leaky_relu, [x_gen()])
+add("elu", F.elu, [x_gen()])
+add("selu", F.selu, [x_gen()])
+add("celu", F.celu, [x_gen()])
+add("gelu", F.gelu, [x_gen()])
+add("gelu_tanh", F.gelu, [x_gen()], kwargs={"approximate": True})
+add("silu", F.silu, [x_gen()])
+add("swish", F.swish, [x_gen()])
+add("mish", F.mish, [x_gen()])
+add("softplus", F.softplus, [x_gen()])
+add("softsign", F.softsign, [x_gen()])
+add("softshrink", F.softshrink, [x_gen(margin=0.7)])
+add("hardshrink", F.hardshrink, [x_gen(margin=0.7)])
+add("hardtanh", F.hardtanh, [x_gen(margin=0.2) * 2])
+add("hardsigmoid", F.hardsigmoid, [x_gen()])
+add("hardswish", F.hardswish, [x_gen(margin=0.2)])
+add("tanhshrink", F.tanhshrink, [x_gen()])
+add("thresholded_relu", F.thresholded_relu, [x_gen(margin=1.2)])
+add("log_sigmoid", F.log_sigmoid, [x_gen()])
+add("softmax", F.softmax, [x_gen()], kwargs={"axis": -1})
+add("log_softmax", F.log_softmax, [x_gen()], kwargs={"axis": -1})
+add("prelu", F.prelu, [x_gen(), np.array([0.25], dtype="float32")],
+    diff=(0, 1))
+add("glu", F.glu, [x_gen((3, 4))])
+add("maxout", F.maxout, [distinct((1, 4, 2, 2))], kwargs={"groups": 2})
+add("normalize", F.normalize, [x_gen()])
+add("cosine_similarity", F.cosine_similarity,
+    [x_gen(seed=1), x_gen(seed=2)], diff=(0, 1))
+add("pairwise_distance", F.pairwise_distance,
+    [x_gen(seed=1), x_gen(seed=2)], diff=(0, 1))
+
+# ---- nn: linear / conv / pool / norm --------------------------------------
+add("linear", F.linear,
+    [x_gen((2, 4), seed=1), x_gen((4, 3), seed=2), x_gen((3,), seed=3)],
+    diff=(0, 1, 2))
+add("bilinear", F.bilinear,
+    [x_gen((2, 3), seed=1), x_gen((2, 4), seed=2),
+     x_gen((2, 3, 4), seed=3) * 0.3],
+    diff=(0, 1, 2))
+add("conv1d", F.conv1d,
+    [x_gen((1, 2, 8), seed=1), x_gen((3, 2, 3), seed=2) * 0.4],
+    diff=(0, 1), rtol=2e-2, atol=5e-3)
+add("conv2d", F.conv2d,
+    [x_gen((1, 2, 6, 6), seed=1), x_gen((3, 2, 3, 3), seed=2) * 0.3],
+    diff=(0, 1), rtol=2e-2, atol=5e-3)
+add("conv2d_stride_pad", F.conv2d,
+    [x_gen((1, 2, 6, 6), seed=1), x_gen((3, 2, 3, 3), seed=2) * 0.3],
+    kwargs={"stride": 2, "padding": 1}, diff=(0, 1),
+    rtol=2e-2, atol=5e-3)
+add("conv2d_groups", F.conv2d,
+    [x_gen((1, 4, 5, 5), seed=1), x_gen((4, 2, 3, 3), seed=2) * 0.3],
+    kwargs={"groups": 2}, diff=(0, 1), rtol=2e-2, atol=5e-3)
+add("conv2d_transpose", F.conv2d_transpose,
+    [x_gen((1, 3, 4, 4), seed=1), x_gen((3, 2, 3, 3), seed=2) * 0.3],
+    diff=(0, 1), rtol=2e-2, atol=5e-3)
+add("conv3d", F.conv3d,
+    [x_gen((1, 1, 4, 4, 4), seed=1), x_gen((2, 1, 2, 2, 2), seed=2) * 0.4],
+    diff=(0, 1), rtol=2e-2, atol=5e-3)
+add("avg_pool2d", F.avg_pool2d, [x_gen((1, 2, 4, 4))],
+    kwargs={"kernel_size": 2})
+add("avg_pool1d", F.avg_pool1d, [x_gen((1, 2, 6))],
+    kwargs={"kernel_size": 2})
+add("max_pool2d", F.max_pool2d, [distinct((1, 2, 4, 4))],
+    kwargs={"kernel_size": 2})
+add("max_pool1d", F.max_pool1d, [distinct((1, 2, 6))],
+    kwargs={"kernel_size": 2})
+add("adaptive_avg_pool2d", F.adaptive_avg_pool2d, [x_gen((1, 2, 4, 4))],
+    kwargs={"output_size": 2})
+add("adaptive_max_pool2d", F.adaptive_max_pool2d, [distinct((1, 2, 4, 4))],
+    kwargs={"output_size": 2})
+add("interpolate_nearest", F.interpolate, [x_gen((1, 2, 3, 3))],
+    kwargs={"scale_factor": 2, "mode": "nearest"})
+add("interpolate_bilinear", F.interpolate, [x_gen((1, 2, 3, 3))],
+    kwargs={"scale_factor": 2, "mode": "bilinear"})
+add("pixel_shuffle", F.pixel_shuffle, [x_gen((1, 4, 2, 2))],
+    kwargs={"upscale_factor": 2})
+add("unfold", F.unfold, [x_gen((1, 2, 4, 4))],
+    kwargs={"kernel_sizes": 2})
+add("layer_norm", lambda x, w, b: F.layer_norm(x, 6, w, b),
+    [x_gen((2, 6), seed=1), pos((6,), seed=2), x_gen((6,), seed=3)],
+    diff=(0, 1, 2))
+add("group_norm_x", lambda x: F.group_norm(x, num_groups=2),
+    [x_gen((2, 4, 3, 3))])
+add("instance_norm_x", F.instance_norm, [x_gen((2, 3, 4, 4))])
+add("local_response_norm", F.local_response_norm, [x_gen((1, 4, 3, 3))],
+    kwargs={"size": 3})
+add("embedding_w", lambda w: F.embedding(
+    paddle.to_tensor(idx((2, 3), 5)), w), [x_gen((5, 4))])
+
+# ---- losses ----------------------------------------------------------------
+add("mse_loss", F.mse_loss, [x_gen(seed=1), x_gen(seed=2)], diff=(0,))
+add("l1_loss", F.l1_loss,
+    [distinct(seed=1), distinct(seed=2, scale=0.41)], diff=(0,))
+add("smooth_l1_loss", F.smooth_l1_loss,
+    [x_gen(seed=1), x_gen(seed=2)], diff=(0,))
+add("cross_entropy", F.cross_entropy,
+    [x_gen((3, 5), seed=1), idx((3,), 5)], diff=(0,))
+add("cross_entropy_soft", F.cross_entropy,
+    [x_gen((3, 5), seed=1),
+     _rs(2).dirichlet(np.ones(5), 3).astype("float32")],
+    kwargs={"soft_label": True}, diff=(0,))
+add("nll_loss", F.nll_loss,
+    [np.log(_rs(1).dirichlet(np.ones(5), 3).astype("float32") + 0.05),
+     idx((3,), 5)], diff=(0,))
+add("binary_cross_entropy", F.binary_cross_entropy,
+    [_rs(1).uniform(0.2, 0.8, (3, 4)).astype("float32"),
+     _rs(2).randint(0, 2, (3, 4)).astype("float32")], diff=(0,))
+add("bce_with_logits", F.binary_cross_entropy_with_logits,
+    [x_gen(seed=1), _rs(2).randint(0, 2, (3, 4)).astype("float32")],
+    diff=(0,))
+add("kl_div", F.kl_div,
+    [np.log(_rs(1).dirichlet(np.ones(4), 3).astype("float32") + 0.05),
+     _rs(2).dirichlet(np.ones(4), 3).astype("float32")], diff=(0,))
+add("log_loss", F.log_loss,
+    [_rs(1).uniform(0.2, 0.8, (3, 1)).astype("float32"),
+     _rs(2).randint(0, 2, (3, 1)).astype("float32")], diff=(0,))
+add("sigmoid_focal_loss", F.sigmoid_focal_loss,
+    [x_gen((3, 4), seed=1),
+     _rs(2).randint(0, 2, (3, 4)).astype("float32")], diff=(0,))
+add("margin_ranking_loss", F.margin_ranking_loss,
+    [distinct(seed=1), distinct(seed=2, scale=0.41),
+     np.sign(distinct(seed=3)).astype("float32")], diff=(0, 1))
+add("hinge_embedding_loss", F.hinge_embedding_loss,
+    [pos(seed=1), np.sign(distinct(seed=3)).astype("float32")],
+    diff=(0,))
+add("cosine_embedding_loss", F.cosine_embedding_loss,
+    [x_gen((3, 4), seed=1), x_gen((3, 4), seed=2),
+     np.sign(distinct((3,), seed=3)).astype("float32")], diff=(0, 1))
+add("triplet_margin_loss", F.triplet_margin_loss,
+    [x_gen((3, 4), seed=1), x_gen((3, 4), seed=2) + 3.0,
+     x_gen((3, 4), seed=3) - 3.0], diff=(0, 1, 2))
+add("square_error_cost", P.nn.functional.square_error_cost,
+    [x_gen(seed=1), x_gen(seed=2)], diff=(0,))
+add("dice_loss", F.dice_loss,
+    [_rs(1).dirichlet(np.ones(4), 6).astype("float32").reshape(6, 4),
+     idx((6, 1), 4)], diff=(0,))
+add("softmax_with_cross_entropy", F.softmax_with_cross_entropy,
+    [x_gen((3, 5), seed=1), idx((3, 1), 5)], diff=(0,))
+add("npair_loss", F.npair_loss,
+    [x_gen((3, 4), seed=1), x_gen((3, 4), seed=2), idx((3,), 3)],
+    diff=(0, 1))
+add("label_smooth", F.label_smooth,
+    [_rs(1).dirichlet(np.ones(4), 3).astype("float32")], diff=(0,))
+
+# ---- misc tensor ops -------------------------------------------------------
+add("cast_f32", lambda x: P.cast(x, "float32"), [x_gen()])
+add("assign", P.assign, [x_gen()])
+add("clone", lambda x: x.clone(), [x_gen()])
+add("one_sub", lambda x: 1.0 - x, [x_gen()])
+add("rdiv", lambda x: 2.0 / x, [pos()])
+add("index_put", lambda x, v: P.index_put(
+    x, (paddle.to_tensor(np.array([0, 2], dtype="int64")),), v),
+    [x_gen((4, 3), seed=1), x_gen((2, 3), seed=2)], diff=(0, 1))
+add("tensor_t_method", lambda x: x.t(), [x_gen((3, 4))])
+
+
+_IDS = [c.name for c in CASES]
+
+
+def test_case_count():
+    assert len(CASES) >= 150, f"only {len(CASES)} grad-check cases"
+
+
+@pytest.mark.parametrize("case", CASES, ids=_IDS)
+def test_op_grad(case):
+    check_grad(case)
+
+
+def test_masked_select_broadcast_mask():
+    x = paddle.to_tensor(np.arange(6, dtype="float32").reshape(2, 3))
+    m = paddle.to_tensor(np.array([True, False, True]))
+    np.testing.assert_allclose(paddle.masked_select(x, m).numpy(),
+                               [0.0, 2.0, 3.0, 5.0])
