@@ -129,6 +129,12 @@ class Fleet:
         from ...parallel.spmd import make_sharded_train_step
         st = self._strategy or DistributedStrategy()
         opt = getattr(optimizer, "user_defined_optimizer", optimizer)
+        if st.pipeline:
+            from ...parallel.pipeline import make_pipeline_train_step
+            n_micro = int(st.pipeline_configs.get("accumulate_steps", 1))
+            return make_pipeline_train_step(
+                layer, opt, loss_fn, n_micro=max(n_micro, 1),
+                mesh=get_mesh(), recompute=st.recompute)
         if st.localsgd or st.adaptive_localsgd:
             from ...parallel.localsgd import make_local_train_step
             cfg = (st.adaptive_localsgd_configs if st.adaptive_localsgd

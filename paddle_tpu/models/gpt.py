@@ -140,6 +140,41 @@ class GPTBlock(nn.Layer):
         return x
 
 
+class _GPTEmbeddingStage(nn.Layer):
+    """Pipeline pre-section: token+position embedding (shares the GPT
+    model's parameter Tensors; see parallel/pipeline.py)."""
+
+    def __init__(self, gpt):
+        super().__init__()
+        self.wte = gpt.wte
+        self.wpe = gpt.wpe
+        self.drop = gpt.drop
+
+    def forward(self, input_ids):
+        b, s = input_ids.shape
+        pos = creation.arange(0, s, dtype="int64")
+        pos = manipulation.reshape(pos, [1, s])
+        return self.drop(self.wte(input_ids) + self.wpe(pos))
+
+
+class _GPTHeadStage(nn.Layer):
+    """Pipeline post-section: final LN (+ tied LM head when lm=True)."""
+
+    def __init__(self, gpt, lm):
+        super().__init__()
+        self.ln_f = gpt.ln_f
+        self._lm = lm
+        if lm:
+            self.wte = gpt.wte  # tied head; dedup'd by named_parameters
+
+    def forward(self, h):
+        h = self.ln_f(h)
+        if not self._lm:
+            return h
+        from ..ops.linalg import matmul
+        return matmul(h, self.wte.weight, transpose_y=True)
+
+
 class GPTModel(nn.Layer):
     def __init__(self, cfg: GPTConfig = None, **kwargs):
         super().__init__()
@@ -164,6 +199,13 @@ class GPTModel(nn.Layer):
             h = blk(h, use_ring=use_ring)
         return self.ln_f(h)
 
+    def pipeline_sections(self):
+        """(pre, blocks, post) for heterogeneous pipeline parallelism
+        (reference PipelineOptimizer splits a Program by device_guard,
+        `fluid/optimizer.py:3718`; here the model declares its stages)."""
+        return (_GPTEmbeddingStage(self), self.blocks,
+                _GPTHeadStage(self, lm=False))
+
 
 class GPTForCausalLM(nn.Layer):
     def __init__(self, cfg: GPTConfig = None, **kwargs):
@@ -174,3 +216,7 @@ class GPTForCausalLM(nn.Layer):
         h = self.gpt(input_ids, use_ring=use_ring)
         from ..ops.linalg import matmul
         return matmul(h, self.gpt.wte.weight, transpose_y=True)
+
+    def pipeline_sections(self):
+        return (_GPTEmbeddingStage(self.gpt), self.gpt.blocks,
+                _GPTHeadStage(self.gpt, lm=True))

@@ -1,6 +1,7 @@
 from .mesh import (DEFAULT_AXES, P, axis_size, create_mesh, get_mesh,
                    mesh_scope, named_sharding, replicated, set_mesh)
-from .pipeline import gpipe_spmd, pipeline_forward
+from .pipeline import (gpipe_spmd, make_pipeline_train_step,
+                       partition_blocks, pipeline_forward)
 from .ring_attention import (ring_attention, shard_map_ring_attention,
                              ulysses_attention)
 from .compression import dgc_compress, dgc_init
