@@ -149,6 +149,22 @@ def partition_blocks(blocks, pp):
     return block_apply, stacked, k
 
 
+def _make_stage_fn(block_apply, training):
+    """One pipeline stage = scan over its k blocks. `key` must already be
+    folded with (device, microbatch); the block index is folded here so
+    every block gets a distinct dropout mask."""
+    def stage_fn(params_k, h, key):
+        def body(hh, idx_and_p):
+            i, p_one = idx_and_p
+            out, _ = block_apply(p_one, {}, jax.random.fold_in(key, i),
+                                 training, hh)
+            return out, None
+        k_blocks = jax.tree_util.tree_leaves(params_k)[0].shape[0]
+        h2, _ = lax.scan(body, h, (jnp.arange(k_blocks), params_k))
+        return h2
+    return stage_fn
+
+
 def _hetero_pipeline_inner(block_apply, stage_params, x, rng, training,
                            axis_name, n_micro, recompute, schedule):
     """Inside shard_map: GPipe schedule over one stage of k blocks.
@@ -166,12 +182,7 @@ def _hetero_pipeline_inner(block_apply, stage_params, x, rng, training,
     mb_shape = x.shape[1:]
     perm = [(i, (i + 1) % pp) for i in range(pp)]
 
-    def stage_fn(params_k, h):
-        def body(hh, p_one):
-            out, _ = block_apply(p_one, {}, rng, training, hh)
-            return out, None
-        h2, _ = lax.scan(body, h, params_k)
-        return h2
+    stage_fn = _make_stage_fn(block_apply, training)
 
     if recompute:
         stage_fn = jax.checkpoint(stage_fn)
@@ -183,7 +194,9 @@ def _hetero_pipeline_inner(block_apply, stage_params, x, rng, training,
         cur = jnp.where(d == 0, inject, buf_in)
         my_mb = t - d
         active = (my_mb >= 0) & (my_mb < n_micro)
-        y = stage_fn(stage_params, cur)
+        key_t = jax.random.fold_in(jax.random.fold_in(rng, d),
+                                   jnp.clip(my_mb, 0, n_micro - 1))
+        y = stage_fn(stage_params, cur, key_t)
         y = jnp.where(active, y, jnp.zeros_like(y))
         out_idx = jnp.clip(my_mb, 0, n_micro - 1)
         store = (d == pp - 1) & active
@@ -238,6 +251,14 @@ def make_pipeline_train_step(model, optimizer, loss_fn, *, n_micro,
     outer = _Outer()
     pre_apply, opv, obv = functionalize(
         outer, forward=lambda *a, **k: outer.pre(*a, **k))
+    if schedule == "1f1b" and obv:
+        # the manual-vjp 1F1B loop replays pre/post per microbatch and has
+        # no way to thread buffer mutations through the schedule; refuse
+        # loudly rather than silently serving stale running stats
+        raise ValueError(
+            "schedule='1f1b' requires buffer-free pre/post sections "
+            f"(found buffers: {sorted(obv)}); use schedule='gpipe' or "
+            "move running-stat layers out of the pipelined model")
     post_apply, _, _ = functionalize(
         outer, forward=lambda *a, **k: outer.post(*a, **k))
     block_apply, bpv, k = partition_blocks(blocks, pp)
@@ -285,7 +306,7 @@ def make_pipeline_train_step(model, optimizer, loss_fn, *, n_micro,
         from ..framework.autograd import trace_mode
         opv_ = {n: pv_all_[n] for n in opv}
         bpv_ = {n: pv_all_[f"pp::{n}"] for n in bpv}
-        h, _ = pre_apply(opv_, bv_, rng, True, *inputs)
+        h, pre_bufs = pre_apply(opv_, bv_, rng, True, *inputs)
         b = h.shape[0]
         dp = mesh.shape.get(dp_axis, 1)
         if b % (n_micro * dp) != 0:
@@ -295,7 +316,9 @@ def make_pipeline_train_step(model, optimizer, loss_fn, *, n_micro,
         hm = h.reshape((n_micro, b // n_micro) + h.shape[1:])
         y = pipelined(bpv_, hm, rng, True)
         y = y.reshape((b,) + y.shape[2:])
-        out, new_bufs = post_apply(opv_, bv_, rng, True, y)
+        # thread pre-section buffer updates through post so running-stat
+        # layers in either bookend section persist their mutations
+        out, new_bufs = post_apply(opv_, pre_bufs, rng, True, y)
         with trace_mode():
             wout = jax.tree_util.tree_map(lambda v: Tensor(v), out)
             wlab = [Tensor(v) for v in labels]
@@ -329,10 +352,15 @@ def make_pipeline_train_step(model, optimizer, loss_fn, *, n_micro,
         def shard_fn(bp_local, opv_in, bv_in, ids_in, lab_in, rng_):
             bp = jax.tree_util.tree_map(lambda a: jnp.squeeze(a, 0),
                                         bp_local)
-            return _one_f_one_b_inner(
+            loss, g_stage, g_outer = _one_f_one_b_inner(
                 block_apply, pre_apply, post_apply, loss_fn, bp, opv_in,
                 bv_in, ids_in, lab_in, rng_, pp_axis, n_micro, pp_count,
                 dp_axis=dp_axis if has_dp else None)
+            # restore the leading stage axis stripped by squeeze(0) above:
+            # out_specs P(pp) concatenates per-shard leaves on axis 0, so
+            # each shard must contribute [1, k, ...], not [k, ...]
+            g_stage = jax.tree_util.tree_map(lambda g: g[None], g_stage)
+            return loss, g_stage, g_outer
 
         loss, g_stage, g_outer = jax.shard_map(
             shard_fn, mesh=mesh,
@@ -419,21 +447,19 @@ def _one_f_one_b_inner(block_apply, pre_apply, post_apply, loss_fn,
     perm_f = [(i, (i + 1) % pp) for i in range(pp)]
     perm_b = [(i, (i - 1) % pp) for i in range(pp)]
 
-    def stage_fn(params_k, h):
-        def body(hh, p_one):
-            out, _ = block_apply(p_one, {}, rng, True, hh)
-            return out, None
-        h2, _ = lax.scan(body, h, params_k)
-        return h2
+    stage_fn = _make_stage_fn(block_apply, True)
+
+    def stage_key(m):
+        return jax.random.fold_in(jax.random.fold_in(rng, d), m)
 
     def pre_of(m):
         xs = [lax.dynamic_index_in_dim(x, m, 0, keepdims=False)
               for x in ids_micro]
-        out, _ = pre_apply(opv, obv, rng, True, *xs)
+        out, _ = pre_apply(opv, obv, jax.random.fold_in(rng, m), True, *xs)
         return out
 
-    def head_loss(opv_, y, labels_m):
-        out, _ = post_apply(opv_, obv, rng, True, y)
+    def head_loss(opv_, y, labels_m, key):
+        out, _ = post_apply(opv_, obv, key, True, y)
         with trace_mode():
             wout = jax.tree_util.tree_map(lambda v: Tensor(v), out)
             wlab = [Tensor(v) for v in labels_m]
@@ -455,7 +481,7 @@ def _one_f_one_b_inner(block_apply, pre_apply, post_apply, loss_fn,
         m_f = (tau - d) // 2
         m_safe = jnp.clip(m_f, 0, n_micro - 1)
         x_in = jnp.where(d == 0, pre_of(m_safe), ring_f)
-        y = stage_fn(stage_params, x_in)
+        y = stage_fn(stage_params, x_in, stage_key(m_safe))
         x_stash = lax.dynamic_update_index_in_dim(
             x_stash, x_in, m_safe % pp, 0)
         y_prev = jnp.where(d == pp - 1, y, y_prev)
@@ -472,11 +498,14 @@ def _one_f_one_b_inner(block_apply, pre_apply, post_apply, loss_fn,
         # cotangent into this stage's output: loss head on the last
         # stage (y from the previous step), ring hop elsewhere
         lv_m, (g_post, dy_head) = jax.value_and_grad(
-            head_loss, argnums=(0, 1))(opv, y_prev, labels_m)
+            head_loss, argnums=(0, 1))(opv, y_prev, labels_m,
+                                       jax.random.fold_in(rng, m_safe))
         dy = jnp.where(d == pp - 1, dy_head / n_micro, ring_b)
         x_in = lax.dynamic_index_in_dim(x_stash, m_safe % pp, 0,
                                         keepdims=False)
-        _, stage_vjp = jax.vjp(stage_fn, stage_params, x_in)
+        key_m = stage_key(m_safe)
+        _, stage_vjp = jax.vjp(
+            lambda p, h: stage_fn(p, h, key_m), stage_params, x_in)
         dstage, dx = stage_vjp(dy)
         g_stage = jax.tree_util.tree_map(jnp.add, g_stage, dstage)
         # pre-section grads: replay pre's vjp with the stage-0 input
@@ -484,7 +513,8 @@ def _one_f_one_b_inner(block_apply, pre_apply, post_apply, loss_fn,
         xs_m = [lax.dynamic_index_in_dim(x, m_safe, 0, keepdims=False)
                 for x in ids_micro]
         _, pre_vjp = jax.vjp(
-            lambda ov: pre_apply(ov, obv, rng, True, *xs_m)[0], opv)
+            lambda ov: pre_apply(ov, obv, jax.random.fold_in(rng, m_safe),
+                                 True, *xs_m)[0], opv)
         (g_pre,) = pre_vjp(dx)
         is_first = (d == 0).astype("float32")
         is_last = (d == pp - 1).astype("float32")

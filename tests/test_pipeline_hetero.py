@@ -1,6 +1,8 @@
 """Heterogeneous pipeline parallelism on a real model (reference:
 PipelineOptimizer `fluid/optimizer.py:3718` + SectionWorker F-then-B;
 the parity contract mirrors `test_dist_base.py` loss-vs-local checks)."""
+import jax
+import jax.numpy as jnp
 import numpy as np
 import pytest
 
@@ -40,27 +42,40 @@ def _make(seed=0):
     return net, opt
 
 
-@pytest.mark.parametrize("n_micro,batch", [(4, 8), (8, 16)])
-def test_pp4_dp2_loss_parity_vs_dense(n_micro, batch):
-    """pp=4 × dp=2 pipelined GPT == dense dp=8 step, loss per step."""
+@pytest.mark.parametrize("schedule,n_micro,batch",
+                         [("gpipe", 4, 8), ("gpipe", 8, 16),
+                          ("1f1b", 4, 8), ("1f1b", 8, 16)])
+def test_pp4_dp2_loss_parity_vs_dense(schedule, n_micro, batch):
+    """pp=4 × dp=2 pipelined GPT == dense dp=8 step, loss per step.
+
+    Runs >=4 consecutive steps and asserts every param/opt-state leaf
+    keeps its shape — guards against grad-reassembly bugs that silently
+    corrupt the stacked stage params (the round-2 1f1b failure mode).
+    """
     ids, tgt = _data(b=batch)
 
     net_a, opt_a = _make(seed=42)
     mesh_pp = create_mesh({"dp": 2, "pp": 4})
     step_pp, st_pp = make_pipeline_train_step(
-        net_a, opt_a, lm_loss, n_micro=n_micro, mesh=mesh_pp)
+        net_a, opt_a, lm_loss, n_micro=n_micro, mesh=mesh_pp,
+        schedule=schedule)
 
     net_b, opt_b = _make(seed=42)
     mesh_dp = create_mesh({"dp": 8})
     step_dp, st_dp = make_sharded_train_step(
         net_b, opt_b, lm_loss, mesh=mesh_dp, zero_stage=0)
 
-    for i in range(3):
+    shapes0 = jax.tree_util.tree_map(jnp.shape, (st_pp["params"],
+                                                 st_pp["opt_state"]))
+    for i in range(4):
         st_pp, loss_pp = step_pp(st_pp, (ids,), (tgt,))
         st_dp, loss_dp = step_dp(st_dp, (ids,), (tgt,))
         np.testing.assert_allclose(float(loss_pp), float(loss_dp),
                                    rtol=2e-3,
                                    err_msg=f"step {i} loss diverged")
+        shapes_i = jax.tree_util.tree_map(jnp.shape, (st_pp["params"],
+                                                      st_pp["opt_state"]))
+        assert shapes_i == shapes0, f"state shapes drifted at step {i}"
 
 
 def test_pipeline_trains(n_steps=8):
