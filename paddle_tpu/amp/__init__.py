@@ -54,21 +54,52 @@ def amp_active():
 
 
 def maybe_cast_inputs(op_name, raw_args):
-    """Called from the dispatch core for each op when AMP is active."""
+    """Called from the dispatch core for each op when AMP is active.
+
+    White ops (MXU) get their fp32 inputs cast down to the autocast dtype;
+    black ops (numerically sensitive) get autocast-dtype inputs cast UP to
+    fp32, mirroring the reference's two-list rewrite
+    (`fp16_utils.py:306 cast_model_to_fp16`)."""
     if not _state.enabled:
         return raw_args
+    target = to_jax_dtype(_state.dtype)
     in_white = (op_name in WHITE_LIST or op_name in _state.custom_white) \
         and op_name not in _state.custom_black
-    if not in_white:
-        return raw_args
+    in_black = op_name in BLACK_LIST or op_name in _state.custom_black
+    if in_white:
+        return [a.astype(target)
+                if hasattr(a, "dtype") and a.dtype == jnp.float32 else a
+                for a in raw_args]
+    if in_black:
+        return [a.astype(jnp.float32)
+                if hasattr(a, "dtype") and a.dtype == target else a
+                for a in raw_args]
+    return raw_args
+
+
+# Ops that must COMPUTE in fp32 but, under AMP, should emit the autocast
+# dtype so the activation stream between MXU ops stays bf16 end to end
+# (halves HBM traffic for the residual stream — the TPU-idiomatic policy;
+# the reference keeps these fp32 because fp16 lacks the exponent range,
+# which bf16 does not).
+STREAM_CAST_OUT = {"layer_norm", "softmax"}
+
+
+def maybe_wrap_op(op_name, fn):
+    """Wrap a black-listed stream op so it emits the autocast dtype.
+    Runs inside the op closure, so AD sees the casts (cotangents flow
+    through them) and jit fuses them into the op's kernel."""
+    if not _state.enabled or op_name not in STREAM_CAST_OUT:
+        return fn
+    import jax as _jax
     target = to_jax_dtype(_state.dtype)
-    out = []
-    for a in raw_args:
-        if hasattr(a, "dtype") and a.dtype == jnp.float32:
-            out.append(a.astype(target))
-        else:
-            out.append(a)
-    return out
+
+    def wrapped(*args, **kwargs):
+        out = fn(*args, **kwargs)
+        return _jax.tree_util.tree_map(
+            lambda x: x.astype(target)
+            if hasattr(x, "dtype") and x.dtype == jnp.float32 else x, out)
+    return wrapped
 
 
 @contextlib.contextmanager

@@ -40,6 +40,9 @@ register_flag("FLAGS_cudnn_deterministic", False, "parity: deterministic ops")
 register_flag("FLAGS_benchmark", False, "sync after every op for timing")
 register_flag("FLAGS_use_flash_attention", True,
               "use the Pallas flash-attention kernel on TPU when applicable")
+register_flag("FLAGS_flash_attention_interpret", False,
+              "force the Pallas flash kernels in interpreter mode (CPU "
+              "test meshes; TPU semantics, interpreter speed)")
 
 
 def set_flags(flags: Dict[str, Any]) -> None:

@@ -262,9 +262,10 @@ def apply_op(name: str, fn: Callable, args: Sequence[Any], kwargs: dict):
     raw_kwargs = {k: (v._value if isinstance(v, Tensor) else v)
                   for k, v in kwargs.items()}
 
-    from ..amp import amp_active, maybe_cast_inputs
+    from ..amp import amp_active, maybe_cast_inputs, maybe_wrap_op
     if amp_active():
         raw_args = maybe_cast_inputs(name, raw_args)
+        fn = maybe_wrap_op(name, fn)
 
     # static-graph mode: execute eagerly on placeholder values for
     # shape/dtype propagation AND record the op into the current Program

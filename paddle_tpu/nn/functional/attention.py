@@ -40,20 +40,27 @@ def scaled_dot_product_attention(query, key, value, attn_mask=None,
     d = query.shape[-1]
     scale = 1.0 / (d ** 0.5)
 
+    eff_dropout = dropout_p if training else 0.0
     use_flash = False
-    if flag("FLAGS_use_flash_attention") and attn_mask is None and \
-            dropout_p == 0.0:
-        try:
-            import jax as _j
-            plats = {dd.platform for dd in _j.devices()}
-            use_flash = "tpu" in plats or "axon" in plats
-        except Exception:
-            use_flash = False
+    if flag("FLAGS_use_flash_attention"):
+        from ...ops.pallas_ops import flash_supported
+        if flash_supported(tuple(query.shape), attn_mask):
+            if flag("FLAGS_flash_attention_interpret"):
+                # interpreter mode has no TPU PRNG lowering → no dropout
+                use_flash = eff_dropout == 0.0
+            else:
+                try:
+                    import jax as _j
+                    plats = {dd.platform for dd in _j.devices()}
+                    use_flash = "tpu" in plats or "axon" in plats
+                except Exception:
+                    use_flash = False
 
     if use_flash:
         from ...ops.pallas_ops import flash_attention
-        return flash_attention(query, key, value, causal=is_causal,
-                               scale=scale)
+        return flash_attention(
+            query, key, value, causal=is_causal, scale=scale,
+            attn_mask=attn_mask, dropout_p=eff_dropout)
 
     def impl(q, k, v, *m):
         mask = m[0] if m else None

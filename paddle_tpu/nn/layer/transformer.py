@@ -70,16 +70,32 @@ class MultiHeadAttention(Layer):
                 cache=None):
         key = query if key is None else key
         value = key if value is None else value
-        q = self._shape(self.q_proj(query))
-        if isinstance(cache, self.StaticCache):
-            k, v = cache.k, cache.v
+        fuse_qkv = (key is query and value is query and cache is None
+                    and self.kdim == self.embed_dim
+                    and self.vdim == self.embed_dim)
+        if fuse_qkv:
+            # self-attention fast path: one [E, 3E] matmul feeds the MXU a
+            # 3x wider tile than three [E, E] dots (params stay separate,
+            # so checkpoints still map 1:1; grads flow through the concat)
+            w = M.concat([self.q_proj.weight, self.k_proj.weight,
+                          self.v_proj.weight], axis=1)
+            b = (None if self.q_proj.bias is None else
+                 M.concat([self.q_proj.bias, self.k_proj.bias,
+                           self.v_proj.bias], axis=0))
+            qkv = F.linear(query, w, b)
+            q, k, v = (self._shape(t)
+                       for t in M.split(qkv, 3, axis=-1))
         else:
-            k = self._shape(self.k_proj(key))
-            v = self._shape(self.v_proj(value))
-            if isinstance(cache, self.Cache):
-                k = M.concat([cache.k, k], axis=2)
-                v = M.concat([cache.v, v], axis=2)
-                cache = self.Cache(k, v)
+            q = self._shape(self.q_proj(query))
+            if isinstance(cache, self.StaticCache):
+                k, v = cache.k, cache.v
+            else:
+                k = self._shape(self.k_proj(key))
+                v = self._shape(self.v_proj(value))
+                if isinstance(cache, self.Cache):
+                    k = M.concat([cache.k, k], axis=2)
+                    v = M.concat([cache.v, v], axis=2)
+                    cache = self.Cache(k, v)
 
         out = F.scaled_dot_product_attention(
             q, k, v, attn_mask=attn_mask, dropout_p=self.dropout,

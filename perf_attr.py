@@ -1,0 +1,78 @@
+import glob, gzip, json, re, shutil
+import numpy as np
+import time
+
+import jax
+import jax.numpy as jnp
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+from paddle_tpu.framework.functional import functionalize
+from paddle_tpu.framework.autograd import trace_mode
+from paddle_tpu.framework.tensor import Tensor
+from paddle_tpu.models import ErnieConfig, ErnieForSequenceClassification
+
+paddle.seed(0)
+cfg = ErnieConfig.base()
+net = ErnieForSequenceClassification(cfg, num_classes=2)
+opt = paddle.optimizer.AdamW(5e-5, parameters=net.parameters())
+ce = nn.CrossEntropyLoss()
+apply_fn, pv, bv = functionalize(net)
+opt_state = {n: opt._init_state(v) for n, v in pv.items()}
+def loss_fn(pv_, bv_, rng, ids, labels):
+    from paddle_tpu import amp
+    with trace_mode(), amp.auto_cast(level="O1", dtype="bfloat16"):
+        out, new_bufs = apply_fn(pv_, bv_, rng, True, ids)
+        lv = ce(Tensor(out), Tensor(labels))
+    return jnp.mean(lv._value.astype("float32")), new_bufs
+def step(state, ids, labels):
+    pv_, bv_, opt_state_, step_no, rng = state
+    rng2 = jax.random.fold_in(rng, step_no)
+    (lv, new_bufs), grads = jax.value_and_grad(
+        loss_fn, has_aux=True)(pv_, bv_, rng2, ids, labels)
+    new_pv, new_opt = opt.apply_gradients_pytree(
+        grads, pv_, opt_state_, jnp.asarray(5e-5, "float32"), step_no)
+    return (new_pv, new_bufs, new_opt, step_no + 1, rng), lv
+jit_step = jax.jit(step, donate_argnums=(0,))
+rng_np = np.random.RandomState(0)
+ids = jnp.asarray(rng_np.randint(0, cfg.vocab_size, size=(32, 128)).astype("int32"))
+labels = jnp.asarray(rng_np.randint(0, 2, size=(32,)).astype("int32"))
+state = (pv, bv, opt_state, jnp.asarray(1, "int32"), jax.random.PRNGKey(0))
+comp = jit_step.lower(state, ids, labels).compile()
+txt = comp.as_text()
+# map op result name -> metadata op_name
+meta = {}
+for m in re.finditer(r'%?([\w.\-]+) = [^\n]*metadata=\{op_name="([^"]*)"', txt):
+    meta[m.group(1)] = m.group(2)
+for i in range(3):
+    state, lv = comp(state, ids, labels)
+float(lv)
+shutil.rmtree("/tmp/jaxtrace2", ignore_errors=True)
+jax.profiler.start_trace("/tmp/jaxtrace2")
+for i in range(5):
+    state, lv = comp(state, ids, labels)
+float(lv)
+jax.profiler.stop_trace()
+files = glob.glob("/tmp/jaxtrace2/**/*.trace.json.gz", recursive=True)
+with gzip.open(files[0], "rt") as f:
+    tr = json.load(f)
+from collections import defaultdict
+dur = defaultdict(float)
+pid_names = {}
+for ev in tr.get("traceEvents", []):
+    if ev.get("ph") == "M" and ev.get("name") == "process_name":
+        pid_names[ev["pid"]] = ev["args"].get("name", "")
+xla_pids = {p for p, n in pid_names.items() if "XLA" in n or "TPU" in n or "Ops" in n}
+for ev in tr.get("traceEvents", []):
+    if ev.get("ph") == "X" and "dur" in ev and ev.get("pid") in xla_pids:
+        dur[ev.get("name", "?")] += ev["dur"]
+print("process names:", set(pid_names.values()))
+tot = sum(dur.values())
+print(f"total device op time: {tot/1000/5:.2f} ms/step over {len(dur)} ops")
+grp = defaultdict(float)
+for name, d in dur.items():
+    key = meta.get(name.lstrip('%'), name)
+    # collapse per-layer indices
+    key = re.sub(r'\d+', 'N', key)
+    grp[key] += d
+for name, d in sorted(grp.items(), key=lambda kv: -kv[1])[:30]:
+    print(f"{d/1000/5:8.3f} ms/step  {name[:120]}")

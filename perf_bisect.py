@@ -1,0 +1,81 @@
+import json
+import time
+
+import numpy as np
+
+
+def run(tag, dropout, amp_level="O1", iters=20, batch=32, seq=128):
+    import jax
+    import jax.numpy as jnp
+    import paddle_tpu as paddle
+    import paddle_tpu.nn as nn
+    from paddle_tpu.framework.functional import functionalize
+    from paddle_tpu.framework.autograd import trace_mode
+    from paddle_tpu.framework.tensor import Tensor
+    from paddle_tpu.models import ErnieConfig, ErnieForSequenceClassification
+
+    paddle.seed(0)
+    cfg = ErnieConfig.base()
+    cfg.hidden_dropout_prob = dropout
+    cfg.attention_probs_dropout_prob = dropout
+    net = ErnieForSequenceClassification(cfg, num_classes=2)
+    opt = paddle.optimizer.AdamW(5e-5, parameters=net.parameters())
+    ce = nn.CrossEntropyLoss()
+
+    apply_fn, pv, bv = functionalize(net)
+    opt_state = {n: opt._init_state(v) for n, v in pv.items()}
+
+    import contextlib
+
+    def loss_fn(pv_, bv_, rng, ids, labels):
+        from paddle_tpu import amp
+        ctx = (amp.auto_cast(level=amp_level, dtype="bfloat16")
+               if amp_level else contextlib.nullcontext())
+        with trace_mode(), ctx:
+            out, new_bufs = apply_fn(pv_, bv_, rng, True, ids)
+            lv = ce(Tensor(out), Tensor(labels))
+        return jnp.mean(lv._value.astype("float32")), new_bufs
+
+    def step(pv_, bv_, opt_state_, step_no, rng, ids, labels):
+        (lv, new_bufs), grads = jax.value_and_grad(
+            loss_fn, has_aux=True)(pv_, bv_, rng, ids, labels)
+        new_pv, new_opt = opt.apply_gradients_pytree(
+            grads, pv_, opt_state_, jnp.asarray(5e-5, "float32"), step_no)
+        return lv, new_pv, new_bufs, new_opt
+
+    jit_step = jax.jit(step, donate_argnums=(0, 2))
+    rng_np = np.random.RandomState(0)
+    ids = jnp.asarray(rng_np.randint(0, cfg.vocab_size,
+                                     size=(batch, seq)).astype("int32"))
+    labels = jnp.asarray(rng_np.randint(0, 2, size=(batch,)).astype("int32"))
+    key = jax.random.PRNGKey(0)
+    step_no = jnp.asarray(1, "int32")
+    for i in range(3):
+        lv, pv, bv, opt_state = jit_step(pv, bv, opt_state, step_no + i,
+                                         key, ids, labels)
+    float(lv)
+    t0 = time.perf_counter()
+    for i in range(iters):
+        lv, pv, bv, opt_state = jit_step(pv, bv, opt_state,
+                                         step_no + 3 + i, key, ids, labels)
+    float(lv)
+    dt = time.perf_counter() - t0
+    sps = batch * iters / dt
+    ms = 1000 * dt / iters
+    H, I, L, S = 768, 3072, 12, seq
+    per_tok = 6 * L * (4 * H * H + 2 * H * I) + 12 * L * S * H
+    tflops = per_tok * batch * seq / (dt / iters) / 1e12
+    print(f"{tag:30s} {ms:7.2f} ms/step  {sps:8.1f} samples/s  "
+          f"{tflops:6.1f} TF/s  mfu={tflops/197:.3f}", flush=True)
+
+
+if __name__ == "__main__":
+    run("baseline d=0.1 O1", 0.1)
+    run("dropout=0      O1", 0.0)
+    run("dropout=0.1    O2", 0.1, amp_level="O2")
+    run("dropout=0.1  fp32", 0.1, amp_level=None)
+
+def run_prng(impl):
+    import jax
+    jax.config.update("jax_default_prng_impl", impl)
+    run(f"dropout=0.1 O1 prng={impl}", 0.1)

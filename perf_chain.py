@@ -1,0 +1,26 @@
+import time
+import jax, jax.numpy as jnp
+import numpy as np
+
+def chain(m, k, n, count, iters=20):
+    ws = [jnp.asarray(np.random.randn(k, n)*0.02, jnp.bfloat16) for _ in range(count)]
+    x = jnp.asarray(np.random.randn(m, k), jnp.bfloat16)
+    @jax.jit
+    def f(x, ws):
+        h = x
+        for w in ws:
+            h = h @ w
+        return h
+    float(jnp.sum(f(x, ws)))
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        y = f(x, ws)
+    float(jnp.sum(y))
+    dt = (time.perf_counter() - t0)/iters
+    fl = 2*m*k*n*count
+    print(f"{count}x [{m},{k}]x[{k},{n}]: {dt*1e3:7.2f} ms {fl/dt/1e12:6.1f} TF/s ({fl/dt/1e12/197*100:4.1f}%) per-dot {dt/count*1e6:6.1f}us")
+
+chain(4096, 768, 768, 24)
+chain(4096, 768, 768, 96)
+chain(4096, 3072, 3072, 24)
+chain(8192, 4096, 4096, 8)

@@ -1,0 +1,58 @@
+import jax
+jax.config.update("jax_default_prng_impl", "rbg")
+import numpy as np
+import jax.numpy as jnp
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+from paddle_tpu.framework.functional import functionalize
+from paddle_tpu.framework.autograd import trace_mode
+from paddle_tpu.framework.tensor import Tensor
+from paddle_tpu.models import ErnieConfig, ErnieForSequenceClassification
+
+paddle.seed(0)
+cfg = ErnieConfig.base()
+net = ErnieForSequenceClassification(cfg, num_classes=2)
+opt = paddle.optimizer.AdamW(5e-5, parameters=net.parameters())
+ce = nn.CrossEntropyLoss()
+apply_fn, pv, bv = functionalize(net)
+opt_state = {n: opt._init_state(v) for n, v in pv.items()}
+def loss_fn(pv_, bv_, rng, ids, labels):
+    from paddle_tpu import amp
+    with trace_mode(), amp.auto_cast(level="O1", dtype="bfloat16"):
+        out, new_bufs = apply_fn(pv_, bv_, rng, True, ids)
+        lv = ce(Tensor(out), Tensor(labels))
+    return jnp.mean(lv._value.astype("float32")), new_bufs
+def step(pv_, bv_, opt_state_, step_no, rng, ids, labels):
+    (lv, new_bufs), grads = jax.value_and_grad(
+        loss_fn, has_aux=True)(pv_, bv_, rng, ids, labels)
+    new_pv, new_opt = opt.apply_gradients_pytree(
+        grads, pv_, opt_state_, jnp.asarray(5e-5, "float32"), step_no)
+    return lv, new_pv, new_bufs, new_opt
+jit_step = jax.jit(step, donate_argnums=(0, 2))
+rng_np = np.random.RandomState(0)
+ids = jnp.asarray(rng_np.randint(0, cfg.vocab_size, size=(32, 128)).astype("int32"))
+labels = jnp.asarray(rng_np.randint(0, 2, size=(32,)).astype("int32"))
+key = jax.random.PRNGKey(0)
+step_no = jnp.asarray(1, "int32")
+comp = jit_step.lower(pv, bv, opt_state, step_no, key, ids, labels).compile()
+ca = comp.cost_analysis()
+if isinstance(ca, list): ca = ca[0]
+print("flops:", ca.get("flops"), " bytes:", ca.get("bytes accessed"))
+print("transcendentals:", ca.get("transcendentals"))
+txt = comp.as_text()
+import re
+# all dot ops with operand dtypes
+dots = {}
+for m in re.finditer(r'(\w+\[[^\]]*\]) dot\(', txt):
+    out_t = m.group(1).split('[')[0]
+    dots[out_t] = dots.get(out_t, 0) + 1
+print("dot output dtypes:", dots)
+f32dots = [l.strip()[:160] for l in txt.splitlines() if ' dot(' in l and l.strip().startswith('f32')]
+print("f32 dots:", len(f32dots))
+for l in f32dots[:10]: print("  ", l)
+# count rng ops
+print("rng-bit-generator:", txt.count("rng-bit-generator"))
+# big fusions named in profile: find fusion.3122 body size
+for fn in ["fusion.3122", "fusion.3155", "fusion.8", "fusion.6", "fusion.3160"]:
+    m = re.search(rf'{fn} = [^\n]*', txt)
+    if m: print(fn, "->", m.group(0)[:200])

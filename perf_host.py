@@ -1,0 +1,60 @@
+import time
+import numpy as np
+
+def run(tag, aot, dropout=0.1, iters=30):
+    import jax
+    import jax.numpy as jnp
+    import paddle_tpu as paddle
+    import paddle_tpu.nn as nn
+    from paddle_tpu.framework.functional import functionalize
+    from paddle_tpu.framework.autograd import trace_mode
+    from paddle_tpu.framework.tensor import Tensor
+    from paddle_tpu.models import ErnieConfig, ErnieForSequenceClassification
+    paddle.seed(0)
+    cfg = ErnieConfig.base()
+    cfg.hidden_dropout_prob = dropout
+    cfg.attention_probs_dropout_prob = dropout
+    net = ErnieForSequenceClassification(cfg, num_classes=2)
+    opt = paddle.optimizer.AdamW(5e-5, parameters=net.parameters())
+    ce = nn.CrossEntropyLoss()
+    apply_fn, pv, bv = functionalize(net)
+    opt_state = {n: opt._init_state(v) for n, v in pv.items()}
+    def loss_fn(pv_, bv_, rng, ids, labels):
+        from paddle_tpu import amp
+        with trace_mode(), amp.auto_cast(level="O1", dtype="bfloat16"):
+            out, new_bufs = apply_fn(pv_, bv_, rng, True, ids)
+            lv = ce(Tensor(out), Tensor(labels))
+        return jnp.mean(lv._value.astype("float32")), new_bufs
+    def step(state, ids, labels):
+        pv_, bv_, opt_state_, step_no, rng = state
+        rng2 = jax.random.fold_in(rng, step_no)
+        (lv, new_bufs), grads = jax.value_and_grad(
+            loss_fn, has_aux=True)(pv_, bv_, rng2, ids, labels)
+        new_pv, new_opt = opt.apply_gradients_pytree(
+            grads, pv_, opt_state_, jnp.asarray(5e-5, "float32"), step_no)
+        return (new_pv, new_bufs, new_opt, step_no + 1, rng), lv
+    jit_step = jax.jit(step, donate_argnums=(0,))
+    rng_np = np.random.RandomState(0)
+    ids = jnp.asarray(rng_np.randint(0, cfg.vocab_size, size=(32, 128)).astype("int32"))
+    labels = jnp.asarray(rng_np.randint(0, 2, size=(32,)).astype("int32"))
+    state = (pv, bv, opt_state, jnp.asarray(1, "int32"), jax.random.PRNGKey(0))
+    fn = jit_step
+    if aot:
+        fn = jit_step.lower(state, ids, labels).compile()
+    for i in range(3):
+        state, lv = fn(state, ids, labels)
+    float(lv)
+    t0 = time.perf_counter()
+    for i in range(iters):
+        state, lv = fn(state, ids, labels)
+    float(lv)
+    dt = time.perf_counter() - t0
+    ms = 1000 * dt / iters
+    H, I, L, S = 768, 3072, 12, 128
+    per_tok = 6 * L * (4 * H * H + 2 * H * I) + 12 * L * S * H
+    tflops = per_tok * 32 * S / (dt / iters) / 1e12
+    print(f"{tag:22s} {ms:7.2f} ms/step  {32*iters/dt:8.1f} samples/s  mfu={tflops/197:.3f}", flush=True)
+
+if __name__ == "__main__":
+    run("state-carried jit", aot=False)
+    run("state-carried AOT", aot=True)

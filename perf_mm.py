@@ -1,0 +1,30 @@
+import time
+import jax, jax.numpy as jnp
+from jax import lax
+import numpy as np
+
+def bench(m, k, n, iters=100, dtype=jnp.bfloat16):
+    a = jnp.asarray(np.random.randn(m, k), dtype)
+    b = jnp.asarray(np.random.randn(k, n), dtype)
+    @jax.jit
+    def f(a, b):
+        def body(c, _):
+            # vary a slightly to prevent CSE/loop-invariant hoisting
+            c2 = (a + c[0,0].astype(a.dtype)) @ b
+            return c2, ()
+        c0 = jnp.zeros((m, n), dtype)
+        c, _ = lax.scan(body, c0, None, length=iters)
+        return c
+    float(jnp.sum(f(a, b)))
+    t0 = time.perf_counter()
+    c = f(a, b); float(jnp.sum(c))
+    dt = (time.perf_counter() - t0) / iters
+    fl = 2*m*k*n
+    print(f"[{m},{k}]x[{k},{n}]: {dt*1e6:8.1f} us  {fl/dt/1e12:6.1f} TF/s  ({fl/dt/1e12/197*100:4.1f}%)")
+
+bench(4096, 768, 2304)
+bench(4096, 768, 768)
+bench(4096, 768, 3072)
+bench(4096, 3072, 768)
+bench(768, 4096, 3072)
+bench(8192, 8192, 8192, iters=20)

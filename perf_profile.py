@@ -1,0 +1,65 @@
+import jax
+jax.config.update("jax_default_prng_impl", "rbg")
+import perf_bisect, glob, gzip, json, os, shutil
+shutil.rmtree("/tmp/jaxtrace", ignore_errors=True)
+
+import time
+import numpy as np
+
+def profiled():
+    import jax.numpy as jnp
+    import paddle_tpu as paddle
+    import paddle_tpu.nn as nn
+    from paddle_tpu.framework.functional import functionalize
+    from paddle_tpu.framework.autograd import trace_mode
+    from paddle_tpu.framework.tensor import Tensor
+    from paddle_tpu.models import ErnieConfig, ErnieForSequenceClassification
+    paddle.seed(0)
+    cfg = ErnieConfig.base()
+    net = ErnieForSequenceClassification(cfg, num_classes=2)
+    opt = paddle.optimizer.AdamW(5e-5, parameters=net.parameters())
+    ce = nn.CrossEntropyLoss()
+    apply_fn, pv, bv = functionalize(net)
+    opt_state = {n: opt._init_state(v) for n, v in pv.items()}
+    def loss_fn(pv_, bv_, rng, ids, labels):
+        from paddle_tpu import amp
+        with trace_mode(), amp.auto_cast(level="O1", dtype="bfloat16"):
+            out, new_bufs = apply_fn(pv_, bv_, rng, True, ids)
+            lv = ce(Tensor(out), Tensor(labels))
+        return jnp.mean(lv._value.astype("float32")), new_bufs
+    def step(pv_, bv_, opt_state_, step_no, rng, ids, labels):
+        (lv, new_bufs), grads = jax.value_and_grad(
+            loss_fn, has_aux=True)(pv_, bv_, rng, ids, labels)
+        new_pv, new_opt = opt.apply_gradients_pytree(
+            grads, pv_, opt_state_, jnp.asarray(5e-5, "float32"), step_no)
+        return lv, new_pv, new_bufs, new_opt
+    jit_step = jax.jit(step, donate_argnums=(0, 2))
+    rng_np = np.random.RandomState(0)
+    ids = jnp.asarray(rng_np.randint(0, cfg.vocab_size, size=(32, 128)).astype("int32"))
+    labels = jnp.asarray(rng_np.randint(0, 2, size=(32,)).astype("int32"))
+    key = jax.random.PRNGKey(0)
+    step_no = jnp.asarray(1, "int32")
+    for i in range(3):
+        lv, pv, bv, opt_state = jit_step(pv, bv, opt_state, step_no + i, key, ids, labels)
+    float(lv)
+    jax.profiler.start_trace("/tmp/jaxtrace")
+    for i in range(5):
+        lv, pv, bv, opt_state = jit_step(pv, bv, opt_state, step_no + 3 + i, key, ids, labels)
+    float(lv)
+    jax.profiler.stop_trace()
+
+profiled()
+files = glob.glob("/tmp/jaxtrace/**/*.trace.json.gz", recursive=True)
+print("trace files:", files)
+if files:
+    with gzip.open(files[0], "rt") as f:
+        tr = json.load(f)
+    from collections import defaultdict
+    dur = defaultdict(float)
+    for ev in tr.get("traceEvents", []):
+        if ev.get("ph") == "X" and "dur" in ev:
+            name = ev.get("name", "?")
+            dur[name] += ev["dur"]
+    top = sorted(dur.items(), key=lambda kv: -kv[1])[:40]
+    for name, d in top:
+        print(f"{d/1000:9.2f} ms  {name[:110]}")
