@@ -43,6 +43,10 @@ register_flag("FLAGS_use_flash_attention", True,
 register_flag("FLAGS_flash_attention_interpret", False,
               "force the Pallas flash kernels in interpreter mode (CPU "
               "test meshes; TPU semantics, interpreter speed)")
+register_flag("FLAGS_flash_attention_min_seq", 512,
+              "shortest query length dispatched to the Pallas flash kernel; "
+              "below this XLA's fused dense attention wins (measured "
+              "crossover on v5e; see tools/perf_attr.py)")
 
 
 def set_flags(flags: Dict[str, Any]) -> None:

@@ -17,19 +17,26 @@ from ...framework.tensor import apply_op
 __all__ = ["scaled_dot_product_attention"]
 
 
-def _sdpa_ref(q, k, v, mask, scale, is_causal):
+def _sdpa_ref(q, k, v, mask, scale, is_causal, dropout_p=0.0, rng=None):
     # q,k,v: [B, H, S, D]
     s = jnp.einsum("bhqd,bhkd->bhqk", q, k) * scale
     if is_causal:
         S, K = s.shape[-2], s.shape[-1]
-        causal = jnp.tril(jnp.ones((S, K), bool))
-        s = jnp.where(causal, s, -1e30)
+        # bottom-right aligned: query i sits at absolute position K-S+i, so
+        # the KV-cache decode shape (S < K) attends to the whole prefix
+        qpos = jnp.arange(S)[:, None] + (K - S)
+        s = jnp.where(qpos >= jnp.arange(K)[None, :], s, -1e30)
     if mask is not None:
         if mask.dtype == jnp.bool_:
             s = jnp.where(mask, s, -1e30)
         else:
             s = s + mask
     p = jax.nn.softmax(s, axis=-1)
+    if dropout_p > 0.0:
+        # dropout on the softmax probabilities (upscale-in-train), matching
+        # the Pallas kernel's in-kernel semantics — NOT on the output
+        keep = jax.random.bernoulli(rng, 1.0 - dropout_p, p.shape)
+        p = jnp.where(keep, p / (1.0 - dropout_p), 0.0).astype(p.dtype)
     return jnp.einsum("bhqk,bhkd->bhqd", p, v)
 
 
@@ -44,7 +51,9 @@ def scaled_dot_product_attention(query, key, value, attn_mask=None,
     use_flash = False
     if flag("FLAGS_use_flash_attention"):
         from ...ops.pallas_ops import flash_supported
-        if flash_supported(tuple(query.shape), attn_mask):
+        if flash_supported(tuple(query.shape), tuple(key.shape),
+                           tuple(value.shape), attn_mask,
+                           is_causal=is_causal):
             if flag("FLAGS_flash_attention_interpret"):
                 # interpreter mode has no TPU PRNG lowering → no dropout
                 use_flash = eff_dropout == 0.0
@@ -62,12 +71,14 @@ def scaled_dot_product_attention(query, key, value, attn_mask=None,
             query, key, value, causal=is_causal, scale=scale,
             attn_mask=attn_mask, dropout_p=eff_dropout)
 
+    if eff_dropout > 0.0:
+        from ...framework.random import get_rng_key
+        rng = get_rng_key()
+    else:
+        rng = None
+
     def impl(q, k, v, *m):
         mask = m[0] if m else None
-        return _sdpa_ref(q, k, v, mask, scale, is_causal)
+        return _sdpa_ref(q, k, v, mask, scale, is_causal, eff_dropout, rng)
     args = (query, key, value) + ((attn_mask,) if attn_mask is not None else ())
-    out = apply_op("sdpa", impl, args, {})
-    if dropout_p > 0.0 and training:
-        from .common import dropout
-        out = dropout(out, dropout_p, training=training)
-    return out
+    return apply_op("sdpa", impl, args, {})

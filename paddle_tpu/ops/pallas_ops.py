@@ -252,49 +252,51 @@ def _smem_scalar_spec():
 
 def _flash_call(q, k, v, bias, seed, causal, scale, dropout_p,
                 block_q, block_k):
-    B, H, S, D = q.shape
-    qr = q.reshape(B * H, S, D)
-    kr = k.reshape(B * H, S, D)
-    vr = v.reshape(B * H, S, D)
-    bias3 = bias.reshape(B, 1, S)
+    B, H, Sq, D = q.shape
+    Sk = k.shape[2]
+    qr = q.reshape(B * H, Sq, D)
+    kr = k.reshape(B * H, Sk, D)
+    vr = v.reshape(B * H, Sk, D)
+    bias3 = bias.reshape(B, 1, Sk)
     seed_arr = jnp.asarray(seed, jnp.int32).reshape(1, 1)
     kernel = functools.partial(_fwd_kernel, scale=scale, causal=causal,
                                block_k=block_k, dropout_p=dropout_p)
     STATS["flash_fwd"] += 1
     out, lse = pl.pallas_call(
         kernel,
-        grid=(B * H, S // block_q),
+        grid=(B * H, Sq // block_q),
         in_specs=[
             _smem_scalar_spec(),
             pl.BlockSpec((None, block_q, D), lambda b, i: (b, i, 0)),
-            pl.BlockSpec((None, S, D), lambda b, i: (b, 0, 0)),
-            pl.BlockSpec((None, S, D), lambda b, i: (b, 0, 0)),
-            pl.BlockSpec((None, 1, S), lambda b, i: (b // H, 0, 0)),
+            pl.BlockSpec((None, Sk, D), lambda b, i: (b, 0, 0)),
+            pl.BlockSpec((None, Sk, D), lambda b, i: (b, 0, 0)),
+            pl.BlockSpec((None, 1, Sk), lambda b, i: (b // H, 0, 0)),
         ],
         out_specs=[
             pl.BlockSpec((None, block_q, D), lambda b, i: (b, i, 0)),
             pl.BlockSpec((None, block_q, 1), lambda b, i: (b, i, 0)),
         ],
         out_shape=[
-            jax.ShapeDtypeStruct((B * H, S, D), q.dtype),
-            jax.ShapeDtypeStruct((B * H, S, 1), jnp.float32),
+            jax.ShapeDtypeStruct((B * H, Sq, D), q.dtype),
+            jax.ShapeDtypeStruct((B * H, Sq, 1), jnp.float32),
         ],
         interpret=_interpret(),
     )(seed_arr, qr, kr, vr, bias3)
-    return out.reshape(B, H, S, D), lse
+    return out.reshape(B, H, Sq, D), lse
 
 
 def _flash_bwd_call(q, k, v, bias, seed, out, lse, g, causal, scale,
                     dropout_p, block_q, block_k):
-    B, H, S, D = q.shape
-    qr = q.reshape(B * H, S, D)
-    kr = k.reshape(B * H, S, D)
-    vr = v.reshape(B * H, S, D)
-    gr = g.reshape(B * H, S, D)
-    bias3 = bias.reshape(B, 1, S)
+    B, H, Sq, D = q.shape
+    Sk = k.shape[2]
+    qr = q.reshape(B * H, Sq, D)
+    kr = k.reshape(B * H, Sk, D)
+    vr = v.reshape(B * H, Sk, D)
+    gr = g.reshape(B * H, Sq, D)
+    bias3 = bias.reshape(B, 1, Sk)
     # delta = rowsum(dO ∘ O) — tiny elementwise+reduce, XLA fuses it
     delta = jnp.sum(gr.astype(jnp.float32)
-                    * out.reshape(B * H, S, D).astype(jnp.float32),
+                    * out.reshape(B * H, Sq, D).astype(jnp.float32),
                     axis=-1, keepdims=True)
     seed_arr = jnp.asarray(seed, jnp.int32).reshape(1, 1)
     STATS["flash_bwd"] += 1
@@ -302,48 +304,48 @@ def _flash_bwd_call(q, k, v, bias, seed, out, lse, g, causal, scale,
     dq = pl.pallas_call(
         functools.partial(_dq_kernel, scale=scale, causal=causal,
                           block_k=block_k, dropout_p=dropout_p),
-        grid=(B * H, S // block_q),
+        grid=(B * H, Sq // block_q),
         in_specs=[
             _smem_scalar_spec(),
             pl.BlockSpec((None, block_q, D), lambda b, i: (b, i, 0)),
-            pl.BlockSpec((None, S, D), lambda b, i: (b, 0, 0)),
-            pl.BlockSpec((None, S, D), lambda b, i: (b, 0, 0)),
-            pl.BlockSpec((None, 1, S), lambda b, i: (b // H, 0, 0)),
+            pl.BlockSpec((None, Sk, D), lambda b, i: (b, 0, 0)),
+            pl.BlockSpec((None, Sk, D), lambda b, i: (b, 0, 0)),
+            pl.BlockSpec((None, 1, Sk), lambda b, i: (b // H, 0, 0)),
             pl.BlockSpec((None, block_q, D), lambda b, i: (b, i, 0)),
             pl.BlockSpec((None, block_q, 1), lambda b, i: (b, i, 0)),
             pl.BlockSpec((None, block_q, 1), lambda b, i: (b, i, 0)),
         ],
         out_specs=pl.BlockSpec((None, block_q, D), lambda b, i: (b, i, 0)),
-        out_shape=jax.ShapeDtypeStruct((B * H, S, D), q.dtype),
+        out_shape=jax.ShapeDtypeStruct((B * H, Sq, D), q.dtype),
         interpret=_interpret(),
     )(seed_arr, qr, kr, vr, bias3, gr, lse, delta)
 
     dk, dv = pl.pallas_call(
         functools.partial(_dkv_kernel, scale=scale, causal=causal,
                           block_q=block_q, dropout_p=dropout_p),
-        grid=(B * H, S // block_k),
+        grid=(B * H, Sk // block_k),
         in_specs=[
             _smem_scalar_spec(),
-            pl.BlockSpec((None, S, D), lambda b, i: (b, 0, 0)),
+            pl.BlockSpec((None, Sq, D), lambda b, i: (b, 0, 0)),
             pl.BlockSpec((None, block_k, D), lambda b, i: (b, i, 0)),
             pl.BlockSpec((None, block_k, D), lambda b, i: (b, i, 0)),
             pl.BlockSpec((None, 1, block_k), lambda b, i: (b // H, 0, i)),
-            pl.BlockSpec((None, S, D), lambda b, i: (b, 0, 0)),
-            pl.BlockSpec((None, S, 1), lambda b, i: (b, 0, 0)),
-            pl.BlockSpec((None, S, 1), lambda b, i: (b, 0, 0)),
+            pl.BlockSpec((None, Sq, D), lambda b, i: (b, 0, 0)),
+            pl.BlockSpec((None, Sq, 1), lambda b, i: (b, 0, 0)),
+            pl.BlockSpec((None, Sq, 1), lambda b, i: (b, 0, 0)),
         ],
         out_specs=[
             pl.BlockSpec((None, block_k, D), lambda b, i: (b, i, 0)),
             pl.BlockSpec((None, block_k, D), lambda b, i: (b, i, 0)),
         ],
         out_shape=[
-            jax.ShapeDtypeStruct((B * H, S, D), q.dtype),
-            jax.ShapeDtypeStruct((B * H, S, D), q.dtype),
+            jax.ShapeDtypeStruct((B * H, Sk, D), q.dtype),
+            jax.ShapeDtypeStruct((B * H, Sk, D), q.dtype),
         ],
         interpret=_interpret(),
     )(seed_arr, qr, kr, vr, bias3, gr, lse, delta)
-    return (dq.reshape(B, H, S, D), dk.reshape(B, H, S, D),
-            dv.reshape(B, H, S, D))
+    return (dq.reshape(B, H, Sq, D), dk.reshape(B, H, Sk, D),
+            dv.reshape(B, H, Sk, D))
 
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(5, 6, 7))
@@ -378,17 +380,38 @@ def _flash_bwd_rule(causal, scale, dropout_p, res, g):
 flash_attention_raw.defvjp(_flash_fwd_rule, _flash_bwd_rule)
 
 
-def flash_supported(q_shape, mask):
-    """Static gate: shapes the kernels handle."""
+def flash_supported(q_shape, k_shape=None, v_shape=None, mask=None,
+                    is_causal=False, min_seq=None):
+    """Static gate: shapes the kernels handle AND where they win.
+
+    Below `min_seq` queries (default: FLAGS_flash_attention_min_seq, 512)
+    XLA's fused dense attention beats the Pallas kernel on v5e — dense won
+    the round-2/3 bench at seq 128 by ~25% — so short sequences are
+    refused here and ride the jnp fallback.
+    """
     if not _HAS_PALLAS or len(q_shape) != 4:
         return False
-    B, H, S, D = q_shape
-    if S % _BLOCK_Q != 0 or S % _BLOCK_K != 0 or D % 8 != 0 or D > 512:
+    B, H, Sq, D = q_shape
+    k_shape = tuple(k_shape) if k_shape is not None else tuple(q_shape)
+    v_shape = tuple(v_shape) if v_shape is not None else k_shape
+    if len(k_shape) != 4 or k_shape != v_shape:
+        return False
+    Bk, Hk, Sk, Dk = k_shape
+    if (Bk, Hk, Dk) != (B, H, D):
+        return False
+    if is_causal and Sk != Sq:  # causal ranges assume aligned diagonals
+        return False
+    if Sq % _BLOCK_Q != 0 or Sk % _BLOCK_K != 0 or D % 8 != 0 or D > 512:
+        return False
+    if min_seq is None:
+        from ..framework.flags import flag
+        min_seq = flag("FLAGS_flash_attention_min_seq")
+    if Sq < min_seq:
         return False
     if mask is not None:
         ms = getattr(mask, "shape", None)
         if ms is None or len(ms) != 4 or ms[1] != 1 or ms[2] != 1 \
-                or ms[0] != B or ms[3] != S:
+                or ms[0] != B or ms[3] != Sk:
             return False
     return True
 
@@ -397,13 +420,13 @@ def flash_attention(query, key, value, causal=False, scale=None,
                     attn_mask=None, dropout_p=0.0):
     """Framework-level entry: Tensor in/out, tape-recorded.
 
-    attn_mask: None, or a [B, 1, 1, S] additive (float) / boolean
+    attn_mask: None, or a [B, 1, 1, S_kv] additive (float) / boolean
     key-padding mask — the padded-batch BERT/ERNIE shape.
     """
     from ..framework.tensor import apply_op, Tensor
     if scale is None:
         scale = 1.0 / (query.shape[-1] ** 0.5)
-    B, S = query.shape[0], query.shape[2]
+    B, S = key.shape[0], key.shape[2]
     if attn_mask is None:
         bias = jnp.zeros((B, S), jnp.float32)
     else:
