@@ -1,19 +1,134 @@
-"""Benchmark: ERNIE-base (L12/H768/A12, seq 128) full training step
-(fwd+bwd+AdamW fused in one XLA program), bf16 compute via AMP autocast —
-the PaddleNLP ERNIE-base finetune configuration from BASELINE.md.
+"""Round benchmark for paddle_tpu on one real TPU chip.
 
-Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
-Runs on whatever accelerator jax exposes (the driver provides the TPU).
+Configs (BASELINE.md / BASELINE.json):
+  1. ERNIE-base finetune, bs32 seq128, bf16 AMP, fused train step — the
+     headline PaddleNLP configuration. Printed LAST (the driver parses the
+     final JSON line).
+  2. ResNet-50 train step, bs32 224x224, bf16 AMP — the PaddleClas config
+     (BASELINE.json lists it first).
+  3. GPT long-sequence (seq 2048) causal train step with the Pallas flash
+     kernel ON vs OFF — proves the flash crossover gate points the right way.
+
+Each metric prints ONE JSON line:
+  {"metric", "value", "unit", "vs_baseline", "mfu"}
+vs_baseline is the ratio against the best previously recorded run of the
+same metric (BENCH_r*.json / the table in BASELINE.md), not a hardcoded 1.0.
+A >2% drop on the headline metric prints a loud REGRESSION line on stderr
+(reference gates op perf the same way: tools/check_op_benchmark_result.py).
+
+Backend init rides a bounded retry with a hard timeout so a flaky TPU
+tunnel yields a diagnosable JSON line instead of a bare rc=1 traceback
+(BENCH_r04.json died that way).
 """
 from __future__ import annotations
 
+import glob
 import json
+import os
+import sys
+import threading
 import time
+import traceback
 
 import numpy as np
 
+V5E_PEAK_BF16 = 197e12  # FLOP/s, one v5e chip
 
-def main():
+# BENCH_SMOKE=1: tiny shapes/iters so the full script is CPU-testable in CI
+_SMOKE = bool(os.environ.get("BENCH_SMOKE"))
+
+_HEADLINE = "ernie_base_train_samples_per_sec_bs32_seq128_bf16"
+# best recorded value per metric if the BENCH_r*.json history is unreadable
+_FALLBACK_BEST = {_HEADLINE: 1033.89}
+
+
+def _best_prior(metric):
+    """Best previously recorded value for `metric` from the round history."""
+    best = _FALLBACK_BEST.get(metric)
+    root = os.path.dirname(os.path.abspath(__file__))
+    for path in glob.glob(os.path.join(root, "BENCH_r*.json")):
+        try:
+            with open(path) as f:
+                rec = json.load(f)
+        except Exception:
+            continue
+        entries = []
+        parsed = rec.get("parsed")
+        if isinstance(parsed, dict):
+            entries.append(parsed)
+        for line in (rec.get("tail") or "").splitlines():
+            line = line.strip()
+            if line.startswith("{"):
+                try:
+                    entries.append(json.loads(line))
+                except Exception:
+                    pass
+        for e in entries:
+            if e.get("metric") == metric and isinstance(
+                    e.get("value"), (int, float)) and e["value"] > 0:
+                best = max(best or 0, float(e["value"])) or None
+    return best
+
+
+def _emit(metric, value, unit, mfu=None, extra=None):
+    best = _best_prior(metric)
+    rec = {"metric": metric, "value": round(float(value), 2), "unit": unit,
+           "vs_baseline": round(float(value) / best, 4) if best else 1.0}
+    if mfu is not None:
+        rec["mfu"] = round(float(mfu), 4)
+    if extra:
+        rec.update(extra)
+    print(json.dumps(rec), flush=True)
+    return rec
+
+
+def _init_backend(attempts=3, timeout_s=150):
+    """Touch the accelerator with retries + a hard timeout per attempt."""
+    import jax
+    # this image's sitecustomize imports jax before our env vars can take
+    # effect and its axon wrapper ignores JAX_PLATFORMS — mirror the env
+    # into jax.config so JAX_PLATFORMS=cpu really selects the CPU backend
+    plat = os.environ.get("JAX_PLATFORMS")
+    if plat:
+        try:
+            jax.config.update("jax_platforms", plat)
+        except Exception:
+            pass
+    last = [None]
+    for i in range(attempts):
+        done = threading.Event()
+
+        def probe():
+            try:
+                devs = jax.devices()
+                _ = jax.numpy.zeros((8, 8)) @ jax.numpy.zeros((8, 8))
+                _.block_until_ready()
+                last[0] = devs
+            except Exception as e:  # noqa: BLE001
+                last[0] = e
+            finally:
+                done.set()
+
+        t = threading.Thread(target=probe, daemon=True)
+        t.start()
+        if not done.wait(timeout_s):
+            last[0] = TimeoutError(
+                f"backend init exceeded {timeout_s}s (attempt {i + 1})")
+        if isinstance(last[0], list):
+            return last[0]
+        sys.stderr.write(f"backend init attempt {i + 1}/{attempts} failed: "
+                         f"{last[0]!r}\n")
+        time.sleep(5 * (i + 1))
+    raise RuntimeError(f"backend unavailable after {attempts} attempts: "
+                       f"{last[0]!r}")
+
+
+def _count_params(pv):
+    import jax
+    return sum(int(np.prod(x.shape)) for x in jax.tree_util.tree_leaves(pv))
+
+
+def bench_ernie():
     import jax
     import jax.numpy as jnp
     import paddle_tpu as paddle
@@ -23,14 +138,15 @@ def main():
     from paddle_tpu.framework.tensor import Tensor
     from paddle_tpu.models import ErnieConfig, ErnieForSequenceClassification
 
-    BATCH, SEQ = 32, 128
+    BATCH, SEQ = (4, 128) if _SMOKE else (32, 128)
     paddle.seed(0)
-    cfg = ErnieConfig.base()
+    cfg = ErnieConfig.tiny() if _SMOKE else ErnieConfig.base()
     net = ErnieForSequenceClassification(cfg, num_classes=2)
     opt = paddle.optimizer.AdamW(5e-5, parameters=net.parameters())
     ce = nn.CrossEntropyLoss()
 
     apply_fn, pv, bv = functionalize(net)
+    n_params = _count_params(pv)
     opt_state = {n: opt._init_state(v) for n, v in pv.items()}
 
     def loss_fn(pv_, bv_, rng, ids, labels):
@@ -44,8 +160,7 @@ def main():
         (lv, new_bufs), grads = jax.value_and_grad(
             loss_fn, has_aux=True)(pv_, bv_, rng, ids, labels)
         new_pv, new_opt = opt.apply_gradients_pytree(
-            grads, pv_, opt_state_, jnp.asarray(5e-5, "float32"),
-            step_no)
+            grads, pv_, opt_state_, jnp.asarray(5e-5, "float32"), step_no)
         return lv, new_pv, new_bufs, new_opt
 
     jit_step = jax.jit(step, donate_argnums=(0, 2))
@@ -56,29 +171,214 @@ def main():
     labels = jnp.asarray(rng_np.randint(0, 2, size=(BATCH,)).astype("int32"))
     key = jax.random.PRNGKey(0)
 
-    # warmup (compile); float() forces a device→host sync (the axon tunnel
-    # does not implement block_until_ready faithfully)
     step_no = jnp.asarray(1, "int32")
     for i in range(3):
         lv, pv, bv, opt_state = jit_step(pv, bv, opt_state, step_no + i,
                                          key, ids, labels)
     float(lv)
 
-    iters = 20
+    iters = 2 if _SMOKE else 20
     t0 = time.perf_counter()
     for i in range(iters):
         lv, pv, bv, opt_state = jit_step(pv, bv, opt_state,
                                          step_no + 3 + i, key, ids, labels)
     float(lv)
     dt = time.perf_counter() - t0
-    samples_per_sec = BATCH * iters / dt
+    sps = BATCH * iters / dt
+    # train FLOPs ≈ 6 · params · tokens (fwd 2 + bwd 4); embeddings excluded
+    # from the matmul estimate would be more exact, but 6ND is the standard
+    mfu = 6.0 * n_params * (sps * SEQ) / V5E_PEAK_BF16
+    return sps, mfu
 
-    print(json.dumps({
-        "metric": "ernie_base_train_samples_per_sec_bs32_seq128_bf16",
-        "value": round(samples_per_sec, 2),
-        "unit": "samples/sec",
-        "vs_baseline": 1.0,
-    }))
+
+def bench_resnet50():
+    import jax
+    import jax.numpy as jnp
+    import paddle_tpu as paddle
+    import paddle_tpu.nn as nn
+    from paddle_tpu.framework.functional import functionalize
+    from paddle_tpu.framework.autograd import trace_mode
+    from paddle_tpu.framework.tensor import Tensor
+    from paddle_tpu.vision.models import resnet50
+
+    BATCH = 2 if _SMOKE else 32
+    paddle.seed(0)
+    net = resnet50(num_classes=1000)
+    opt = paddle.optimizer.Momentum(0.1, parameters=net.parameters())
+    ce = nn.CrossEntropyLoss()
+
+    apply_fn, pv, bv = functionalize(net)
+    opt_state = {n: opt._init_state(v) for n, v in pv.items()}
+
+    def loss_fn(pv_, bv_, rng, imgs, labels):
+        from paddle_tpu import amp
+        with trace_mode(), amp.auto_cast(level="O1", dtype="bfloat16"):
+            out, new_bufs = apply_fn(pv_, bv_, rng, True, imgs)
+            lv = ce(Tensor(out), Tensor(labels))
+        return jnp.mean(lv._value.astype("float32")), new_bufs
+
+    def step(pv_, bv_, opt_state_, step_no, rng, imgs, labels):
+        (lv, new_bufs), grads = jax.value_and_grad(
+            loss_fn, has_aux=True)(pv_, bv_, rng, imgs, labels)
+        new_pv, new_opt = opt.apply_gradients_pytree(
+            grads, pv_, opt_state_, jnp.asarray(0.1, "float32"), step_no)
+        return lv, new_pv, new_bufs, new_opt
+
+    jit_step = jax.jit(step, donate_argnums=(0, 2))
+
+    side = 64 if _SMOKE else 224
+    rng_np = np.random.RandomState(0)
+    imgs = jnp.asarray(rng_np.standard_normal(
+        (BATCH, 3, side, side)).astype("float32"))
+    labels = jnp.asarray(rng_np.randint(0, 1000,
+                                        size=(BATCH,)).astype("int32"))
+    key = jax.random.PRNGKey(0)
+    step_no = jnp.asarray(1, "int32")
+    for i in range(2):
+        lv, pv, bv, opt_state = jit_step(pv, bv, opt_state, step_no + i,
+                                         key, imgs, labels)
+    float(lv)
+
+    iters = 2 if _SMOKE else 10
+    t0 = time.perf_counter()
+    for i in range(iters):
+        lv, pv, bv, opt_state = jit_step(pv, bv, opt_state,
+                                         step_no + 2 + i, key, imgs, labels)
+    float(lv)
+    dt = time.perf_counter() - t0
+    ips = BATCH * iters / dt
+    # ResNet-50 @224: ~4.09 GFLOP fwd per image; train ≈ 3× fwd
+    mfu = 3 * 4.09e9 * ips / V5E_PEAK_BF16
+    return ips, mfu
+
+
+def bench_gpt_long_seq(use_flash):
+    import paddle_tpu as paddle
+    from paddle_tpu.framework.flags import flag as _flag
+
+    BATCH, SEQ = (1, 512) if _SMOKE else (4, 2048)
+    prior_flash = _flag("FLAGS_use_flash_attention")
+    paddle.set_flags({"FLAGS_use_flash_attention": use_flash})
+    try:
+        return _bench_gpt_body(BATCH, SEQ)
+    finally:
+        paddle.set_flags({"FLAGS_use_flash_attention": prior_flash})
+
+
+def _bench_gpt_body(BATCH, SEQ):
+    import jax
+    import jax.numpy as jnp
+    import paddle_tpu as paddle
+    from paddle_tpu.framework.functional import functionalize
+    from paddle_tpu.framework.autograd import trace_mode
+    from paddle_tpu.models import GPTConfig, GPTForCausalLM
+
+    paddle.seed(0)
+    if _SMOKE:
+        cfg = GPTConfig.tiny(max_position_embeddings=SEQ, dropout=0.0)
+    else:
+        cfg = GPTConfig(vocab_size=32768, hidden_size=768, num_layers=8,
+                        num_heads=12, intermediate_size=3072,
+                        max_position_embeddings=SEQ, dropout=0.0)
+    net = GPTForCausalLM(cfg)
+    opt = paddle.optimizer.AdamW(1e-4, parameters=net.parameters())
+
+    apply_fn, pv, bv = functionalize(net)
+    n_params = _count_params(pv)
+    opt_state = {n: opt._init_state(v) for n, v in pv.items()}
+
+    def loss_fn(pv_, bv_, rng, ids):
+        from paddle_tpu import amp
+        with trace_mode(), amp.auto_cast(level="O1", dtype="bfloat16"):
+            logits, new_bufs = apply_fn(pv_, bv_, rng, True, ids)
+            lg = logits[:, :-1].astype("float32")
+            tgt = ids[:, 1:]
+            lse = jax.nn.logsumexp(lg, axis=-1)
+            pick = jnp.take_along_axis(lg, tgt[..., None],
+                                       axis=-1).squeeze(-1)
+            lv = jnp.mean(lse - pick)
+        return lv, new_bufs
+
+    def step(pv_, bv_, opt_state_, step_no, rng, ids):
+        (lv, new_bufs), grads = jax.value_and_grad(
+            loss_fn, has_aux=True)(pv_, bv_, rng, ids)
+        new_pv, new_opt = opt.apply_gradients_pytree(
+            grads, pv_, opt_state_, jnp.asarray(1e-4, "float32"), step_no)
+        return lv, new_pv, new_bufs, new_opt
+
+    jit_step = jax.jit(step, donate_argnums=(0, 2))
+    rng_np = np.random.RandomState(0)
+    ids = jnp.asarray(rng_np.randint(0, cfg.vocab_size,
+                                     size=(BATCH, SEQ)).astype("int32"))
+    key = jax.random.PRNGKey(0)
+    step_no = jnp.asarray(1, "int32")
+    for i in range(2):
+        lv, pv, bv, opt_state = jit_step(pv, bv, opt_state, step_no + i,
+                                         key, ids)
+    float(lv)
+    iters = 2 if _SMOKE else 8
+    t0 = time.perf_counter()
+    for i in range(iters):
+        lv, pv, bv, opt_state = jit_step(pv, bv, opt_state,
+                                         step_no + 2 + i, key, ids)
+    float(lv)
+    dt = time.perf_counter() - t0
+    tps = BATCH * SEQ * iters / dt
+    mfu = 6.0 * n_params * tps / V5E_PEAK_BF16
+    return tps, mfu
+
+
+def main():
+    try:
+        devs = _init_backend()
+        sys.stderr.write(f"backend: {devs}\n")
+    except Exception as e:  # noqa: BLE001
+        traceback.print_exc()
+        _emit(_HEADLINE, 0.0, "samples/sec",
+              extra={"error": f"backend init failed: {e}"})
+        return
+
+    # secondary metrics first; the driver parses the LAST JSON line
+    try:
+        ips, mfu = bench_resnet50()
+        _emit("resnet50_train_images_per_sec_bs32_bf16", ips, "images/sec",
+              mfu=mfu)
+    except Exception as e:  # noqa: BLE001
+        traceback.print_exc()
+        _emit("resnet50_train_images_per_sec_bs32_bf16", 0.0, "images/sec",
+              extra={"error": str(e)[:300]})
+
+    try:
+        tps_on, mfu_on = bench_gpt_long_seq(use_flash=True)
+    except Exception as e:  # noqa: BLE001
+        traceback.print_exc()
+        tps_on = None
+        _emit("gpt_seq2048_train_tokens_per_sec_bs4_bf16_flash", 0.0,
+              "tokens/sec", extra={"error": str(e)[:300]})
+    if tps_on is not None:
+        extra = {}
+        try:
+            tps_off, _ = bench_gpt_long_seq(use_flash=False)
+            extra = {"flash_off_tokens_per_sec": round(tps_off, 2),
+                     "flash_speedup": round(tps_on / max(tps_off, 1e-9), 3)}
+        except Exception as e:  # noqa: BLE001
+            traceback.print_exc()
+            extra = {"flash_off_error": str(e)[:300]}
+        _emit("gpt_seq2048_train_tokens_per_sec_bs4_bf16_flash", tps_on,
+              "tokens/sec", mfu=mfu_on, extra=extra)
+
+    try:
+        sps, mfu = bench_ernie()
+        rec = _emit(_HEADLINE, sps, "samples/sec", mfu=mfu)
+        if rec["vs_baseline"] < 0.98:
+            sys.stderr.write(
+                f"REGRESSION: {_HEADLINE} {rec['value']} is "
+                f"{(1 - rec['vs_baseline']) * 100:.1f}% below the best "
+                f"recorded run — investigate before shipping\n")
+    except Exception as e:  # noqa: BLE001
+        traceback.print_exc()
+        _emit(_HEADLINE, 0.0, "samples/sec",
+              extra={"error": str(e)[:300]})
 
 
 if __name__ == "__main__":

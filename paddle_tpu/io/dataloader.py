@@ -1,17 +1,31 @@
 """DataLoader (reference `fluid/reader.py:149` +
 `fluid/dataloader/dataloader_iter.py:265/469`).
 
-Threaded prefetch pipeline: `num_workers` threads pull index batches from
-the sampler, fetch+collate to numpy (GIL released in numpy), and push to a
-bounded queue; a process pool handles decode-heavy datasets when
-`use_process_workers=True`. Batches are handed out as framework Tensors
-(host-resident; H2D overlaps with compute under jit).
+`num_workers>0` runs REAL worker processes (reference
+`_DataLoaderIterMultiProcess`, `dataloader_iter.py:469`): each worker
+fetches+collates to numpy and ships the batch through a POSIX
+shared-memory segment (the reference's mmap'd `_shared_memory` allocator,
+`fluid/memory/allocation/mmap_allocator.cc`), so decode-heavy pipelines
+are not Python-GIL-bound. Metadata rides a small mp.Queue; the parent
+copies each array once out of the segment (JAX's CPU backend may alias
+numpy buffers, so live views over an unlinked segment are unsafe) and
+frees it. Ordered hand-out, worker-error propagation with the original
+traceback, sentinel + join shutdown.
+
+`use_thread_workers=True` keeps the lighter in-process thread pool
+(useful when the dataset is closure-heavy and cheap to decode). Batches
+are handed out as framework Tensors (host-resident; H2D overlaps with
+compute under jit).
 """
 from __future__ import annotations
 
-import itertools
+import multiprocessing as mp
+import os
 import queue
 import threading
+import time
+import traceback as _traceback
+import uuid
 from typing import Callable, Optional
 
 import numpy as np
@@ -68,18 +82,143 @@ def _to_tensors(collated):
     return collated
 
 
+# ---------------------------------------------------------------------------
+# multiprocess workers with shared-memory batch transport
+# ---------------------------------------------------------------------------
+
+class _ArrRef:
+    """Placeholder for an ndarray leaf stripped out of a collated batch."""
+    __slots__ = ("idx",)
+
+    def __init__(self, idx):
+        self.idx = idx
+
+
+def _shm_encode(obj, name=None):
+    """Strip ndarray leaves into one shared-memory segment.
+
+    Returns (tree, shm_name, specs): `tree` mirrors `obj` with ndarrays
+    replaced by _ArrRef; `specs` is [(offset, shape, dtype_str)] into the
+    segment. shm_name is None when the batch holds no arrays. `name` pins
+    the segment name so the parent can sweep segments whose metadata never
+    made it out of a killed worker.
+    """
+    from multiprocessing import shared_memory
+    arrays = []
+
+    def strip(x):
+        if isinstance(x, np.ndarray):
+            arrays.append(np.ascontiguousarray(x))
+            return _ArrRef(len(arrays) - 1)
+        if isinstance(x, dict):
+            return {k: strip(v) for k, v in x.items()}
+        if isinstance(x, (list, tuple)):
+            return type(x)(strip(v) for v in x)
+        return x
+
+    tree = strip(obj)
+    if not arrays:
+        return tree, None, []
+    total = sum(a.nbytes for a in arrays) or 1
+    shm = shared_memory.SharedMemory(name=name, create=True, size=total)
+    specs, off = [], 0
+    for a in arrays:
+        if a.nbytes:
+            dst = np.ndarray(a.shape, a.dtype, buffer=shm.buf, offset=off)
+            np.copyto(dst, a)
+        specs.append((off, a.shape, a.dtype.str))
+        off += a.nbytes
+    name = shm.name
+    shm.close()
+    # the PARENT owns the segment's lifetime (it unlinks after device-put);
+    # deregister here so this worker's resource_tracker doesn't double-free
+    try:
+        from multiprocessing import resource_tracker
+        resource_tracker.unregister(shm._name, "shared_memory")
+    except Exception:
+        pass
+    return tree, name, specs
+
+
+def _shm_decode(tree, shm_name, specs):
+    """Rebuild the batch from the segment and release it.
+
+    Leaves are copied out (one memcpy per array): JAX's CPU backend may
+    zero-copy alias a numpy buffer, so handing out live views over a
+    segment we are about to unlink would leave tensors over unmapped
+    pages. The expensive per-sample decode already happened in the worker;
+    this single sequential memcpy is the transport cost.
+    """
+    if shm_name is None:
+        return tree
+    from multiprocessing import shared_memory
+    shm = shared_memory.SharedMemory(name=shm_name)
+    try:
+        arrays = [np.ndarray(shape, np.dtype(dt), buffer=shm.buf,
+                             offset=off).copy()
+                  for off, shape, dt in specs]
+    finally:
+        try:
+            shm.close()
+            shm.unlink()
+        except Exception:
+            pass
+
+    def rebuild(x):
+        if isinstance(x, _ArrRef):
+            return arrays[x.idx]
+        if isinstance(x, dict):
+            return {k: rebuild(v) for k, v in x.items()}
+        if isinstance(x, (list, tuple)):
+            return type(x)(rebuild(v) for v in x)
+        return x
+
+    return rebuild(tree)
+
+
+def _mp_worker_loop(dataset, collate_fn, worker_init_fn, wid, nw,
+                    task_q, result_q, use_shm, uid):
+    """Target of one DataLoader worker process (numpy-only; never touches
+    the accelerator)."""
+    _worker_info.info = WorkerInfo(wid, nw, dataset)
+    rc = 0
+    if worker_init_fn:
+        try:
+            worker_init_fn(wid)
+        except Exception:
+            result_q.put((-1, "err", _traceback.format_exc()))
+            rc = 1
+    while not rc:
+        item = task_q.get()
+        if item is None:
+            break
+        seq, indices = item
+        try:
+            out = collate_fn([dataset[i] for i in indices])
+            payload = _shm_encode(out, f"{uid}s{seq}") if use_shm \
+                else (out, None, [])
+            result_q.put((seq, "ok", payload))
+        except Exception:
+            result_q.put((seq, "err", _traceback.format_exc()))
+    result_q.close()
+    result_q.join_thread()  # flush the feeder thread before hard exit
+    os._exit(rc)            # skip atexit: the fork inherited jax/XLA state
+
+
 class DataLoader:
     def __init__(self, dataset: Dataset, feed_list=None, places=None,
                  return_list=True, batch_sampler=None, batch_size=1,
                  shuffle=False, drop_last=False, collate_fn=None,
                  num_workers=0, use_buffer_reader=True, prefetch_factor=2,
                  use_shared_memory=True, timeout=0, worker_init_fn=None,
-                 persistent_workers=False):
+                 persistent_workers=False, use_thread_workers=False):
         self.dataset = dataset
         self.return_list = return_list
         self.collate_fn = collate_fn or default_collate_fn
         self.num_workers = max(0, int(num_workers))
         self.prefetch_factor = prefetch_factor
+        self.use_shared_memory = use_shared_memory
+        self.use_thread_workers = use_thread_workers
         self.timeout = timeout
         self.worker_init_fn = worker_init_fn
         self._iterable_mode = isinstance(dataset, IterableDataset)
@@ -109,7 +248,9 @@ class DataLoader:
             return self._iter_iterable()
         if self.num_workers == 0:
             return self._iter_single()
-        return self._iter_threaded()
+        if self.use_thread_workers:
+            return self._iter_threaded()
+        return self._iter_multiprocess()
 
     def _fetch(self, indices):
         samples = [self.dataset[i] for i in indices]
@@ -128,6 +269,114 @@ class DataLoader:
                 batch = []
         if batch and not getattr(self, "drop_last", False):
             yield _to_tensors(self.collate_fn(batch))
+
+    def _iter_multiprocess(self):
+        """Real worker processes + shared-memory transport (reference
+        `_DataLoaderIterMultiProcess`, `dataloader_iter.py:469`)."""
+        ctx = mp.get_context("fork" if "fork" in mp.get_all_start_methods()
+                             else "spawn")
+        nw = self.num_workers
+        task_q = ctx.Queue()
+        result_q = ctx.Queue()
+        use_shm = self.use_shared_memory
+        # deterministic segment names ("<uid>s<seq>") let shutdown sweep
+        # segments whose metadata never escaped a killed worker
+        uid = f"ptpu{os.getpid()}x{uuid.uuid4().hex[:8]}"
+        procs = [ctx.Process(
+            target=_mp_worker_loop,
+            args=(self.dataset, self.collate_fn, self.worker_init_fn,
+                  wid, nw, task_q, result_q, use_shm, uid),
+            daemon=True) for wid in range(nw)]
+        for p in procs:
+            p.start()
+
+        batches = list(self.batch_sampler)
+        total = len(batches)
+        depth = nw * self.prefetch_factor
+        sent = 0
+        for seq in range(min(depth, total)):
+            task_q.put((seq, batches[seq]))
+            sent += 1
+
+        pending = {}
+
+        def shutdown():
+            # drop queued-but-unstarted work so workers reach the sentinel
+            # quickly even when the consumer abandoned the epoch early
+            while True:
+                try:
+                    task_q.get_nowait()
+                except Exception:
+                    break
+            for _ in procs:
+                try:
+                    task_q.put(None)
+                except Exception:
+                    pass
+            for p in procs:
+                p.join(timeout=30)
+                if p.is_alive():
+                    p.terminate()
+            # release segments still in flight (reorder buffer + queue)
+            while True:
+                try:
+                    seq, status, payload = result_q.get_nowait()
+                except Exception:
+                    break
+                if status == "ok":
+                    pending[seq] = payload
+            for _, payload in pending.items():
+                _shm_decode(*payload)
+            pending.clear()
+            if use_shm:
+                from multiprocessing import shared_memory
+                for seq in range(total):
+                    try:
+                        leak = shared_memory.SharedMemory(
+                            name=f"{uid}s{seq}")
+                    except FileNotFoundError:
+                        continue
+                    except Exception:
+                        break
+                    try:
+                        leak.close()
+                        leak.unlink()
+                    except Exception:
+                        pass
+
+        try:
+            # self.timeout follows the reference: 0 means wait forever;
+            # liveness is polled so a dead worker still fails fast
+            deadline = (time.monotonic() + self.timeout
+                        if self.timeout else None)
+            for want in range(total):
+                while want not in pending:
+                    try:
+                        seq, status, payload = result_q.get(timeout=5)
+                    except queue.Empty:
+                        dead = [p.pid for p in procs if not p.is_alive()]
+                        if dead:
+                            raise RuntimeError(
+                                f"DataLoader worker(s) {dead} died while "
+                                f"batch {want} was outstanding") from None
+                        if deadline and time.monotonic() > deadline:
+                            raise RuntimeError(
+                                f"DataLoader timed out after "
+                                f"{self.timeout}s waiting for batch "
+                                f"{want}") from None
+                        continue
+                    if status == "err":
+                        raise RuntimeError(
+                            "DataLoader worker raised:\n" + payload)
+                    pending[seq] = payload
+                if sent < total:
+                    task_q.put((sent, batches[sent]))
+                    sent += 1
+                deadline = (time.monotonic() + self.timeout
+                            if self.timeout else None)
+                yield _to_tensors(_shm_decode(*pending.pop(want)))
+        finally:
+            shutdown()
 
     def _iter_threaded(self):
         """Ordered multi-thread prefetch (reference multiprocess iter
