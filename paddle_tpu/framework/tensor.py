@@ -290,7 +290,7 @@ def apply_op(name: str, fn: Callable, args: Sequence[Any], kwargs: dict):
 
             def fn_slots(*vals):
                 return closed_static(*vals)
-            _static.record_op(name, fn_slots, tin, outs)
+            _static.record_op(name, fn_slots, tin, outs, attrs=raw_kwargs)
             return outs[0] if single else tuple(outs)
 
     record = bool(diff_pos) and is_grad_enabled()

@@ -5,6 +5,7 @@ from .program import (Executor, Program, Variable, append_backward, data,
                       default_main_program, default_startup_program,
                       disable_static, enable_static, global_scope,
                       in_static_mode, program_guard, scope_guard)
+from .serde import load_program, save_program
 
 # static layer API (paddle.static.nn)
 from . import nn  # noqa: F401
@@ -47,12 +48,40 @@ def save_inference_model(path_prefix, feed_vars, fetch_vars, executor,
     with open(path_prefix + ".pdiparams", "wb") as f:
         pickle.dump({n: p for n, p in zip(lowered.param_names, params)}, f,
                     protocol=4)
+    # also persist the op-level Program IR so the graph itself (not just
+    # the fused serving artifact) round-trips — reference ProgramDesc
+    try:
+        save_program(program, path_prefix + ".ptprog",
+                     scope=scope, include_params=True,
+                     extra={"fetch_slots": [v.slot for v in fetch_vars],
+                            "fetch_names": [getattr(v, "name", None)
+                                            for v in fetch_vars]})
+    except Exception as e:  # programs with non-exportable ops (e.g. host
+        import warnings      # callbacks) still get the fused .pdmodel
+        warnings.warn(f"op-level .ptprog export failed ({e!r}); "
+                      f"load_inference_model will fall back to the fused "
+                      f"StableHLO predictor")
     return [v.name for v in fetch_vars]
 
 
 def load_inference_model(path_prefix, executor=None, **kwargs):
-    """Returns (program_like, feed_names, fetch_names) where program_like
-    is directly callable / usable with inference.Predictor."""
+    """Returns (program, feed_names, fetch_names).
+
+    When the op-level `.ptprog` IR is present (written by
+    save_inference_model), a real Program is rebuilt — inspectable,
+    re-executable through Executor, and differentiable. Otherwise falls
+    back to the fused StableHLO predictor."""
+    import os
+
+    from .program import global_scope
+    if os.path.exists(path_prefix + ".ptprog"):
+        program, params = load_program(path_prefix + ".ptprog")
+        global_scope().update(params)
+        feed_names = sorted(program.feed_vars.keys())
+        extra = getattr(program, "_doc_extra", {})
+        program._fetch_slots = extra.get("fetch_slots", [])
+        fetch_names = extra.get("fetch_names", [])
+        return program, feed_names, fetch_names
     from ..inference import Config, create_predictor
     pred = create_predictor(Config(path_prefix))
     return pred, pred.get_input_names(), ["output_0"]

@@ -14,7 +14,6 @@ needs no per-op grad makers.
 from __future__ import annotations
 
 import contextlib
-import itertools
 import threading
 from typing import Any, Callable, Dict, List, Optional, Sequence
 
@@ -29,7 +28,23 @@ __all__ = ["Program", "Executor", "program_guard", "default_main_program",
            "in_static_mode", "data", "scope_guard", "global_scope",
            "Variable", "append_backward"]
 
-_slot_counter = itertools.count()
+class _SlotCounter:
+    """SSA slot allocator; advance_past() keeps fresh slots clear of ids
+    preserved by a loaded Program (serde.program_from_doc)."""
+
+    def __init__(self):
+        self._n = 0
+
+    def __next__(self):
+        n = self._n
+        self._n += 1
+        return n
+
+    def advance_past(self, n):
+        self._n = max(self._n, n + 1)
+
+
+_slot_counter = _SlotCounter()
 
 
 class Variable(Tensor):
@@ -45,13 +60,14 @@ class Variable(Tensor):
 
 
 class _Op:
-    __slots__ = ("name", "fn", "in_refs", "out_slots")
+    __slots__ = ("name", "fn", "in_refs", "out_slots", "attrs")
 
-    def __init__(self, name, fn, in_refs, out_slots):
+    def __init__(self, name, fn, in_refs, out_slots, attrs=None):
         self.name = name
         self.fn = fn
         self.in_refs = in_refs  # list of ("s", slot) | ("c", const_array)
         self.out_slots = out_slots
+        self.attrs = attrs or {}  # inspectable op attributes (OpDesc parity)
 
 
 class Program:
@@ -63,7 +79,7 @@ class Program:
         self.random_ops = False
         self._opt_hooks: List[Callable] = []
 
-    def record(self, name, fn, inputs, output_tensors):
+    def record(self, name, fn, inputs, output_tensors, attrs=None):
         from ..framework.tensor import Parameter
         in_refs = []
         for t in inputs:
@@ -83,7 +99,7 @@ class Program:
         out_slots = [t.slot for t in output_tensors]
         for t in output_tensors:
             self.vars[t.slot] = t
-        self.ops.append(_Op(name, fn, in_refs, out_slots))
+        self.ops.append(_Op(name, fn, in_refs, out_slots, attrs))
 
     def clone(self, for_test=False):
         return self
@@ -94,10 +110,31 @@ class Program:
     def all_parameters(self):
         return list(self.param_vars.values())
 
+    # -- serialization (reference ProgramDesc.SerializeToString) ----------
+    def to_doc(self, scope=None, include_params=True):
+        from .serde import program_to_doc
+        return program_to_doc(self, scope if scope is not None
+                              else _state.scope, include_params)
+
+    @classmethod
+    def from_doc(cls, doc):
+        from .serde import program_from_doc
+        return program_from_doc(doc)
+
+    def save(self, path, scope=None, include_params=True):
+        from .serde import save_program
+        save_program(self, path, scope, include_params)
+
+    @classmethod
+    def load(cls, path):
+        from .serde import load_program
+        return load_program(path)
+
     def __repr__(self):
         lines = [f"Program({len(self.ops)} ops)"]
         for op in self.ops[:50]:
-            lines.append(f"  {op.name}: {op.in_slots} -> {op.out_slots}")
+            ins = [r if t == "s" else "const" for t, r in op.in_refs]
+            lines.append(f"  {op.name}: {ins} -> {op.out_slots}")
         return "\n".join(lines)
 
 
@@ -178,8 +215,8 @@ def make_parameter(name, value):
     return v
 
 
-def record_op(name, fn, inputs, outputs):
-    _state.main.record(name, fn, inputs, outputs)
+def record_op(name, fn, inputs, outputs, attrs=None):
+    _state.main.record(name, fn, inputs, outputs, attrs)
 
 
 class _Lowered:
