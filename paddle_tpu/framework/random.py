@@ -31,27 +31,36 @@ if _impl != "threefry2x32":
 
 
 class _RngScope:
-    __slots__ = ("key",)
+    """Key is materialized lazily: importing the framework must NOT touch
+    the XLA backend, or jax.distributed.initialize (multi-host rendezvous
+    in distributed/env.py) can no longer run after `import paddle_tpu`."""
+    __slots__ = ("key", "_seed")
 
-    def __init__(self, key):
+    def __init__(self, key=None, seed=None):
         self.key = key
+        self._seed = seed
+
+    def materialize(self):
+        if self.key is None:
+            self.key = jax.random.PRNGKey(self._seed)
+        return self.key
 
     def next_key(self):
-        self.key, sub = jax.random.split(self.key)
+        self.key, sub = jax.random.split(self.materialize())
         return sub
 
 
 class _State(threading.local):
     def __init__(self):
-        self.stack = [_RngScope(jax.random.PRNGKey(default_seed))]
+        self.stack = [_RngScope(seed=default_seed)]
 
 
 _state = _State()
 
 
 def seed(s: int):
-    """paddle.seed — reset the global generator."""
-    _state.stack[0] = _RngScope(jax.random.PRNGKey(int(s)))
+    """paddle.seed — reset the global generator (lazily: no backend touch)."""
+    _state.stack[0] = _RngScope(seed=int(s))
     return _state.stack[0]
 
 
@@ -65,7 +74,7 @@ def get_rng_state():
     `paddle.get_cuda_rng_state`, `framework/generator.cc` GetState). The
     state is the raw PRNG key array — one generator per host thread, not
     per device: JAX keys are device-agnostic."""
-    return [_state.stack[-1].key]
+    return [_state.stack[-1].materialize()]
 
 
 def set_rng_state(states):

@@ -1,0 +1,54 @@
+"""Worker script for the subprocess-cluster loss-parity test (reference
+`python/paddle/fluid/tests/unittests/test_dist_base.py:1184`
+check_with_place: real ranks on localhost, losses compared to a single
+process). Launched by paddle_tpu.distributed.fleet.launch, which sets the
+PADDLE_*/JAX_* env contract consumed by distributed.env."""
+import json
+import sys
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np  # noqa: E402
+
+
+def main():
+    out_path = sys.argv[1]
+    steps = int(sys.argv[2]) if len(sys.argv) > 2 else 5
+
+    import paddle_tpu as paddle
+    import paddle_tpu.nn as nn
+    from paddle_tpu.distributed.env import init_parallel_env
+    from paddle_tpu.parallel.mesh import get_mesh
+    from paddle_tpu.parallel.spmd import make_sharded_train_step
+
+    penv = init_parallel_env()   # jax.distributed rendezvous from env vars
+    mesh = get_mesh()
+
+    paddle.seed(1234)            # identical init on every rank
+    net = nn.Sequential(nn.Linear(16, 32), nn.Tanh(), nn.Linear(32, 4))
+    opt = paddle.optimizer.Momentum(0.05, parameters=net.parameters())
+    ce = nn.CrossEntropyLoss()
+    step, state = make_sharded_train_step(
+        net, opt, lambda out, labels: ce(out, labels[0]), mesh=mesh)
+
+    rng = np.random.RandomState(0)   # identical global batches on all ranks
+    B = 8
+    losses = []
+    for _ in range(steps):
+        x = rng.standard_normal((B, 16)).astype(np.float32)
+        y = rng.randint(0, 4, size=(B,)).astype(np.int32)
+        state, loss = step(state, (x,), (y,))
+        losses.append(float(jax.device_get(loss)))
+
+    if penv.rank == 0:
+        with open(out_path, "w") as f:
+            json.dump({"losses": losses, "world": penv.world_size,
+                       "n_devices": len(jax.devices())}, f)
+    print(f"rank {penv.rank}/{penv.world_size} done; "
+          f"final loss {losses[-1]:.6f}", flush=True)
+
+
+if __name__ == "__main__":
+    main()
