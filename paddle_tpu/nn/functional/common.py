@@ -178,19 +178,28 @@ def pixel_shuffle(x, upscale_factor, data_format="NCHW", name=None):
     return apply_op("pixel_shuffle", impl, (x,), {})
 
 
+def _to2(v):
+    return (v, v) if isinstance(v, int) else tuple(v)
+
+
+def _pads4(paddings):
+    """Normalize unfold/fold paddings to (top, bottom, left, right).
+    The reference 4-element order is [top, LEFT, bottom, right]
+    (`operators/unfold_op.h` reads h from paddings[0]/[2], w from
+    paddings[1]/[3])."""
+    if isinstance(paddings, int):
+        return (paddings,) * 4
+    if len(paddings) == 2:
+        return (paddings[0], paddings[0], paddings[1], paddings[1])
+    return (paddings[0], paddings[2], paddings[1], paddings[3])
+
+
 def unfold(x, kernel_sizes, strides=1, paddings=0, dilations=1, name=None):
     """im2col (reference `operators/unfold_op`)."""
-    def to2(v):
-        return (v, v) if isinstance(v, int) else tuple(v)
-    kh, kw = to2(kernel_sizes)
-    sh, sw = to2(strides)
-    dh, dw = to2(dilations)
-    if isinstance(paddings, int):
-        pads = (paddings,) * 4
-    elif len(paddings) == 2:
-        pads = (paddings[0], paddings[0], paddings[1], paddings[1])
-    else:
-        pads = tuple(paddings)
+    kh, kw = _to2(kernel_sizes)
+    sh, sw = _to2(strides)
+    dh, dw = _to2(dilations)
+    pads = _pads4(paddings)
 
     def impl(v):
         n, c, h, w = v.shape
@@ -230,3 +239,97 @@ def sequence_mask(lengths, maxlen=None, dtype="int64", name=None):
         return Tensor(jnp.asarray(
             (np.arange(m)[None, :] < l[:, None]).astype("int64")))
     return apply_op("sequence_mask", impl, (lengths,), {})
+
+
+def fold(x, output_sizes, kernel_sizes, strides=1, paddings=0, dilations=1,
+         name=None):
+    """col2im, the inverse of unfold (reference `operators/fold_op.cc`).
+    x: [N, C*kh*kw, L] → [N, C, H, W]; overlapping positions sum."""
+    H, W = _to2(output_sizes)
+    kh, kw = _to2(kernel_sizes)
+    sh, sw = _to2(strides)
+    dh, dw = _to2(dilations)
+    pt, pb, pl, pr = _pads4(paddings)
+    oh = (H + pt + pb - (dh * (kh - 1) + 1)) // sh + 1
+    ow = (W + pl + pr - (dw * (kw - 1) + 1)) // sw + 1
+
+    def impl(v):
+        n, ckk, L = v.shape
+        c = ckk // (kh * kw)
+        v6 = v.reshape(n, c, kh, kw, oh, ow)
+        Hp, Wp = H + pt + pb, W + pl + pr
+        out = jnp.zeros((n, c, Hp, Wp), v.dtype)
+        # static kernel loop (kh*kw slices); each is one strided
+        # scatter-add XLA turns into a dynamic-update fusion
+        for i in range(kh):
+            for j in range(kw):
+                out = out.at[:, :, i * dh:i * dh + oh * sh:sh,
+                             j * dw:j * dw + ow * sw:sw].add(
+                    v6[:, :, i, j])
+        return out[:, :, pt:pt + H, pl:pl + W]
+    return apply_op("fold", impl, (x,), {})
+
+
+def grid_sample(x, grid, mode="bilinear", padding_mode="zeros",
+                align_corners=True, name=None):
+    """Sample x at normalized grid locations (reference
+    `operators/grid_sampler_op.cc`). x: [N, C, H, W], grid: [N, Hg, Wg, 2]
+    in [-1, 1] (last dim = (x, y)). Pure gather + lerp — differentiable in
+    both x and grid, no kernel needed."""
+    def _unnormalize(coord, size):
+        if align_corners:
+            return (coord + 1.0) * 0.5 * (size - 1)
+        return ((coord + 1.0) * size - 1.0) * 0.5
+
+    def _reflect(c, lo, hi):
+        rng = hi - lo
+        c2 = jnp.abs(jnp.mod(c - lo, 2.0 * rng))
+        return lo + jnp.where(c2 > rng, 2.0 * rng - c2, c2)
+
+    def impl(v, g):
+        N, C, H, W = v.shape
+        ix = _unnormalize(g[..., 0], W)
+        iy = _unnormalize(g[..., 1], H)
+        if padding_mode == "reflection":
+            if align_corners:
+                ix = _reflect(ix, 0.0, W - 1.0)
+                iy = _reflect(iy, 0.0, H - 1.0)
+            else:
+                ix = _reflect(ix, -0.5, W - 0.5)
+                iy = _reflect(iy, -0.5, H - 0.5)
+        if padding_mode in ("border", "reflection"):
+            ix = jnp.clip(ix, 0.0, W - 1.0)
+            iy = jnp.clip(iy, 0.0, H - 1.0)
+
+        def gather(yi, xi):
+            yc = jnp.clip(yi, 0, H - 1)
+            xc = jnp.clip(xi, 0, W - 1)
+            b = jnp.arange(N)[:, None, None]
+            got = v[b, :, yc, xc]              # [N, Hg, Wg, C]
+            if padding_mode == "zeros":
+                ok = ((yi >= 0) & (yi <= H - 1) &
+                      (xi >= 0) & (xi <= W - 1))
+                got = got * ok[..., None].astype(got.dtype)
+            return got
+
+        if mode == "nearest":
+            out = gather(jnp.rint(iy).astype(jnp.int32),
+                         jnp.rint(ix).astype(jnp.int32))
+            return jnp.moveaxis(out, -1, 1)
+
+        x0 = jnp.floor(ix)
+        y0 = jnp.floor(iy)
+        wx = (ix - x0)[..., None]
+        wy = (iy - y0)[..., None]
+        x0i, y0i = x0.astype(jnp.int32), y0.astype(jnp.int32)
+        tl = gather(y0i, x0i)
+        tr = gather(y0i, x0i + 1)
+        bl = gather(y0i + 1, x0i)
+        br = gather(y0i + 1, x0i + 1)
+        top = tl * (1 - wx) + tr * wx
+        bot = bl * (1 - wx) + br * wx
+        return jnp.moveaxis(top * (1 - wy) + bot * wy, -1, 1)
+    return apply_op("grid_sample", impl, (x, grid), {})
+
+
+__all__ += ["fold", "grid_sample"]

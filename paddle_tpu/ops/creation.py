@@ -159,3 +159,17 @@ def clone(x, name=None):
 def numel(x, name=None):
     return Tensor(jnp.asarray(int(np.prod(x.shape)) if x.shape else 1,
                               dtype="int64"))
+
+
+def vander(x, n=None, increasing=False, name=None):
+    """Vandermonde matrix (reference `paddle.vander`)."""
+    def impl(v):
+        cols = v.shape[0] if n is None else int(n)
+        powers = jnp.arange(cols)
+        if not increasing:
+            powers = powers[::-1]
+        return v[:, None] ** powers[None, :].astype(v.dtype)
+    return apply_op("vander", impl, (x,), {})
+
+
+__all__.append("vander")

@@ -206,3 +206,103 @@ def cov(x, rowvar=True, ddof=True, fweights=None, aweights=None, name=None):
 def corrcoef(x, rowvar=True, name=None):
     return apply_op("corrcoef", lambda v: jnp.corrcoef(v, rowvar=rowvar),
                     (x,), {})
+
+
+def cdist(x, y, p=2.0, compute_mode="use_mm_for_euclid_dist_if_necessary",
+          name=None):
+    """Pairwise p-norm distance (reference `paddle.cdist` /
+    `operators/dist_op.cc` math). x: [..., P, M], y: [..., R, M] →
+    [..., P, R]. The p=2 path uses one matmul (MXU) + row norms instead of
+    the O(P·R·M) broadcast subtraction."""
+    def impl(a, b):
+        if p == 2.0 and compute_mode != "donot_use_mm_for_euclid_dist":
+            a2 = jnp.sum(a * a, axis=-1)[..., :, None]
+            b2 = jnp.sum(b * b, axis=-1)[..., None, :]
+            ab = jnp.einsum("...pm,...rm->...pr", a, b)
+            sq = jnp.maximum(a2 + b2 - 2.0 * ab, 0.0)
+            return jnp.sqrt(sq + 1e-24)
+        d = a[..., :, None, :] - b[..., None, :, :]
+        if p == 0.0:
+            return jnp.sum((d != 0).astype(a.dtype), axis=-1)
+        if p == float("inf"):
+            return jnp.max(jnp.abs(d), axis=-1)
+        return jnp.sum(jnp.abs(d) ** p, axis=-1) ** (1.0 / p)
+    return apply_op("cdist", impl, (x, y), {})
+
+
+def lu(x, pivot=True, get_infos=False, name=None):
+    """LU factorization (reference `operators/lu_op.cc`). Returns
+    (LU, pivots[, infos]) with 1-based pivots like the reference."""
+    def impl(v):
+        lu_mat, piv = jax.scipy.linalg.lu_factor(v)
+        return lu_mat, (piv + 1).astype("int32")
+    out = apply_op("lu", impl, (x,), {})
+    if get_infos:
+        infos = Tensor(jnp.zeros(x.shape[:-2] or (1,), "int32"))
+        return out[0], out[1], infos
+    return out
+
+
+def lu_unpack(x, y, unpack_ludata=True, unpack_pivots=True, name=None):
+    """Unpack paddle.linalg.lu results into (P, L, U) (reference
+    `operators/lu_unpack_op.cc`). Batched like the reference: leading
+    dims are vmapped."""
+    def one(lu_mat, piv):
+        m, n = lu_mat.shape
+        k = min(m, n)
+        l_mat = jnp.tril(lu_mat[:, :k], -1) + jnp.eye(
+            m, k, dtype=lu_mat.dtype)
+        u_mat = jnp.triu(lu_mat[:k, :])
+        # pivots (1-based sequential row swaps) → permutation matrix
+        perm = jnp.arange(m)
+        piv0 = piv.astype("int32") - 1
+
+        def body(i, pr):
+            j = piv0[i]
+            pi, pj = pr[i], pr[j]
+            return pr.at[i].set(pj).at[j].set(pi)
+        perm = jax.lax.fori_loop(0, piv0.shape[-1], body, perm)
+        p_mat = jnp.eye(m, dtype=lu_mat.dtype)[perm].T
+        return p_mat, l_mat, u_mat
+
+    def impl(lu_mat, piv):
+        if lu_mat.ndim == 2:
+            return one(lu_mat, piv)
+        batch = lu_mat.shape[:-2]
+        lu_f = lu_mat.reshape((-1,) + lu_mat.shape[-2:])
+        piv_f = piv.reshape((-1, piv.shape[-1]))
+        p, l, u = jax.vmap(one)(lu_f, piv_f)
+        return (p.reshape(batch + p.shape[-2:]),
+                l.reshape(batch + l.shape[-2:]),
+                u.reshape(batch + u.shape[-2:]))
+    return apply_op("lu_unpack", impl, (x, y), {})
+
+
+def eig(x, name=None):
+    """General (non-symmetric) eigendecomposition (reference
+    `operators/eig_op.h`). XLA has no non-symmetric eig on TPU, so this
+    runs as a host callback into LAPACK via numpy — the same
+    CPU-kernel-only stance as the reference (eig_op registers CPU only).
+    Returns (eigenvalues, eigenvectors), complex."""
+    import numpy as _np
+
+    def impl(v):
+        cdt = jnp.complex64 if v.dtype in (jnp.float32, jnp.complex64) \
+            else jnp.complex128
+        n = v.shape[-1]
+        out_shapes = (jax.ShapeDtypeStruct(v.shape[:-1], cdt),
+                      jax.ShapeDtypeStruct(v.shape, cdt))
+
+        def host_eig(a):
+            w, vec = _np.linalg.eig(_np.asarray(a))
+            return (_np.asarray(w, dtype=cdt),
+                    _np.asarray(vec, dtype=cdt))
+        return jax.pure_callback(host_eig, out_shapes, v, vmap_method="sequential")
+    return apply_op("eig", impl, (x,), {})
+
+
+def eigvals(x, name=None):
+    return eig(x, name=name)[0]
+
+
+__all__ += ["cdist", "lu", "lu_unpack", "eig", "eigvals"]

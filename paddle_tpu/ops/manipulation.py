@@ -418,3 +418,31 @@ def one_hot(x, num_classes, name=None):
     return apply_op("one_hot",
                     lambda v: jax.nn.one_hot(v, num_classes, dtype="float32"),
                     (x,), {})
+
+
+def index_fill(x, index, axis, value, name=None):
+    """reference `paddle.index_fill`."""
+    def impl(v, i):
+        moved = jnp.moveaxis(v, axis, 0)
+        moved = moved.at[i].set(value)
+        return jnp.moveaxis(moved, 0, axis)
+    return apply_op("index_fill", impl, (x, index), {})
+
+
+def diagonal_scatter(x, y, offset=0, axis1=0, axis2=1, name=None):
+    """reference `paddle.diagonal_scatter`: write y into the diagonal."""
+    def impl(v, w):
+        n, m = v.shape[axis1], v.shape[axis2]
+        rows = jnp.arange(max(n, m))
+        if offset >= 0:
+            r, c = rows[:min(n, m - offset)], rows[:min(n, m - offset)] + offset
+        else:
+            r, c = rows[:min(n + offset, m)] - offset, rows[:min(n + offset, m)]
+        moved = jnp.moveaxis(v, (axis1, axis2), (0, 1))
+        moved = moved.at[r, c].set(jnp.moveaxis(
+            w, -1, 0) if w.ndim > 1 else w)
+        return jnp.moveaxis(moved, (0, 1), (axis1, axis2))
+    return apply_op("diagonal_scatter", impl, (x, y), {})
+
+
+__all__ += ["index_fill", "diagonal_scatter"]

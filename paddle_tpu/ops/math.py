@@ -230,3 +230,142 @@ def diff(x, n=1, axis=-1, prepend=None, append=None, name=None):
 __all__ += ["scale", "clip", "lerp", "stanh", "logit", "multiplex", "cumsum",
             "cumprod", "cummax", "isnan", "isinf", "isfinite", "nan_to_num",
             "increment", "addmm", "trace", "diff"]
+
+
+def renorm(x, p, axis, max_norm, name=None):
+    """Per-slice p-norm clamp along `axis` (reference
+    `operators/renorm_op.cc`): slices whose p-norm exceeds max_norm are
+    rescaled to exactly max_norm."""
+    def impl(v):
+        ax = axis if axis >= 0 else v.ndim + axis
+        red = tuple(i for i in range(v.ndim) if i != ax)
+        norms = jnp.sum(jnp.abs(v) ** p, axis=red, keepdims=True) \
+            ** (1.0 / p)
+        factor = jnp.where(norms > max_norm,
+                           max_norm / (norms + 1e-7), 1.0)
+        return v * factor
+    return apply_op("renorm", impl, (x,), {})
+
+
+def logcumsumexp(x, axis=None, dtype=None, name=None):
+    """Numerically-stable cumulative logsumexp (reference
+    `operators/cum_op.h` LogcumsumexpKernel): running max + rescaled
+    cumsum through lax.associative_scan (parallel on TPU, not a serial
+    loop)."""
+    def impl(v):
+        if dtype is not None:
+            v = v.astype(to_jax_dtype(dtype))
+        ax = axis
+        if ax is None:
+            v = v.reshape(-1)
+            ax = 0
+        elif ax < 0:
+            ax = v.ndim + ax
+
+        def combine(a, b):
+            am, al = a
+            bm, bl = b
+            m = jnp.maximum(am, bm)
+            return m, jnp.log(jnp.exp(al + am - m) +
+                              jnp.exp(bl + bm - m))
+        m, l = jax.lax.associative_scan(
+            combine, (v, jnp.zeros_like(v)), axis=ax)
+        return m + l
+    return apply_op("logcumsumexp", impl, (x,), {})
+
+
+__all__ += ["renorm", "logcumsumexp"]
+
+
+def trapezoid(y, x=None, dx=None, axis=-1, name=None):
+    """reference `paddle.trapezoid` (operators/... trapezoidal rule)."""
+    if x is not None:
+        return apply_op("trapezoid",
+                        lambda yv, xv: jnp.trapezoid(yv, xv, axis=axis),
+                        (y, x), {})
+    dx_ = 1.0 if dx is None else dx
+    return apply_op("trapezoid",
+                    lambda yv: jnp.trapezoid(yv, dx=dx_, axis=axis),
+                    (y,), {})
+
+
+def hypot(x, y, name=None):
+    return apply_op("hypot", jnp.hypot, (x, y), {})
+
+
+def copysign(x, y, name=None):
+    if not hasattr(y, "shape"):
+        y = Tensor(jnp.asarray(y, "float32"))
+    return apply_op("copysign", jnp.copysign, (x, y), {})
+
+
+def ldexp(x, y, name=None):
+    return apply_op("ldexp",
+                    lambda a, b: a * (2.0 ** b.astype(a.dtype)), (x, y), {})
+
+
+def polar(abs, angle, name=None):
+    return apply_op(
+        "polar",
+        lambda r, t: (r * jnp.cos(t) + 1j * r * jnp.sin(t)).astype(
+            "complex64"), (abs, angle), {})
+
+
+def sgn(x, name=None):
+    def impl(v):
+        if jnp.issubdtype(v.dtype, jnp.complexfloating):
+            mag = jnp.abs(v)
+            return jnp.where(mag == 0, 0.0 + 0.0j, v / mag)
+        return jnp.sign(v)
+    return apply_op("sgn", impl, (x,), {})
+
+
+def sinc(x, name=None):
+    return apply_op("sinc", jnp.sinc, (x,), {})
+
+
+def i0(x, name=None):
+    return apply_op("i0", lambda v: jax.scipy.special.i0(v), (x,), {})
+
+
+def i0e(x, name=None):
+    return apply_op("i0e", lambda v: jax.scipy.special.i0e(v), (x,), {})
+
+
+def i1(x, name=None):
+    return apply_op("i1", lambda v: jax.scipy.special.i1(v), (x,), {})
+
+
+def i1e(x, name=None):
+    return apply_op("i1e", lambda v: jax.scipy.special.i1e(v), (x,), {})
+
+
+def gammaln(x, name=None):
+    return apply_op("gammaln", jax.scipy.special.gammaln, (x,), {})
+
+
+def gammainc(x, y, name=None):
+    return apply_op("gammainc", jax.scipy.special.gammainc, (x, y), {})
+
+
+def nextafter(x, y, name=None):
+    return apply_op("nextafter", jnp.nextafter, (x, y), {})
+
+
+def nanquantile(x, q, axis=None, keepdim=False, name=None):
+    return apply_op(
+        "nanquantile",
+        lambda v: jnp.nanquantile(v, q, axis=axis, keepdims=keepdim),
+        (x,), {})
+
+
+def frexp(x, name=None):
+    def impl(v):
+        m, e = jnp.frexp(v)
+        return m, e.astype("int32")
+    return apply_op("frexp", impl, (x,), {})
+
+
+__all__ += ["trapezoid", "hypot", "copysign", "ldexp", "polar", "sgn",
+            "sinc", "i0", "i0e", "i1", "i1e", "gammaln", "gammainc",
+            "nextafter", "nanquantile", "frexp"]

@@ -111,3 +111,13 @@ def index_sample(x, index):
     return apply_op("index_sample",
                     lambda v, i: jnp.take_along_axis(v, i, axis=1),
                     (x, index), {})
+
+
+def bucketize(x, sorted_sequence, out_int32=False, right=False, name=None):
+    """Bucket indices of x in a 1-D sorted sequence (reference
+    `paddle.bucketize` over searchsorted)."""
+    return searchsorted(sorted_sequence, x, out_int32=out_int32,
+                        right=right, name=name)
+
+
+__all__.append("bucketize")

@@ -536,6 +536,57 @@ add("index_put", lambda x, v: P.index_put(
     [x_gen((4, 3), seed=1), x_gen((2, 3), seed=2)], diff=(0, 1))
 add("tensor_t_method", lambda x: x.t(), [x_gen((3, 4))])
 
+# ---- round-5 op-gap closures (reference grid_sampler/fold/renorm/...) -----
+add("cdist_p2", P.cdist, [x_gen((4, 3), seed=11), x_gen((5, 3), seed=12)],
+    diff=(0, 1), atol=5e-3)
+add("cdist_p1", P.cdist, [x_gen((4, 3), seed=13), x_gen((5, 3), seed=14)],
+    diff=(0, 1), kwargs={"p": 1.0, "compute_mode": "donot_use_mm"},
+    atol=5e-3)
+add("renorm", P.renorm, [x_gen((3, 4, 2), seed=15)],
+    kwargs={"p": 2.0, "axis": 1, "max_norm": 1.2}, atol=5e-3)
+add("logcumsumexp", P.logcumsumexp, [x_gen((3, 5), seed=16)],
+    kwargs={"axis": -1})
+add("vander", P.vander, [x_gen((4,), seed=17)], kwargs={"n": 3}, atol=5e-3)
+add("fold", F.fold, [x_gen((2, 8, 9), seed=18)],
+    kwargs={"output_sizes": (4, 4), "kernel_sizes": 2, "strides": 1})
+add("unfold", F.unfold, [x_gen((1, 2, 5, 5), seed=19)],
+    kwargs={"kernel_sizes": 3, "strides": 1, "paddings": 1})
+
+
+def _gs_grid(shape, seed):
+    """Grid points away from integer sample-coords so bilinear stays
+    locally linear under the finite-difference eps."""
+    g = _rs(seed).uniform(-0.7, 0.7, size=shape).astype("float32")
+    return g
+
+
+add("grid_sample_x", F.grid_sample,
+    [x_gen((1, 2, 5, 6), seed=20), _gs_grid((1, 3, 3, 2), 21)],
+    diff=(0,), kwargs={"align_corners": True})
+add("grid_sample_grid", F.grid_sample,
+    [x_gen((1, 2, 5, 6), seed=22), _gs_grid((1, 3, 3, 2), 23)],
+    diff=(1,), kwargs={"align_corners": True}, atol=2e-2, rtol=5e-2)
+add("grid_sample_border", F.grid_sample,
+    [x_gen((1, 2, 4, 4), seed=24), _gs_grid((1, 2, 2, 2), 25)],
+    diff=(0,), kwargs={"padding_mode": "border", "align_corners": False})
+add("lu", lambda x: P.linalg.lu(x)[0], [spd(4, seed=26)], atol=8e-3,
+    rtol=3e-2)
+add("trapezoid", P.trapezoid, [x_gen((3, 5), seed=27)])
+add("hypot", P.hypot, [pos(seed=28), pos(seed=29)], diff=(0, 1))
+add("copysign", P.copysign, [x_gen(seed=30), x_gen(seed=31)], diff=(0,))
+add("ldexp", P.ldexp, [x_gen(seed=32),
+                       idx((3, 4), 3, seed=33).astype("float32")],
+    diff=(0,))
+add("sinc", P.sinc, [x_gen(seed=34)], atol=5e-3)
+add("i0", P.i0, [x_gen(seed=35)], atol=5e-3)
+add("i1", P.i1, [x_gen(seed=36)], atol=5e-3)
+add("gammaln_op", P.gammaln, [pos(seed=37)])
+add("index_fill", P.index_fill,
+    [x_gen((4, 3), seed=38),
+     np.array([0, 2], dtype="int64")],
+    diff=(0,), kwargs={"axis": 0, "value": 0.5}, int_inputs=(1,))
+add("diagonal_scatter", P.diagonal_scatter,
+    [x_gen((4, 4), seed=39), x_gen((4,), seed=40)], diff=(0, 1))
 
 _IDS = [c.name for c in CASES]
 

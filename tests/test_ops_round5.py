@@ -1,0 +1,194 @@
+"""Forward parity for the round-5 op-gap closures (reference ops:
+grid_sampler_op.cc, fold/unfold_op.cc, renorm_op.cc, cum_op.h
+logcumsumexp, lu_op.cc, eig_op.h, searchsorted/bucketize). torch (CPU,
+baked into the image) provides the oracle where the math is fiddly."""
+import numpy as np
+import pytest
+import torch
+import torch.nn.functional as TF
+
+import paddle_tpu as paddle
+import paddle_tpu.nn.functional as F
+
+
+def _rs(seed=0):
+    return np.random.RandomState(seed)
+
+
+def test_fold_matches_torch():
+    x = _rs(0).randn(2, 3 * 2 * 2, 9).astype("float32")
+    ref = TF.fold(torch.tensor(x), output_size=(4, 4), kernel_size=2,
+                  stride=1).numpy()
+    got = F.fold(paddle.to_tensor(x), (4, 4), 2, strides=1).numpy()
+    np.testing.assert_allclose(got, ref, rtol=1e-5, atol=1e-6)
+
+
+def test_unfold_fold_roundtrip_stride_pad_dilation():
+    img = _rs(1).randn(1, 2, 8, 8).astype("float32")
+    u = F.unfold(paddle.to_tensor(img), 3, strides=2, paddings=1)
+    got = F.fold(u, (8, 8), 3, strides=2, paddings=1).numpy()
+    ref = TF.fold(TF.unfold(torch.tensor(img), 3, stride=2, padding=1),
+                  (8, 8), 3, stride=2, padding=1).numpy()
+    np.testing.assert_allclose(got, ref, rtol=1e-5, atol=1e-6)
+
+
+def test_unfold_asymmetric_paddings_reference_order():
+    """4-element paddings are [top, LEFT, bottom, right] in the reference
+    (`operators/unfold_op.h`); regression for the swapped order."""
+    img = _rs(20).randn(1, 2, 6, 6).astype("float32")
+    # pad left by 2 only: torch F.pad order (l, r, t, b) = (2, 0, 0, 0)
+    ref = TF.unfold(TF.pad(torch.tensor(img), (2, 0, 0, 0)), 3).numpy()
+    got = F.unfold(paddle.to_tensor(img), 3,
+                   paddings=[0, 2, 0, 0]).numpy()  # [t, l, b, r]
+    np.testing.assert_allclose(got, ref, rtol=1e-5, atol=1e-6)
+
+
+def test_fold_asymmetric_paddings_roundtrip():
+    img = _rs(21).randn(1, 2, 6, 6).astype("float32")
+    pads = [1, 2, 0, 1]   # t, l, b, r
+    u = F.unfold(paddle.to_tensor(img), 3, strides=1, paddings=pads)
+    got = F.fold(u, (6, 6), 3, strides=1, paddings=pads).numpy()
+    # torch oracle with equivalent explicit padding
+    tu = TF.unfold(TF.pad(torch.tensor(img), (2, 1, 1, 0)), 3)
+    tf_ = TF.fold(tu, (6 + 1 + 0, 6 + 2 + 1), 3).numpy()[
+        :, :, 1:7, 2:8]
+    np.testing.assert_allclose(got, tf_, rtol=1e-5, atol=1e-6)
+
+
+def test_cdist_donot_use_mm_is_exact():
+    x = np.ones((3, 4), np.float32)
+    got = paddle.cdist(paddle.to_tensor(x), paddle.to_tensor(x.copy()),
+                       compute_mode="donot_use_mm_for_euclid_dist")
+    np.testing.assert_array_equal(got.numpy(), np.zeros((3, 3), np.float32))
+
+
+def test_lu_unpack_batched():
+    a = _rs(22).randn(2, 4, 4).astype("float32")
+    lu_, piv = paddle.linalg.lu(paddle.to_tensor(a))
+    P_, L, U = paddle.linalg.lu_unpack(lu_, piv)
+    rec = np.einsum("bij,bjk,bkl->bil", P_.numpy(), L.numpy(), U.numpy())
+    np.testing.assert_allclose(rec, a, rtol=1e-4, atol=1e-5)
+
+
+def test_trapezoid_dx_zero():
+    y = _rs(23).randn(3, 5).astype("float32")
+    got = paddle.trapezoid(paddle.to_tensor(y), dx=0.0).numpy()
+    np.testing.assert_array_equal(got, np.zeros(3, np.float32))
+
+
+@pytest.mark.parametrize("mode", ["bilinear", "nearest"])
+@pytest.mark.parametrize("pm", ["zeros", "border", "reflection"])
+@pytest.mark.parametrize("ac", [True, False])
+def test_grid_sample_matches_torch(mode, pm, ac):
+    x = _rs(2).randn(2, 3, 5, 6).astype("float32")
+    grid = (_rs(3).rand(2, 4, 4, 2).astype("float32") * 2.4 - 1.2)
+    ref = TF.grid_sample(torch.tensor(x), torch.tensor(grid), mode=mode,
+                         padding_mode=pm, align_corners=ac).numpy()
+    got = F.grid_sample(paddle.to_tensor(x), paddle.to_tensor(grid),
+                        mode=mode, padding_mode=pm,
+                        align_corners=ac).numpy()
+    np.testing.assert_allclose(got, ref, rtol=1e-4, atol=1e-5)
+
+
+def test_renorm_matches_torch():
+    x = _rs(4).randn(3, 4, 5).astype("float32")
+    ref = torch.renorm(torch.tensor(x), 2, 1, 1.5).numpy()
+    got = paddle.renorm(paddle.to_tensor(x), 2.0, 1, 1.5).numpy()
+    np.testing.assert_allclose(got, ref, rtol=1e-4, atol=1e-5)
+
+
+def test_logcumsumexp_matches_torch():
+    x = _rs(5).randn(3, 7).astype("float32")
+    ref = torch.logcumsumexp(torch.tensor(x), dim=-1).numpy()
+    got = paddle.logcumsumexp(paddle.to_tensor(x), axis=-1).numpy()
+    np.testing.assert_allclose(got, ref, rtol=1e-4, atol=1e-5)
+    # flattened (axis=None) path
+    ref0 = torch.logcumsumexp(torch.tensor(x).reshape(-1), dim=0).numpy()
+    got0 = paddle.logcumsumexp(paddle.to_tensor(x)).numpy()
+    np.testing.assert_allclose(got0, ref0, rtol=1e-4, atol=1e-5)
+
+
+def test_vander():
+    v = _rs(6).randn(5).astype("float32")
+    np.testing.assert_allclose(paddle.vander(paddle.to_tensor(v)).numpy(),
+                               np.vander(v), rtol=1e-4)
+    np.testing.assert_allclose(
+        paddle.vander(paddle.to_tensor(v), 3, True).numpy(),
+        np.vander(v, 3, increasing=True), rtol=1e-4)
+
+
+def test_bucketize_matches_torch():
+    seq = np.sort(_rs(7).randn(6).astype("float32"))
+    vals = _rs(8).randn(3, 4).astype("float32")
+    for right in (False, True):
+        ref = torch.bucketize(torch.tensor(vals), torch.tensor(seq),
+                              right=right).numpy()
+        got = paddle.bucketize(paddle.to_tensor(vals),
+                               paddle.to_tensor(seq), right=right).numpy()
+        np.testing.assert_array_equal(got, ref)
+
+
+def test_cdist_matches_torch():
+    x = _rs(9).randn(2, 5, 3).astype("float32")
+    y = _rs(10).randn(2, 4, 3).astype("float32")
+    for p in (1.0, 2.0, float("inf")):
+        ref = torch.cdist(torch.tensor(x), torch.tensor(y), p=p).numpy()
+        got = paddle.cdist(paddle.to_tensor(x), paddle.to_tensor(y),
+                           p=p).numpy()
+        np.testing.assert_allclose(got, ref, rtol=1e-3, atol=1e-4)
+
+
+def test_lu_and_unpack_reconstruct():
+    a = _rs(11).randn(4, 4).astype("float32")
+    lu_, piv = paddle.linalg.lu(paddle.to_tensor(a))
+    P_, L, U = paddle.linalg.lu_unpack(lu_, piv)
+    np.testing.assert_allclose(P_.numpy() @ L.numpy() @ U.numpy(), a,
+                               rtol=1e-4, atol=1e-5)
+    lu2, piv2, infos = paddle.linalg.lu(paddle.to_tensor(a),
+                                        get_infos=True)
+    assert (infos.numpy() == 0).all()
+
+
+def test_small_op_parade_matches_torch():
+    """One-line parity for the long tail of round-5 additions."""
+    x = _rs(13).randn(3, 4).astype("float32")
+    y = (np.abs(_rs(14).randn(3, 4)) + 0.5).astype("float32")
+    t, pt = torch.tensor, paddle.to_tensor
+    pairs = [
+        (paddle.trapezoid(pt(x)), torch.trapezoid(t(x))),
+        (paddle.hypot(pt(x), pt(y)), torch.hypot(t(x), t(y))),
+        (paddle.copysign(pt(x), pt(y)), torch.copysign(t(x), t(y))),
+        (paddle.polar(pt(y), pt(x)), torch.polar(t(y), t(x))),
+        (paddle.sgn(pt(x)), torch.sgn(t(x))),
+        (paddle.sinc(pt(x)), torch.sinc(t(x))),
+        (paddle.i0(pt(x)), torch.special.i0(t(x))),
+        (paddle.gammaln(pt(y)), torch.special.gammaln(t(y))),
+        (paddle.nextafter(pt(x), pt(y)), torch.nextafter(t(x), t(y))),
+        (paddle.nanquantile(pt(x), 0.5), torch.nanquantile(t(x), 0.5)),
+    ]
+    for got, ref in pairs:
+        np.testing.assert_allclose(got.numpy(), ref.numpy(),
+                                   rtol=1e-4, atol=1e-5)
+    m, e = paddle.frexp(pt(x))
+    mr, er = torch.frexp(t(x))
+    np.testing.assert_allclose(m.numpy(), mr.numpy(), rtol=1e-6)
+    np.testing.assert_array_equal(e.numpy(), er.numpy())
+    i = np.array([0, 2], dtype="int64")
+    np.testing.assert_allclose(
+        paddle.index_fill(pt(x), pt(i), 0, -1.0).numpy(),
+        torch.index_fill(t(x), 0, t(i), -1.0).numpy())
+    d = _rs(15).randn(3).astype("float32")
+    np.testing.assert_allclose(
+        paddle.diagonal_scatter(pt(x), pt(d), offset=1).numpy(),
+        torch.diagonal_scatter(t(x), t(d), offset=1).numpy())
+
+
+def test_eig_host_callback():
+    a = _rs(12).randn(5, 5).astype("float32")
+    w, v = paddle.linalg.eig(paddle.to_tensor(a))
+    np.testing.assert_allclose(
+        a.astype("complex64") @ v.numpy(), w.numpy()[None, :] * v.numpy(),
+        rtol=1e-3, atol=1e-4)
+    wv = paddle.linalg.eigvals(paddle.to_tensor(a)).numpy()
+    np.testing.assert_allclose(np.sort(wv.real), np.sort(w.numpy().real),
+                               rtol=1e-4, atol=1e-5)
