@@ -328,6 +328,61 @@ def _bench_gpt_body(BATCH, SEQ):
     return tps, mfu
 
 
+def bench_host_embedding():
+    """HeterPS-equivalent path: host C++ sparse table -> device train step
+    -> grad push (reference heter_ps/heter_comm.h)."""
+    import paddle_tpu as paddle
+    import paddle_tpu.nn as nn
+    from paddle_tpu.distributed.ps import (HostEmbedding, native_available,
+                                           make_host_embedding_step)
+    if not native_available():
+        raise RuntimeError("native ps_core not built")
+
+    DIM = 16 if _SMOKE else 64
+    BATCH_IDS = 512 if _SMOKE else 8192
+    VOCAB = 100_000
+
+    class Head(nn.Layer):
+        def __init__(self):
+            super().__init__()
+            self.fc = nn.Linear(DIM, 1)
+
+        def forward(self, emb_flat, labels):
+            from paddle_tpu.framework.tensor import Tensor
+            return self.fc(Tensor(emb_flat))
+
+    paddle.seed(0)
+    host = HostEmbedding(DIM, rule="adam", lr=1e-3)
+    head = Head()
+    opt = paddle.optimizer.AdamW(1e-3, parameters=head.parameters())
+
+    def loss_fn(out, data):
+        from paddle_tpu.framework.tensor import Tensor
+        import jax.numpy as jnp
+        d = out._value if hasattr(out, "_value") else out
+        y = data[0]._value if hasattr(data[0], "_value") else data[0]
+        return Tensor(jnp.mean((d.squeeze(-1) - y) ** 2))
+
+    step = make_host_embedding_step(head, opt, loss_fn, host)
+    rng = np.random.RandomState(0)
+
+    def batch():
+        ids = rng.randint(0, VOCAB, size=(BATCH_IDS,)).astype("int64")
+        y = rng.standard_normal((BATCH_IDS,)).astype("float32")
+        return ids, y
+
+    for _ in range(3):
+        ids, y = batch()
+        step(ids, y)
+    iters = 2 if _SMOKE else 15
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        ids, y = batch()
+        step(ids, y)
+    dt = time.perf_counter() - t0
+    return BATCH_IDS * iters / dt
+
+
 def main():
     try:
         devs = _init_backend()
@@ -366,6 +421,14 @@ def main():
             extra = {"flash_off_error": str(e)[:300]}
         _emit("gpt_seq2048_train_tokens_per_sec_bs4_bf16_flash", tps_on,
               "tokens/sec", mfu=mfu_on, extra=extra)
+
+    try:
+        rps = bench_host_embedding()
+        _emit("host_embedding_train_ids_per_sec_dim64", rps, "ids/sec")
+    except Exception as e:  # noqa: BLE001
+        traceback.print_exc()
+        _emit("host_embedding_train_ids_per_sec_dim64", 0.0, "ids/sec",
+              extra={"error": str(e)[:300]})
 
     try:
         sps, mfu = bench_ernie()

@@ -1,4 +1,5 @@
 from .communicator import AsyncCommunicator, GeoCommunicator
+from .host_embedding import HostEmbedding, make_host_embedding_step
 from .runtime import DistributedEmbedding, TheOnePSRuntime, the_one_ps
 from .service import PsClient, PsServer, TableConfig
 from .tables import DenseTable, SparseTable, native_available

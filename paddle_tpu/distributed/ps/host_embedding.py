@@ -1,0 +1,129 @@
+"""Host-offload sparse embedding path — the TPU-native HeterPS.
+
+Reference: `paddle/fluid/framework/fleet/heter_ps/heter_comm.h:50` +
+`PSGPUTrainer` (`framework/trainer.h:283`): giant embedding tables live in
+host RAM, the accelerator runs the dense math, and each step is
+pull → device compute → grad push with the optimizer rule applied
+table-side.
+
+TPU redesign: the table is the native C++ sharded hash
+(`csrc/ps_core.cc` via ctypes, the same core the PS service uses); the
+dense model is ONE jit'd XLA program whose inputs include the pulled
+embedding block and whose outputs include dLoss/dEmbedding, so the only
+host↔device traffic per step is the deduplicated rows in and their
+gradients out. Duplicate ids in a batch are deduplicated host-side and
+their gradients segment-summed ON DEVICE before the push, which keeps
+adagrad/adam table rules correct (one update per touched row per step).
+"""
+from __future__ import annotations
+
+from typing import Callable, Tuple
+
+import numpy as np
+
+__all__ = ["HostEmbedding", "make_host_embedding_step"]
+
+
+class HostEmbedding:
+    """A host-RAM embedding table with dedup pull/push.
+
+    dim: embedding width; rule: 'sgd' | 'adam' | 'sum' (applied in the
+    C++ core on push); lr/init_range/seed as in SparseTable.
+    """
+
+    def __init__(self, dim: int, rule: str = "sgd", lr: float = 0.01,
+                 init_range: float = 0.05, seed: int = 0):
+        from .tables import SparseTable
+        self.dim = int(dim)
+        self.table = SparseTable(dim, rule=rule, lr=lr,
+                                 init_range=init_range, seed=seed)
+
+    def pull_dedup(self, ids) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
+        """ids (any shape) → (rows [cap, dim], inverse [ids.size], uniq).
+
+        rows are padded to the next power-of-two capacity: the unique
+        count varies batch to batch, and an un-padded shape would retrace
+        the jit'd device step every single step on TPU. Pad rows are
+        zeros; their gradients are discarded at push time.
+        """
+        ids = np.ascontiguousarray(np.asarray(ids, np.int64)).reshape(-1)
+        uniq, inverse = np.unique(ids, return_inverse=True)
+        rows = self.table.pull(uniq)
+        cap = 1 << max(0, int(uniq.size - 1)).bit_length()
+        if cap > uniq.size:
+            rows = np.concatenate(
+                [rows, np.zeros((cap - uniq.size, self.dim), np.float32)])
+        return rows, inverse.astype(np.int32), uniq
+
+    def push(self, uniq_ids: np.ndarray, grads) -> None:
+        self.table.push(np.asarray(uniq_ids, np.int64),
+                        np.asarray(grads, np.float32))
+
+    def __len__(self):
+        return len(self.table)
+
+    def save(self, path):
+        return self.table.save(path)
+
+    def load(self, path):
+        return self.table.load(path)
+
+
+def make_host_embedding_step(dense_layer, optimizer, loss_fn: Callable,
+                             emb: HostEmbedding):
+    """Build `step(ids, *data) -> loss` for a dense model over a host table.
+
+    dense_layer(emb_batch, *data) -> outputs; loss_fn(outputs, *data) ->
+    scalar Tensor. The dense parameters train through `optimizer` on
+    device; the embedding rows train through the table rule on host —
+    exactly the HeterPS split (`heter_comm.h:50`).
+    """
+    import jax
+    import jax.numpy as jnp
+
+    from ...framework.autograd import trace_mode
+    from ...framework.functional import functionalize
+    from ...framework.tensor import Tensor
+
+    apply_fn, pv, bv = functionalize(dense_layer)
+    opt_state = {n: optimizer._init_state(v) for n, v in pv.items()}
+
+    def loss_of(pv_, bv_, rng, rows, inverse, data):
+        emb_batch = jnp.take(rows, inverse, axis=0)   # un-dedup on device
+        out, new_bufs = apply_fn(pv_, bv_, rng, True, emb_batch, *data)
+        with trace_mode():
+            lv = loss_fn(jax.tree_util.tree_map(Tensor, out),
+                         [Tensor(d) for d in data])
+        lv = lv._value if isinstance(lv, Tensor) else lv
+        return jnp.mean(lv.astype("float32")), new_bufs
+
+    def device_step(pv_, bv_, opt_state_, step_no, lr, rng, rows, inverse,
+                    *data):
+        (lv, new_bufs), (gp, grows) = jax.value_and_grad(
+            loss_of, argnums=(0, 3), has_aux=True)(
+                pv_, bv_, rng, rows, inverse, data)
+        new_pv, new_opt = optimizer.apply_gradients_pytree(
+            gp, pv_, opt_state_, lr, step_no)
+        # grows is already segment-summed over duplicates by the take-VJP
+        return lv, grows, new_pv, new_bufs, new_opt
+
+    jit_step = jax.jit(device_step)
+    state = {"pv": pv, "bv": bv, "opt": opt_state, "n": 0}
+
+    def step(ids, *data):
+        from ...framework import random as frandom
+        rows, inverse, uniq = emb.pull_dedup(ids)
+        data = tuple(jnp.asarray(np.asarray(d)) for d in data)
+        lv, grows, state["pv"], state["bv"], state["opt"] = jit_step(
+            state["pv"], state["bv"], state["opt"],
+            jnp.asarray(state["n"] + 1, "int32"),
+            jnp.asarray(optimizer.get_lr(), "float32"),  # per-step, so LR
+            frandom.get_rng_key(),                       # schedules work
+            jnp.asarray(rows), jnp.asarray(inverse), *data)
+        state["n"] += 1
+        grows = np.asarray(jax.device_get(grows))
+        emb.push(uniq, grows[:uniq.size])   # drop pad-row gradients
+        return float(jax.device_get(lv))
+
+    step.state = state
+    return step
