@@ -5,7 +5,7 @@ import jax as _jax
 # this setting is a no-op — the MXU consumes bf16 natively.
 _jax.config.update("jax_default_matmul_precision", "highest")
 
-from . import autograd, dtype, flags, place, random
+from . import autograd, dtype, errors, flags, monitor, place, random
 from .autograd import (backward, enable_grad, grad, in_trace_mode,
                        is_grad_enabled, no_grad, trace_mode)
 from .dtype import (DType, convert_dtype, to_jax_dtype, bool_, uint8, int8,

@@ -1,0 +1,80 @@
+"""Typed framework errors (reference `paddle/fluid/platform/enforce.h:410`
++ `platform/errors.h`: error codes LEGACY/INVALID_ARGUMENT/NOT_FOUND/
+OUT_OF_RANGE/ALREADY_EXISTS/RESOURCE_EXHAUSTED/PRECONDITION_NOT_MET/
+PERMISSION_DENIED/EXECUTION_TIMEOUT/UNIMPLEMENTED/UNAVAILABLE/FATAL/
+EXTERNAL, raised via PADDLE_ENFORCE_*).
+
+Each type subclasses the closest Python builtin so existing callers that
+catch ValueError/KeyError/etc. keep working, while new code can catch the
+typed family (all are EnforceNotMet)."""
+from __future__ import annotations
+
+__all__ = ["EnforceNotMet", "InvalidArgumentError", "NotFoundError",
+           "OutOfRangeError", "AlreadyExistsError",
+           "ResourceExhaustedError", "PreconditionNotMetError",
+           "PermissionDeniedError", "ExecutionTimeoutError",
+           "UnimplementedError", "UnavailableError", "FatalError",
+           "ExternalError", "enforce"]
+
+
+class EnforceNotMet(Exception):
+    """Base of every typed framework error (reference enforce.h:410
+    EnforceNotMet). `code` mirrors platform/error_codes.proto."""
+    code = "LEGACY"
+    # KeyError.__str__ repr-quotes the message; keep plain text for every
+    # typed error regardless of which builtin it mixes in
+    __str__ = Exception.__str__
+
+
+class InvalidArgumentError(EnforceNotMet, ValueError):
+    code = "INVALID_ARGUMENT"
+
+
+class NotFoundError(EnforceNotMet, KeyError):
+    code = "NOT_FOUND"
+
+
+class OutOfRangeError(EnforceNotMet, IndexError):
+    code = "OUT_OF_RANGE"
+
+
+class AlreadyExistsError(EnforceNotMet):
+    code = "ALREADY_EXISTS"
+
+
+class ResourceExhaustedError(EnforceNotMet, MemoryError):
+    code = "RESOURCE_EXHAUSTED"
+
+
+class PreconditionNotMetError(EnforceNotMet, RuntimeError):
+    code = "PRECONDITION_NOT_MET"
+
+
+class PermissionDeniedError(EnforceNotMet, PermissionError):
+    code = "PERMISSION_DENIED"
+
+
+class ExecutionTimeoutError(EnforceNotMet, TimeoutError):
+    code = "EXECUTION_TIMEOUT"
+
+
+class UnimplementedError(EnforceNotMet, NotImplementedError):
+    code = "UNIMPLEMENTED"
+
+
+class UnavailableError(EnforceNotMet, RuntimeError):
+    code = "UNAVAILABLE"
+
+
+class FatalError(EnforceNotMet):
+    code = "FATAL"
+
+
+class ExternalError(EnforceNotMet, OSError):
+    code = "EXTERNAL"
+
+
+def enforce(condition, message="", error_cls=PreconditionNotMetError):
+    """PADDLE_ENFORCE: raise `error_cls(message)` unless condition holds."""
+    if not condition:
+        raise error_cls(message)

@@ -50,9 +50,10 @@ register_flag("FLAGS_flash_attention_min_seq", 512,
 
 
 def set_flags(flags: Dict[str, Any]) -> None:
+    from .errors import NotFoundError
     for k, v in flags.items():
         if k not in _FLAGS:
-            raise KeyError(f"Unknown flag {k!r}")
+            raise NotFoundError(f"Unknown flag {k!r}")
         _FLAGS[k] = v
 
 

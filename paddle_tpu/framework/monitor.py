@@ -1,0 +1,90 @@
+"""Runtime STAT counters (reference `paddle/fluid/platform/monitor.h:44`
+StatRegistry/StatValue + the STAT_ADD/STAT_SUB/STAT_RESET macros in
+`monitor.h:131`).
+
+Same contract, Python-native: named monotonic/resettable int counters,
+thread-safe, globally registered, dumped as one dict for metrics export.
+Hot-path framework code (dataloader batches, flash-kernel dispatches,
+executor runs) bumps these; they cost one dict lookup + int add.
+"""
+from __future__ import annotations
+
+import threading
+from typing import Dict
+
+__all__ = ["StatValue", "stat_add", "stat_sub", "stat_reset", "stat_get",
+           "all_stats", "STAT_ADD", "STAT_SUB", "STAT_RESET"]
+
+
+class StatValue:
+    """One named counter (reference monitor.h:44)."""
+
+    __slots__ = ("name", "_v", "_lock")
+
+    def __init__(self, name: str):
+        self.name = name
+        self._v = 0
+        self._lock = threading.Lock()
+
+    def increase(self, n: int = 1) -> int:
+        with self._lock:
+            self._v += n
+            return self._v
+
+    def decrease(self, n: int = 1) -> int:
+        return self.increase(-n)
+
+    def reset(self) -> int:
+        with self._lock:
+            self._v = 0
+            return 0
+
+    def get(self) -> int:
+        return self._v
+
+
+class _Registry:
+    def __init__(self):
+        self._stats: Dict[str, StatValue] = {}
+        self._lock = threading.Lock()
+
+    def get(self, name: str) -> StatValue:
+        s = self._stats.get(name)
+        if s is None:
+            with self._lock:
+                s = self._stats.setdefault(name, StatValue(name))
+        return s
+
+    def snapshot(self) -> Dict[str, int]:
+        return {n: s.get() for n, s in sorted(self._stats.items())}
+
+
+_registry = _Registry()
+
+
+def stat_add(name: str, n: int = 1) -> int:
+    return _registry.get(name).increase(n)
+
+
+def stat_sub(name: str, n: int = 1) -> int:
+    return _registry.get(name).decrease(n)
+
+
+def stat_reset(name: str) -> int:
+    return _registry.get(name).reset()
+
+
+def stat_get(name: str) -> int:
+    return _registry.get(name).get()
+
+
+def all_stats() -> Dict[str, int]:
+    """Snapshot of every registered counter (reference
+    StatRegistry::publish)."""
+    return _registry.snapshot()
+
+
+# macro-style aliases matching the reference spelling
+STAT_ADD = stat_add
+STAT_SUB = stat_sub
+STAT_RESET = stat_reset

@@ -208,29 +208,34 @@ class EarlyStopping(Callback):
 
 
 class VisualDL(Callback):
-    """Scalar logger. VisualDL isn't in this image; falls back to a JSONL
-    event file readable by any dashboard."""
+    """Scalar logger over utils.LogWriter (reference
+    `paddle.callbacks.VisualDL`; VisualDL itself isn't in this image —
+    the JSONL scalar stream is the dashboard-agnostic equivalent)."""
 
     def __init__(self, log_dir="./log"):
         super().__init__()
         self.log_dir = log_dir
-        self._fh = None
+        self._writer = None
+        self._step = 0
 
     def on_train_begin(self, logs=None):
-        os.makedirs(self.log_dir, exist_ok=True)
-        self._fh = open(os.path.join(self.log_dir, "scalars.jsonl"), "a")
+        from ..utils.log_writer import LogWriter
+        self._writer = LogWriter(self.log_dir)
 
     def on_train_batch_end(self, step, logs=None):
-        import json
-        if self._fh:
-            rec = {k: float(v) for k, v in (logs or {}).items()
-                   if isinstance(v, (int, float))}
-            rec["step"] = step
-            self._fh.write(json.dumps(rec) + "\n")
+        if self._writer:
+            self._step += 1
+            for k, v in (logs or {}).items():
+                if isinstance(v, (int, float)):
+                    self._writer.add_scalar(f"train/{k}", v, self._step)
+
+    def on_epoch_end(self, epoch, logs=None):
+        if self._writer:
+            self._writer.dump_stats(step=epoch)
 
     def on_train_end(self, logs=None):
-        if self._fh:
-            self._fh.close()
+        if self._writer:
+            self._writer.close()
 
 
 def config_callbacks(callbacks=None, model=None, batch_size=None, epochs=None,

@@ -30,6 +30,7 @@ from typing import Callable, Optional
 
 import numpy as np
 
+from ..framework.monitor import STAT_ADD
 from ..framework.tensor import Tensor
 from .dataset import Dataset, IterableDataset
 from .sampler import BatchSampler
@@ -254,6 +255,7 @@ class DataLoader:
 
     def _fetch(self, indices):
         samples = [self.dataset[i] for i in indices]
+        STAT_ADD("STAT_dataloader_batches")
         return _to_tensors(self.collate_fn(samples))
 
     def _iter_single(self):
@@ -265,9 +267,11 @@ class DataLoader:
         for sample in self.dataset:
             batch.append(sample)
             if len(batch) == self.batch_size:
+                STAT_ADD("STAT_dataloader_batches")
                 yield _to_tensors(self.collate_fn(batch))
                 batch = []
         if batch and not getattr(self, "drop_last", False):
+            STAT_ADD("STAT_dataloader_batches")
             yield _to_tensors(self.collate_fn(batch))
 
     def _iter_multiprocess(self):
@@ -374,6 +378,7 @@ class DataLoader:
                     sent += 1
                 deadline = (time.monotonic() + self.timeout
                             if self.timeout else None)
+                STAT_ADD("STAT_dataloader_batches")
                 yield _to_tensors(_shm_decode(*pending.pop(want)))
         finally:
             shutdown()

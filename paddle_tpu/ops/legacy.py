@@ -272,3 +272,145 @@ def tensor_array_to_tensor(input, axis=1, use_stack=False, name=None):
     sizes = np.asarray([t.shape[axis] if not use_stack else 1
                         for t in input], np.int32)
     return out, to_tensor(sizes)
+
+
+# ---------------------------------------------------------------------------
+# LoD sequence ops (reference `operators/sequence_ops/*.cc`). Fluid-era
+# models run these eagerly over LoDTensor (concat-of-sequences + offsets);
+# compiled TPU models use padded-dense + sequence_mask instead, so these
+# are host-side conveniences, not jit surfaces.
+# ---------------------------------------------------------------------------
+
+def _seq_offsets(x):
+    lod = x.lod() if isinstance(x, LoDTensor) else []
+    if not lod:
+        raise ValueError("sequence op needs a LoDTensor with level-0 LoD")
+    return list(lod[0])
+
+
+def sequence_pad(x, pad_value=0.0, maxlen=None, name=None):
+    """(LoDTensor rows) → (padded [N, maxlen, ...], lengths [N])
+    (reference `sequence_pad_op.cc`)."""
+    offs = _seq_offsets(x)
+    v = np.asarray(x._value)
+    lens = [b - a for a, b in zip(offs[:-1], offs[1:])]
+    m = maxlen or max(lens)
+    out = np.full((len(lens), m) + v.shape[1:], pad_value, v.dtype)
+    for i, (a, b) in enumerate(zip(offs[:-1], offs[1:])):
+        out[i, :b - a] = v[a:b]
+    return (Tensor(jnp.asarray(out)),
+            Tensor(jnp.asarray(np.asarray(lens, np.int64))))
+
+
+def sequence_unpad(x, length, name=None):
+    """Inverse of sequence_pad (reference `sequence_unpad_op.cc`)."""
+    v = np.asarray(x._value if isinstance(x, Tensor) else x)
+    lens = np.asarray(length._value if isinstance(length, Tensor)
+                      else length).astype(np.int64)
+    rows = np.concatenate([v[i, :l] for i, l in enumerate(lens)], axis=0)
+    offs = np.concatenate([[0], np.cumsum(lens)]).tolist()
+    return LoDTensor(rows, lod=[offs])
+
+
+def sequence_pool(input, pool_type="average", name=None):
+    """Per-sequence pooling (reference `sequence_pool_op.cc`):
+    sum/average/sqrt/max/min/last/first."""
+    offs = _seq_offsets(input)
+    v = np.asarray(input._value)
+    p = pool_type.lower()
+    if p not in ("sum", "average", "mean", "sqrt", "max", "min", "last",
+                 "first"):
+        raise ValueError(f"unknown pool_type {pool_type!r}")
+    outs = []
+    for a, b in zip(offs[:-1], offs[1:]):
+        if b == a:
+            # empty sequences are legal LoD; reference pads them with 0.0
+            outs.append(np.zeros(v.shape[1:], v.dtype))
+            continue
+        seg = v[a:b]
+        if p == "sum":
+            outs.append(seg.sum(0))
+        elif p in ("average", "mean"):
+            outs.append(seg.mean(0))
+        elif p == "sqrt":
+            outs.append(seg.sum(0) / np.sqrt(b - a))
+        elif p == "max":
+            outs.append(seg.max(0))
+        elif p == "min":
+            outs.append(seg.min(0))
+        elif p == "last":
+            outs.append(seg[-1])
+        elif p == "first":
+            outs.append(seg[0])
+    return Tensor(jnp.asarray(np.stack(outs)))
+
+
+def sequence_softmax(input, name=None):
+    """Softmax within each sequence (reference
+    `sequence_softmax_op.cc`)."""
+    offs = _seq_offsets(input)
+    v = np.asarray(input._value, np.float32)
+    if v.ndim > 1 and v.shape[-1] != 1:
+        # reference sequence_softmax_op enforces width-1 input
+        raise ValueError(
+            f"sequence_softmax requires input width 1, got {v.shape}")
+    out = np.empty_like(v)
+    for a, b in zip(offs[:-1], offs[1:]):
+        if b == a:
+            continue
+        e = np.exp(v[a:b] - v[a:b].max())
+        out[a:b] = e / e.sum()
+    return LoDTensor(out, lod=input.lod())
+
+
+def sequence_reverse(x, name=None):
+    """Reverse rows inside each sequence (reference
+    `sequence_reverse_op.h`)."""
+    offs = _seq_offsets(x)
+    v = np.asarray(x._value).copy()
+    for a, b in zip(offs[:-1], offs[1:]):
+        v[a:b] = v[a:b][::-1]
+    return LoDTensor(v, lod=x.lod())
+
+
+def sequence_concat(input, name=None):
+    """Concatenate LoDTensors sequence-by-sequence (reference
+    `sequence_concat_op.cc`)."""
+    all_offs = [_seq_offsets(t) for t in input]
+    n = len(all_offs[0]) - 1
+    vals = [np.asarray(t._value) for t in input]
+    rows, offs = [], [0]
+    for i in range(n):
+        for v, of in zip(vals, all_offs):
+            rows.append(v[of[i]:of[i + 1]])
+        offs.append(offs[-1] + sum(of[i + 1] - of[i] for of in all_offs))
+    if not rows:
+        return LoDTensor(np.zeros((0,) + vals[0].shape[1:],
+                                  vals[0].dtype), lod=[offs])
+    return LoDTensor(np.concatenate(rows, 0), lod=[offs])
+
+
+def sequence_expand(x, y, ref_level=0, name=None):
+    """Repeat each sequence of x to match y's LoD at ref_level
+    (reference `sequence_expand_op.cc`)."""
+    x_offs = _seq_offsets(x) if isinstance(x, LoDTensor) and x.lod() \
+        else None
+    y_offs = list(y.lod()[ref_level])
+    v = np.asarray(x._value)
+    n = len(y_offs) - 1
+    rows, offs = [], [0]
+    for i in range(n):
+        reps = y_offs[i + 1] - y_offs[i]
+        seg = v[x_offs[i]:x_offs[i + 1]] if x_offs is not None \
+            else v[i:i + 1]
+        for _ in range(reps):
+            rows.append(seg)
+        offs.append(offs[-1] + reps * seg.shape[0])
+    if not rows:
+        return LoDTensor(np.zeros((0,) + v.shape[1:], v.dtype), lod=[offs])
+    return LoDTensor(np.concatenate(rows, 0), lod=[offs])
+
+
+__all__ += ["sequence_pad", "sequence_unpad", "sequence_pool",
+            "sequence_softmax", "sequence_reverse", "sequence_concat",
+            "sequence_expand"]

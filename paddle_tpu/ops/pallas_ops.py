@@ -33,9 +33,25 @@ _BLOCK_Q = 128
 _BLOCK_K = 128
 _NEG_INF = -1e30
 
-# trace-time engagement counters (the bench reports these to prove the
-# kernel actually ran in its program; see VERDICT r2 weak #3)
-STATS = {"flash_fwd": 0, "flash_bwd": 0}
+from ..framework.monitor import stat_add as _stat_add, stat_get as _stat_get
+
+
+class _KernelStats:
+    """Trace-time engagement counters (prove the kernel ran in a given
+    program). Backed by the framework STAT registry
+    (framework/monitor.py) so there is one source of truth."""
+
+    _keys = {"flash_fwd": "STAT_flash_attention_fwd",
+             "flash_bwd": "STAT_flash_attention_bwd"}
+
+    def __getitem__(self, k):
+        return _stat_get(self._keys[k])
+
+    def bump(self, k):
+        _stat_add(self._keys[k])
+
+
+STATS = _KernelStats()
 
 try:  # pallas availability is backend dependent
     from jax.experimental import pallas as pl
@@ -261,7 +277,7 @@ def _flash_call(q, k, v, bias, seed, causal, scale, dropout_p,
     seed_arr = jnp.asarray(seed, jnp.int32).reshape(1, 1)
     kernel = functools.partial(_fwd_kernel, scale=scale, causal=causal,
                                block_k=block_k, dropout_p=dropout_p)
-    STATS["flash_fwd"] += 1
+    STATS.bump("flash_fwd")
     out, lse = pl.pallas_call(
         kernel,
         grid=(B * H, Sq // block_q),
@@ -299,7 +315,7 @@ def _flash_bwd_call(q, k, v, bias, seed, out, lse, g, causal, scale,
                     * out.reshape(B * H, Sq, D).astype(jnp.float32),
                     axis=-1, keepdims=True)
     seed_arr = jnp.asarray(seed, jnp.int32).reshape(1, 1)
-    STATS["flash_bwd"] += 1
+    STATS.bump("flash_bwd")
 
     dq = pl.pallas_call(
         functools.partial(_dq_kernel, scale=scale, causal=causal,

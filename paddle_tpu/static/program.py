@@ -21,6 +21,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from ..framework.monitor import STAT_ADD
 from ..framework.tensor import Tensor
 
 __all__ = ["Program", "Executor", "program_guard", "default_main_program",
@@ -276,6 +277,7 @@ class Executor:
 
     def run(self, program=None, feed=None, fetch_list=None,
             scope=None, return_numpy=True, use_program_cache=True):
+        STAT_ADD("STAT_executor_runs")
         program = program or _state.main
         feed = feed or {}
         fetch_list = fetch_list or []

@@ -2,9 +2,10 @@
 from . import download, unique_name
 from .download import get_weights_path_from_url
 from .lazy_import import try_import
+from .log_writer import LogWriter
 
 __all__ = ["download", "get_weights_path_from_url", "try_import",
-           "unique_name", "deprecated", "run_check"]
+           "unique_name", "deprecated", "run_check", "LogWriter"]
 
 
 def deprecated(update_to="", since="", reason=""):

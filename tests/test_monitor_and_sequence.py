@@ -1,0 +1,163 @@
+"""STAT counters (reference platform/monitor.h), typed errors
+(platform/enforce.h), LogWriter observability, and LoD sequence ops
+(operators/sequence_ops/)."""
+import json
+import os
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.framework import errors, monitor
+from paddle_tpu.ops.legacy import (LoDTensor, sequence_concat,
+                                   sequence_expand, sequence_pad,
+                                   sequence_pool, sequence_reverse,
+                                   sequence_softmax, sequence_unpad)
+
+
+def test_stat_counters():
+    monitor.stat_reset("STAT_test_counter")
+    monitor.STAT_ADD("STAT_test_counter", 5)
+    monitor.STAT_SUB("STAT_test_counter", 2)
+    assert monitor.stat_get("STAT_test_counter") == 3
+    assert monitor.all_stats()["STAT_test_counter"] == 3
+
+
+def test_dataloader_bumps_stats():
+    from paddle_tpu.io import DataLoader
+
+    class DS:
+        def __len__(self):
+            return 8
+
+        def __getitem__(self, i):
+            return np.zeros(2, np.float32)
+
+    monitor.stat_reset("STAT_dataloader_batches")
+    for _ in DataLoader(DS(), batch_size=4):
+        pass
+    assert monitor.stat_get("STAT_dataloader_batches") == 2
+
+
+def test_typed_errors_subclass_builtins():
+    with pytest.raises(KeyError):            # old-style catch still works
+        paddle.set_flags({"FLAGS_does_not_exist": 1})
+    with pytest.raises(errors.EnforceNotMet):  # typed catch works too
+        paddle.set_flags({"FLAGS_does_not_exist": 1})
+    assert errors.NotFoundError.code == "NOT_FOUND"
+    # KeyError.__str__ would repr-quote; typed errors keep plain text
+    assert str(errors.NotFoundError("Unknown flag 'x'")) == \
+        "Unknown flag 'x'"
+    with pytest.raises(errors.PreconditionNotMetError):
+        errors.enforce(False, "must hold")
+    errors.enforce(True)                      # no raise
+
+
+def test_log_writer(tmp_path):
+    from paddle_tpu.utils import LogWriter
+    with LogWriter(str(tmp_path)) as w:
+        w.add_scalar("loss", 0.5, 1)
+        w.add_scalar("loss", 0.25, 2)
+        monitor.STAT_ADD("STAT_lw_test", 7)
+        w.dump_stats(step=2)
+    recs = [json.loads(l) for l in
+            open(os.path.join(str(tmp_path), "scalars.jsonl"))]
+    losses = [r for r in recs if r["tag"] == "loss"]
+    assert [r["value"] for r in losses] == [0.5, 0.25]
+    assert any(r["tag"] == "stat/STAT_lw_test" for r in recs)
+
+
+# ---------------------------------------------------------------------------
+# sequence ops over LoDTensor
+# ---------------------------------------------------------------------------
+
+def _lod_input():
+    # two sequences: rows 0-2 and rows 3-4
+    data = np.arange(10, dtype=np.float32).reshape(5, 2)
+    return LoDTensor(data, lod=[[0, 3, 5]])
+
+
+def test_sequence_pad_unpad_roundtrip():
+    x = _lod_input()
+    padded, lens = sequence_pad(x, pad_value=-1.0)
+    assert padded.shape == [2, 3, 2]
+    np.testing.assert_array_equal(lens.numpy(), [3, 2])
+    assert float(padded.numpy()[1, 2, 0]) == -1.0
+    back = sequence_unpad(padded, lens)
+    np.testing.assert_array_equal(back.numpy(), x.numpy())
+    assert back.lod() == [[0, 3, 5]]
+
+
+def test_sequence_pool_modes():
+    x = _lod_input()
+    v = x.numpy()
+    np.testing.assert_allclose(sequence_pool(x, "sum").numpy(),
+                               [v[0:3].sum(0), v[3:5].sum(0)])
+    np.testing.assert_allclose(sequence_pool(x, "average").numpy(),
+                               [v[0:3].mean(0), v[3:5].mean(0)])
+    np.testing.assert_allclose(sequence_pool(x, "max").numpy(),
+                               [v[0:3].max(0), v[3:5].max(0)])
+    np.testing.assert_allclose(sequence_pool(x, "last").numpy(),
+                               [v[2], v[4]])
+    np.testing.assert_allclose(sequence_pool(x, "first").numpy(),
+                               [v[0], v[3]])
+
+
+def test_sequence_softmax_normalizes_per_sequence():
+    data = np.random.RandomState(0).randn(5, 1).astype(np.float32)
+    x = LoDTensor(data, lod=[[0, 3, 5]])
+    out = sequence_softmax(x).numpy().reshape(-1)
+    assert abs(out[:3].sum() - 1.0) < 1e-5
+    assert abs(out[3:].sum() - 1.0) < 1e-5
+
+
+def test_sequence_pool_empty_sequences_pad_zero():
+    """Repeated offsets (empty sequences) are legal LoD; reference pads
+    the pooled row with 0.0 instead of crashing."""
+    x = LoDTensor(np.arange(10, dtype=np.float32).reshape(5, 2),
+                  lod=[[0, 3, 3, 5]])
+    for mode in ("sum", "average", "sqrt", "max", "min", "last", "first"):
+        out = sequence_pool(x, mode).numpy()
+        assert out.shape == (3, 2)
+        np.testing.assert_array_equal(out[1], [0.0, 0.0])
+
+
+def test_sequence_softmax_rejects_wide_input():
+    x = LoDTensor(np.zeros((5, 2), np.float32), lod=[[0, 3, 5]])
+    with pytest.raises(ValueError, match="width 1"):
+        sequence_softmax(x)
+
+
+def test_sequence_expand_all_empty():
+    small = LoDTensor(np.array([[1.0], [2.0]], np.float32),
+                      lod=[[0, 1, 2]])
+    y = LoDTensor(np.zeros((0, 1), np.float32), lod=[[0, 0, 0]])
+    out = sequence_expand(small, y)
+    assert out.numpy().shape == (0, 1)
+    assert out.lod() == [[0, 0, 0]]
+
+
+def test_flash_stats_backed_by_monitor():
+    from paddle_tpu.ops.pallas_ops import STATS
+    base = STATS["flash_fwd"]
+    monitor.STAT_ADD("STAT_flash_attention_fwd", 2)
+    assert STATS["flash_fwd"] == base + 2
+
+
+def test_sequence_reverse_and_concat_and_expand():
+    x = _lod_input()
+    rev = sequence_reverse(x)
+    np.testing.assert_array_equal(rev.numpy()[0], x.numpy()[2])
+    np.testing.assert_array_equal(rev.numpy()[3], x.numpy()[4])
+
+    cat = sequence_concat([x, x])
+    assert cat.lod() == [[0, 6, 10]]
+    np.testing.assert_array_equal(cat.numpy()[0:3], x.numpy()[0:3])
+    np.testing.assert_array_equal(cat.numpy()[3:6], x.numpy()[0:3])
+
+    # expand one row per sequence to y's lod lengths
+    small = LoDTensor(np.array([[1.0], [2.0]], np.float32), lod=[[0, 1, 2]])
+    y = LoDTensor(np.zeros((5, 1), np.float32), lod=[[0, 3, 5]])
+    ex = sequence_expand(small, y)
+    np.testing.assert_array_equal(ex.numpy().reshape(-1),
+                                  [1, 1, 1, 2, 2])
