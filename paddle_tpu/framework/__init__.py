@@ -6,6 +6,7 @@ import jax as _jax
 _jax.config.update("jax_default_matmul_precision", "highest")
 
 from . import autograd, dtype, errors, flags, monitor, place, random
+from .selected_rows import SelectedRows
 from .autograd import (backward, enable_grad, grad, in_trace_mode,
                        is_grad_enabled, no_grad, trace_mode)
 from .dtype import (DType, convert_dtype, to_jax_dtype, bool_, uint8, int8,

@@ -1,0 +1,60 @@
+"""SelectedRows — sparse-row gradients (reference
+`paddle/fluid/framework/selected_rows.h`: rows_ + value_ + height_, the
+grad type produced by `lookup_table(..., is_sparse=True)` and consumed by
+the sparse SGD/Adam kernels and the PS push path).
+
+TPU stance: inside an XLA program a sparse grad is counterproductive —
+scatter-add into dense is what the hardware fuses — so SelectedRows lives
+at the HOST boundary: embedding-heavy models hand (rows, values) blocks
+to the optimizer's sparse path or to the PS/HostEmbedding push without
+ever materializing a vocab-sized dense gradient on the host.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+__all__ = ["SelectedRows", "rows_of_embedding_grad"]
+
+
+class SelectedRows:
+    """rows: int64 [n] ids; value: float [n, ...] rows; height: vocab."""
+
+    def __init__(self, rows, value, height: int):
+        self.rows = np.ascontiguousarray(np.asarray(rows, np.int64))
+        self.value = np.asarray(value)
+        if self.value.shape[0] != self.rows.shape[0]:
+            raise ValueError(
+                f"rows ({self.rows.shape[0]}) and value "
+                f"({self.value.shape[0]}) leading dims differ")
+        self.height = int(height)
+
+    def merge(self) -> "SelectedRows":
+        """Sum duplicate row ids (reference
+        `operators/math/selected_rows_functor.cc` MergeAdd)."""
+        uniq, inv = np.unique(self.rows, return_inverse=True)
+        out = np.zeros((uniq.size,) + self.value.shape[1:],
+                       self.value.dtype)
+        np.add.at(out, inv, self.value)
+        return SelectedRows(uniq, out, self.height)
+
+    def to_dense(self) -> np.ndarray:
+        """Materialize the [height, ...] dense tensor (reference
+        SelectedRows::Get / GetTensorFromSelectedRows op)."""
+        m = self.merge()
+        dense = np.zeros((self.height,) + m.value.shape[1:],
+                         m.value.dtype)
+        dense[m.rows] = m.value
+        return dense
+
+    def __repr__(self):
+        return (f"SelectedRows(n={self.rows.size}, height={self.height}, "
+                f"dim={self.value.shape[1:]})")
+
+
+def rows_of_embedding_grad(ids, dout, height: int) -> SelectedRows:
+    """Build the sparse grad of an embedding lookup: ids [any shape],
+    dout [ids.shape + (dim,)] — the per-lookup output cotangent. This is
+    what `lookup_table_grad(is_sparse=True)` emits in the reference."""
+    ids = np.asarray(ids, np.int64).reshape(-1)
+    d = np.asarray(dout)
+    return SelectedRows(ids, d.reshape(ids.size, -1), height).merge()

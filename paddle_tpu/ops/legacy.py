@@ -178,12 +178,22 @@ def scatter_nd(index, updates, shape, name=None):
 
 
 def get_tensor_from_selected_rows(x, name=None):
-    """SelectedRows (`framework/selected_rows.h`) was CUDA-side sparse-row
-    storage; here sparse grads are dense-with-zero-rows, so this is identity."""
+    """reference `operators/get_tensor_from_selected_rows_op.cc`:
+    materialize a SelectedRows into its dense tensor. Dense tensors pass
+    through (the in-jit path never produces SelectedRows — scatter-add
+    into dense is what XLA fuses)."""
+    from ..framework.selected_rows import SelectedRows
+    if isinstance(x, SelectedRows):
+        return Tensor(jnp.asarray(x.to_dense()))
     return x
 
 
 def merge_selected_rows(x, name=None):
+    """reference `operators/merge_selected_rows_op.cc`: sum duplicate
+    row ids."""
+    from ..framework.selected_rows import SelectedRows
+    if isinstance(x, SelectedRows):
+        return x.merge()
     return x
 
 

@@ -108,6 +108,58 @@ class Optimizer:
             self._accumulators[id(p)] = s
         self._global_step += 1
 
+    # optimizers whose _update takes whole-tensor norms (trust ratios)
+    # cannot be applied row-wise; they override this to False
+    _rowwise_safe = True
+
+    def apply_selected_rows(self, param, srows, advance_step=True):
+        """Sparse-row update over a SelectedRows gradient (reference
+        sparse kernels in `operators/optimizers/*_op.cc` consuming
+        `framework/selected_rows.h` grads): only the touched rows of the
+        parameter and of its accumulators are read or written — no
+        vocab-sized dense gradient is ever materialized.
+
+        When updating several sparse tables in one optimization step,
+        pass advance_step=False for all but the last call so Adam-family
+        bias correction sees one step per iteration, like step()."""
+        if not self._rowwise_safe:
+            raise NotImplementedError(
+                f"{type(self).__name__} computes whole-tensor trust-ratio "
+                f"norms; a row-subset update would change its scale — use "
+                f"a dense gradient")
+        m = srows.merge()
+        if m.height != param._value.shape[0]:
+            raise ValueError(
+                f"SelectedRows height {m.height} != param rows "
+                f"{param._value.shape[0]}")
+        rows = jnp.asarray(m.rows)
+        st = self._state_for(param)
+        prow = jnp.take(param._value, rows, axis=0)
+        sliced, passthrough = {}, {}
+        for k, v in st.items():
+            va = jnp.asarray(v)
+            if va.ndim >= 1 and va.shape[0] == param._value.shape[0]:
+                sliced[k] = jnp.take(va, rows, axis=0)
+            else:
+                passthrough[k] = va
+        g = jnp.asarray(m.value).reshape(prow.shape)
+        if self._grad_clip is not None:
+            g = self._grad_clip._tree_clip([g])[0]
+        new_prow, new_state = self._update(
+            g, prow, {**sliced, **passthrough},
+            jnp.asarray(self.get_lr(), "float32"),
+            jnp.asarray(self._global_step + 1, "int32"))
+        param._value = param._value.at[rows].set(
+            new_prow.astype(param._value.dtype))
+        for k in st:
+            if k in sliced:
+                st[k] = jnp.asarray(st[k]).at[rows].set(new_state[k])
+            else:
+                st[k] = new_state[k]
+        self._accumulators[id(param)] = st
+        if advance_step:
+            self._global_step += 1
+
     def minimize(self, loss, startup_program=None, parameters=None,
                  no_grad_set=None):
         from ..static import program as _static

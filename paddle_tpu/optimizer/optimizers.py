@@ -189,6 +189,7 @@ class RMSProp(Optimizer):
 
 
 class Lamb(Optimizer):
+    _rowwise_safe = False  # trust ratio needs whole-tensor norms
     """reference `operators/optimizers/lamb_op.h`."""
 
     def __init__(self, learning_rate=0.001, lamb_weight_decay=0.01,
@@ -221,6 +222,7 @@ class Lamb(Optimizer):
 
 
 class LarsMomentum(Optimizer):
+    _rowwise_safe = False  # local-lr needs whole-tensor norms
     """reference `operators/optimizers/lars_momentum_op.*`."""
 
     def __init__(self, learning_rate=0.001, momentum=0.9,

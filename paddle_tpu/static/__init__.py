@@ -7,6 +7,24 @@ from .program import (Executor, Program, Variable, append_backward, data,
                       in_static_mode, program_guard, scope_guard)
 from .serde import load_program, save_program
 
+
+import contextlib as _contextlib
+
+
+@_contextlib.contextmanager
+def device_guard(device=None):
+    """reference `fluid/framework.py device_guard`: pins ops to a device
+    in the reference's per-op executor. Under whole-program XLA the
+    compiler owns placement, so this records the hint as an op attr for
+    inspection and otherwise lets GSPMD decide."""
+    from .program import _state
+    prev = getattr(_state, "device_hint", None)
+    _state.device_hint = device
+    try:
+        yield
+    finally:
+        _state.device_hint = prev
+
 # static layer API (paddle.static.nn)
 from . import nn  # noqa: F401
 from .nn import cond, while_loop  # noqa: F401
@@ -28,6 +46,8 @@ def save_inference_model(path_prefix, feed_vars, fetch_vars, executor,
         [feed_vars]
     fetch_vars = fetch_vars if isinstance(fetch_vars, (list, tuple)) else \
         [fetch_vars]
+    # backward-slice to the serving subgraph (reference framework/prune.cc)
+    program = program.prune(fetch_vars)
     lowered = _Lowered(program, [v.slot for v in fetch_vars])
     scope = global_scope()
     params = [np.asarray(scope[n]) for n in lowered.param_names]

@@ -111,6 +111,32 @@ class Program:
     def all_parameters(self):
         return list(self.param_vars.values())
 
+    def prune(self, targets):
+        """Backward-slice the op list to what the target Variables need
+        (reference `framework/prune.cc` Prune + `Program._prune_with_input`
+        used by save_inference_model). Returns a NEW Program sharing
+        Variables but holding only the live ops."""
+        targets = targets if isinstance(targets, (list, tuple)) else \
+            [targets]
+        live = {t.slot for t in targets}
+        keep = []
+        for op in reversed(self.ops):
+            if any(s in live for s in op.out_slots):
+                keep.append(op)
+                for tag, ref in op.in_refs:
+                    if tag == "s":
+                        live.add(ref)
+        keep.reverse()
+        out = Program()
+        out.ops = keep
+        out.vars = dict(self.vars)
+        out.feed_vars = {n: v for n, v in self.feed_vars.items()
+                         if v.slot in live}
+        out.param_vars = {n: v for n, v in self.param_vars.items()
+                          if v.slot in live}
+        out._opt_hooks = list(self._opt_hooks)
+        return out
+
     # -- serialization (reference ProgramDesc.SerializeToString) ----------
     def to_doc(self, scope=None, include_params=True):
         from .serde import program_to_doc
@@ -217,6 +243,10 @@ def make_parameter(name, value):
 
 
 def record_op(name, fn, inputs, outputs, attrs=None):
+    hint = getattr(_state, "device_hint", None)
+    if hint is not None:
+        attrs = dict(attrs or {})
+        attrs["op_device"] = hint   # reference device_guard attr name
     _state.main.record(name, fn, inputs, outputs, attrs)
 
 

@@ -1,0 +1,107 @@
+"""Native C inference API (reference `paddle/fluid/inference/capi/`):
+a real C program links libpd_infer_capi.so, loads a jit-saved artifact,
+runs float32 inference, and its output must match the in-process
+predictor."""
+import os
+import subprocess
+import textwrap
+
+import numpy as np
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+CSRC = os.path.join(REPO, "csrc")
+LIB = os.path.join(CSRC, "libpd_infer_capi.so")
+
+C_DRIVER = r"""
+#include <stdint.h>
+#include <stdio.h>
+#include <stdlib.h>
+#include <string.h>
+
+typedef struct PD_Predictor PD_Predictor;
+PD_Predictor* PD_NewPredictor(const char* model_prefix);
+int PD_PredictorRun(PD_Predictor*, const float*, const int64_t*, int,
+                    float**, int64_t*, int*);
+void PD_DeletePredictor(PD_Predictor*);
+void PD_FreeBuffer(void*);
+const char* PD_GetLastError(void);
+
+int main(int argc, char** argv) {
+  /* argv: model_prefix in_file rows cols out_file */
+  const char* prefix = argv[1];
+  int64_t shape[2] = {atoll(argv[3]), atoll(argv[4])};
+  int64_t n = shape[0] * shape[1];
+  float* in = (float*)malloc(n * sizeof(float));
+  FILE* f = fopen(argv[2], "rb");
+  if (fread(in, sizeof(float), n, f) != (size_t)n) return 10;
+  fclose(f);
+
+  PD_Predictor* p = PD_NewPredictor(prefix);
+  if (!p) { fprintf(stderr, "new: %s\n", PD_GetLastError()); return 11; }
+  float* out = NULL;
+  int64_t oshape[8];
+  int ondim = 0;
+  int rc = PD_PredictorRun(p, in, shape, 2, &out, oshape, &ondim);
+  if (rc != 0) {
+    fprintf(stderr, "run: %s\n", PD_GetLastError());
+    return 12;
+  }
+  int64_t total = 1;
+  for (int i = 0; i < ondim; ++i) total *= oshape[i];
+  f = fopen(argv[5], "wb");
+  fwrite(&ondim, sizeof(int), 1, f);
+  fwrite(oshape, sizeof(int64_t), ondim, f);
+  fwrite(out, sizeof(float), total, f);
+  fclose(f);
+  PD_FreeBuffer(out);
+  PD_DeletePredictor(p);
+  printf("CAPI_OK\n");
+  return 0;
+}
+"""
+
+
+def _build_lib():
+    r = subprocess.run(["make", "libpd_infer_capi.so"], cwd=CSRC,
+                       capture_output=True, text=True)
+    return r.returncode == 0 and os.path.exists(LIB)
+
+
+@pytest.mark.skipif(not _build_lib(), reason="C API lib build failed")
+def test_c_program_runs_saved_model(tmp_path):
+    import paddle_tpu as paddle
+    import paddle_tpu.nn as nn
+    from paddle_tpu.static.input_spec import InputSpec
+
+    paddle.seed(4)
+    net = nn.Sequential(nn.Linear(8, 16), nn.Tanh(), nn.Linear(16, 3))
+    prefix = str(tmp_path / "model")
+    paddle.jit.save(net, prefix, input_spec=[InputSpec([2, 8], "float32")])
+
+    x = np.random.RandomState(5).standard_normal((2, 8)).astype("float32")
+    ref = net(paddle.to_tensor(x)).numpy()
+
+    cfile = tmp_path / "driver.c"
+    cfile.write_text(textwrap.dedent(C_DRIVER))
+    exe = str(tmp_path / "driver")
+    r = subprocess.run(
+        ["gcc", str(cfile), "-o", exe, f"-L{CSRC}", "-lpd_infer_capi",
+         f"-Wl,-rpath,{CSRC}"], capture_output=True, text=True)
+    assert r.returncode == 0, r.stderr
+
+    in_file = str(tmp_path / "in.bin")
+    x.tofile(in_file)
+    out_file = str(tmp_path / "out.bin")
+    env = dict(os.environ, PYTHONPATH=REPO, JAX_PLATFORMS="cpu")
+    r = subprocess.run([exe, prefix, in_file, "2", "8", out_file],
+                       env=env, capture_output=True, text=True,
+                       timeout=300)
+    assert r.returncode == 0, (r.stdout, r.stderr[-1500:])
+    assert "CAPI_OK" in r.stdout
+
+    with open(out_file, "rb") as f:
+        ondim = np.fromfile(f, dtype=np.int32, count=1)[0]
+        oshape = np.fromfile(f, dtype=np.int64, count=ondim)
+        out = np.fromfile(f, dtype=np.float32).reshape(oshape)
+    np.testing.assert_allclose(out, ref, rtol=1e-5, atol=1e-6)

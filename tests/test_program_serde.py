@@ -134,6 +134,38 @@ def test_loaded_program_slots_do_not_collide(static_mode, tmp_path):
     assert repr(prog2)  # inspection surface must not raise
 
 
+def test_prune_backward_slice(static_mode):
+    """Program.prune keeps only ops the fetch targets need (reference
+    framework/prune.cc)."""
+    main = static_mode
+    x, out = _build_mlp(main)
+    # a dead branch: computed but never fetched
+    dead = paddle.nn.functional.relu(paddle.matmul(
+        x, paddle.create_parameter([8, 8], "float32", name="wdead")))
+    n_all = len(main.ops)
+    pruned = main.prune([out])
+    assert len(pruned.ops) < n_all
+    assert "wdead" not in pruned.param_vars
+    assert "x" in pruned.feed_vars
+    feed_x = np.random.RandomState(3).standard_normal((4, 8)).astype(
+        np.float32)
+    ref = static.Executor().run(main, feed={"x": feed_x},
+                                fetch_list=[out])[0]
+    got = static.Executor().run(pruned, feed={"x": feed_x},
+                                fetch_list=[out])[0]
+    np.testing.assert_allclose(got, ref, rtol=1e-6)
+
+
+def test_device_guard_records_attr(static_mode):
+    main = static_mode
+    x = static.data("x", [2, 2], "float32")
+    with static.device_guard("cpu"):
+        y = paddle.nn.functional.relu(x)
+    assert main.ops[-1].attrs.get("op_device") == "cpu"
+    z = paddle.nn.functional.relu(y)
+    assert "op_device" not in (main.ops[-1].attrs or {})
+
+
 def test_roundtrip_new_process(static_mode, tmp_path):
     """save → fresh interpreter → load → identical outputs (the reference
     inference-deployment contract, `fluid/io.py:1199`)."""

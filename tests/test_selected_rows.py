@@ -1,0 +1,91 @@
+"""SelectedRows sparse-row gradients (reference
+`framework/selected_rows.h` + the sparse optimizer kernels in
+`operators/optimizers/` + MergeAdd in
+`operators/math/selected_rows_functor.cc`)."""
+import numpy as np
+
+import paddle_tpu as paddle
+from paddle_tpu.framework.selected_rows import (SelectedRows,
+                                                rows_of_embedding_grad)
+from paddle_tpu.ops.legacy import (get_tensor_from_selected_rows,
+                                   merge_selected_rows)
+
+
+def test_merge_sums_duplicates():
+    s = SelectedRows([3, 1, 3], np.array([[1., 2.], [3., 4.], [5., 6.]],
+                                         np.float32), height=5)
+    m = s.merge()
+    np.testing.assert_array_equal(m.rows, [1, 3])
+    np.testing.assert_allclose(m.value, [[3., 4.], [6., 8.]])
+    dense = m.to_dense()
+    assert dense.shape == (5, 2)
+    np.testing.assert_allclose(dense[3], [6., 8.])
+    np.testing.assert_allclose(dense[0], [0., 0.])
+
+
+def test_legacy_ops_accept_selected_rows():
+    s = SelectedRows([0, 0], np.ones((2, 3), np.float32), height=4)
+    m = merge_selected_rows(s)
+    assert isinstance(m, SelectedRows) and m.rows.size == 1
+    t = get_tensor_from_selected_rows(s)
+    np.testing.assert_allclose(np.asarray(t.numpy())[0], [2., 2., 2.])
+
+
+def test_embedding_grad_builder():
+    ids = np.array([[1, 2], [2, 1]], np.int64)
+    dout = np.ones((2, 2, 4), np.float32)
+    s = rows_of_embedding_grad(ids, dout, height=10)
+    np.testing.assert_array_equal(s.rows, [1, 2])
+    np.testing.assert_allclose(s.value, np.full((2, 4), 2.0))
+
+
+def _sparse_vs_dense(opt_cls, **kw):
+    """Sparse row update must equal the dense update on touched rows and
+    leave untouched rows (params AND accumulators) alone."""
+    V, D = 6, 3
+    w0 = np.random.RandomState(0).standard_normal((V, D)).astype("float32")
+    g_rows = np.array([1, 4], np.int64)
+    g_vals = np.random.RandomState(1).standard_normal((2, D)).astype(
+        "float32")
+
+    p_sparse = paddle.create_parameter([V, D], "float32")
+    p_sparse.set_value(w0.copy())
+    opt_s = opt_cls(0.1, parameters=[p_sparse], **kw)
+    opt_s.apply_selected_rows(
+        p_sparse, SelectedRows(g_rows, g_vals, height=V))
+
+    p_dense = paddle.create_parameter([V, D], "float32")
+    p_dense.set_value(w0.copy())
+    opt_d = opt_cls(0.1, parameters=[p_dense], **kw)
+    dense_g = np.zeros((V, D), np.float32)
+    dense_g[g_rows] = g_vals
+    from paddle_tpu.framework.tensor import Tensor
+    p_dense._grad = Tensor(dense_g)._value
+    opt_d.step()
+
+    sp, dn = p_sparse.numpy(), p_dense.numpy()
+    np.testing.assert_allclose(sp[g_rows], dn[g_rows], rtol=1e-5,
+                               atol=1e-6)
+    np.testing.assert_array_equal(sp[[0, 2, 3, 5]], w0[[0, 2, 3, 5]])
+
+
+def test_sparse_sgd_matches_dense():
+    _sparse_vs_dense(paddle.optimizer.SGD)
+
+
+def test_sparse_momentum_matches_dense_on_touched_rows():
+    _sparse_vs_dense(paddle.optimizer.Momentum)
+
+
+def test_sparse_adam_updates_only_touched_state():
+    V, D = 5, 2
+    p = paddle.create_parameter([V, D], "float32")
+    p.set_value(np.ones((V, D), np.float32))
+    opt = paddle.optimizer.Adam(0.01, parameters=[p])
+    opt.apply_selected_rows(
+        p, SelectedRows([2], np.ones((1, D), np.float32), height=V))
+    st = opt._accumulators[id(p)]
+    m = np.asarray(st["m"]) if "m" in st else None
+    if m is not None:
+        assert np.any(m[2] != 0)
+        np.testing.assert_array_equal(m[0], np.zeros(D))
