@@ -1,5 +1,6 @@
 """paddle.distributed (reference `python/paddle/distributed/`)."""
-from . import collective, fleet, sharding
+from . import collective, fleet, sharding, transpiler
+from .transpiler import DistributeTranspiler, DistributeTranspilerConfig
 from .sharding import group_sharded_parallel, save_group_sharded_model
 from .collective import (ReduceOp, all_gather, all_reduce, alltoall, barrier,
                          broadcast, get_group, new_group, recv, reduce,

@@ -1,0 +1,187 @@
+"""DistributeTranspiler — the legacy fluid PS program split (reference
+`python/paddle/fluid/transpiler/distribute_transpiler.py:156`: rewrite a
+single train Program into trainer programs that SEND gradients and
+pserver programs that RECV + apply them).
+
+TPU redesign: instead of splicing send/recv ops into a ProgramDesc, the
+split is explicit over the op-list IR — `transpile` partitions parameters
+round-robin across pserver endpoints as dense tables (the same TCP
+service + native C++ table core the modern PS path uses), and the
+trainer side wraps the lowered program: pull params → jax.grad on device
+→ push grads; the optimizer rule runs table-side, exactly the reference's
+sync-SGD dataflow."""
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+import numpy as np
+
+__all__ = ["DistributeTranspiler", "DistributeTranspilerConfig"]
+
+
+class DistributeTranspilerConfig:
+    """reference transpiler config (slice_var_up etc. — partitioning
+    knobs). Only round-robin whole-param placement is implemented."""
+
+    def __init__(self):
+        self.slice_var_up = False
+        self.split_method = "RoundRobin"
+        self.min_block_size = 8192
+
+
+class DistributeTranspiler:
+    def __init__(self, config: Optional[DistributeTranspilerConfig] = None):
+        self.config = config or DistributeTranspilerConfig()
+        self._placement: Dict[str, tuple] = {}   # name → (endpoint, tid)
+        self._program = None
+        self._trainers = 1
+        self._pservers: List[str] = []
+
+    def transpile(self, trainer_id, program=None, pservers="",
+                  trainers=1, sync_mode=True, startup_program=None):
+        from ..static.program import default_main_program
+        self._program = program or default_main_program()
+        self._trainer_id = trainer_id
+        self._trainers = trainers
+        self._sync = sync_mode
+        self._pservers = [e for e in pservers.split(",") if e]
+        if not self._pservers:
+            raise ValueError("transpile needs at least one pserver "
+                             "endpoint")
+        names = sorted(self._program.param_vars)
+        for i, n in enumerate(names):
+            ep = self._pservers[i % len(self._pservers)]
+            self._placement[n] = (ep, i)
+        return self
+
+    # -- pserver side -------------------------------------------------------
+    def get_pserver_program(self, endpoint):
+        """Table configs this endpoint must host (the reference returns a
+        recv+apply ProgramDesc; the rule-applying table IS that program
+        here)."""
+        from .ps.service import TableConfig
+        opt = self._program._opt_hooks[-1] if self._program._opt_hooks \
+            else None
+        name = type(opt).__name__.lower() if opt else "sgd"
+        supported = {"sgd": "sgd", "adam": "adam", "adamw": "adam"}
+        if name not in supported:
+            raise ValueError(
+                f"pserver tables implement sgd/adam rules only; got "
+                f"{type(opt).__name__} — use SGD/Adam(W) for the "
+                f"transpiled PS mode (reference legacy PS had the same "
+                f"per-rule server kernels)")
+        rule = supported[name]
+        if name == "adamw" and getattr(opt, "_weight_decay", 0.0):
+            import warnings
+            warnings.warn("AdamW weight decay is not applied by the "
+                          "pserver adam rule; decoupled decay is dropped "
+                          "in transpiled PS mode")
+        from ..optimizer.lr import LRScheduler
+        if opt is not None and isinstance(opt._lr, LRScheduler):
+            import warnings
+            warnings.warn("pserver tables apply a FIXED lr; the "
+                          "LRScheduler will not take effect server-side")
+        lr = opt.get_lr() if opt else 0.01
+        cfgs = []
+        for name, (ep, tid) in sorted(self._placement.items()):
+            if ep != endpoint:
+                continue
+            v = self._program.param_vars[name]
+            cfgs.append(TableConfig(tid, "dense",
+                                    size=int(np.prod(v._value.shape)),
+                                    rule=rule, lr=lr, name=name))
+        return cfgs
+
+    get_pserver_programs = get_pserver_program
+
+    def get_startup_program(self, endpoint=None, pserver_program=None):
+        """Initial values each table must be seeded with (reference:
+        the pserver startup program holding param init ops)."""
+        from ..static.program import global_scope
+        scope = global_scope()
+        out = {}
+        for name, (ep, tid) in self._placement.items():
+            if endpoint is None or ep == endpoint:
+                v = self._program.param_vars[name]
+                init = scope.get(name, np.asarray(v._value))
+                out[tid] = np.asarray(init, np.float32).reshape(-1)
+        return out
+
+    # -- trainer side -------------------------------------------------------
+    def get_trainer_program(self, wait_port=True):
+        """A runnable trainer: pull → device grad → push (the reference
+        splices send/recv ops; here the wrapper is the program)."""
+        return _TrainerProgram(self)
+
+
+class _TrainerProgram:
+    """Drives one trainer against the PS cluster. Callable like an
+    Executor step: run(feed) → loss value."""
+
+    def __init__(self, t: DistributeTranspiler):
+        from .ps.service import PsClient
+        self.t = t
+        self.client = PsClient(t._pservers)
+        self.program = t._program
+        self._jit = None
+        self._jit_key = None
+
+    def _ensure_jit(self, fetch_slots):
+        key = tuple(fetch_slots)
+        if self._jit is not None and self._jit_key == key:
+            return
+        import jax
+
+        from ..static.program import _Lowered
+        program = self.program
+        loss_slot = program._loss_slot
+        self._lowered = _Lowered(program, [loss_slot] + list(fetch_slots))
+
+        def loss_and_grads(feeds, pvals):
+            def f(pv):
+                return _Lowered(program, [loss_slot])(feeds, pv)[0]
+            lv, g = jax.value_and_grad(f)(pvals)
+            outs = _Lowered(program, list(fetch_slots))(feeds, pvals) \
+                if fetch_slots else []
+            return lv, g, outs
+        self._jit = jax.jit(loss_and_grads)
+        self._jit_key = key
+
+    def run(self, feed=None, fetch_list=None):
+        import jax.numpy as jnp
+
+        fetch_slots = [v.slot for v in (fetch_list or [])]
+        self._ensure_jit(fetch_slots)
+        lowered, t = self._lowered, self.t
+        feeds = []
+        for n in lowered.feed_names:
+            a = feed[n] if feed and n in feed else \
+                self.program.feed_vars[n]._value
+            feeds.append(jnp.asarray(np.asarray(
+                a.numpy() if hasattr(a, "numpy") else a)))
+        # pull current params from their tables
+        pvals = []
+        srv_of = {ep: i for i, ep in enumerate(t._pservers)}
+        for n in lowered.param_names:
+            ep, tid = t._placement[n]
+            flat = self.client.pull_dense(tid, server=srv_of[ep])
+            shape = self.program.param_vars[n]._value.shape
+            pvals.append(jnp.asarray(flat.reshape(shape)))
+        lv, grads, fetched = self._jit(feeds, pvals)
+        # push grads scaled by 1/trainers (reference sync-SGD averages
+        # across trainers); the table applies the rule per push
+        scale = 1.0 / max(t._trainers, 1)
+        for n, g in zip(lowered.param_names, grads):
+            ep, tid = t._placement[n]
+            self.client.push_dense(tid, (np.asarray(g, np.float32)
+                                         * scale).reshape(-1),
+                                   server=srv_of[ep])
+        if t._sync:
+            self.client.barrier()
+        if fetch_list:
+            return [float(np.asarray(lv))] + \
+                [np.asarray(f) for f in fetched]
+        return float(np.asarray(lv))
+
+    def close(self):
+        self.client.close()

@@ -5,6 +5,7 @@ from .program import (Executor, Program, Variable, append_backward, data,
                       default_main_program, default_startup_program,
                       disable_static, enable_static, global_scope,
                       in_static_mode, program_guard, scope_guard)
+from .passes import PassManager, get_pass, register_pass
 from .serde import load_program, save_program
 
 

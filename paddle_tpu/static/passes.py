@@ -1,0 +1,99 @@
+"""Program-level IR passes (reference `paddle/fluid/framework/ir/`
+pass registry + `inference/analysis/` pass pipeline).
+
+TPU stance: the heavy rewrites the reference implements as IR passes —
+op fusion, layout, memory planning — are XLA's job after lowering, so
+they are deliberately absent. What remains meaningful at THIS level is
+graph hygiene on the op list before lowering/serialization:
+constant folding (fewer ops to export in .ptprog) and dead-code
+elimination (Program.prune). The PassManager mirrors the reference's
+apply-in-sequence contract so tooling can be ported."""
+from __future__ import annotations
+
+from typing import Callable, Dict, List
+
+import numpy as np
+
+__all__ = ["PassManager", "constant_folding_pass",
+           "dead_code_elimination_pass", "register_pass", "get_pass"]
+
+_PASSES: Dict[str, Callable] = {}
+
+
+def register_pass(name: str):
+    def deco(fn):
+        _PASSES[name] = fn
+        return fn
+    return deco
+
+
+def get_pass(name: str) -> Callable:
+    if name not in _PASSES:
+        from ..framework.errors import NotFoundError
+        raise NotFoundError(f"unknown pass {name!r}; have "
+                            f"{sorted(_PASSES)}")
+    return _PASSES[name]
+
+
+@register_pass("constant_folding_pass")
+def constant_folding_pass(program, targets=None):
+    """Evaluate ops whose inputs are all constants and splice the results
+    in as constants (reference
+    `framework/ir/constant_folding_pass.cc`). Runs eagerly on host — only
+    touches ops that depend on no feed/param."""
+    folded_vals = {}
+    new_ops = []
+    for op in program.ops:
+        ins = []
+        all_const = True
+        for tag, ref in op.in_refs:
+            if tag == "c":
+                ins.append(ref)
+            elif ref in folded_vals:
+                ins.append(folded_vals[ref])
+            else:
+                all_const = False
+                break
+        if not all_const:
+            # rewrite any input slots that earlier folding produced
+            refs = [("c", folded_vals[r]) if t == "s" and r in folded_vals
+                    else (t, r) for t, r in op.in_refs]
+            op.in_refs = refs
+            new_ops.append(op)
+            continue
+        outs = op.fn(*ins)
+        outs = outs if isinstance(outs, (tuple, list)) else (outs,)
+        for s, v in zip(op.out_slots, outs):
+            folded_vals[s] = v
+            if s in program.vars:      # keep the fetch fallback in sync
+                program.vars[s]._value = v
+    program.ops = new_ops
+    return program
+
+
+@register_pass("dead_code_elimination_pass")
+def dead_code_elimination_pass(program, targets=None):
+    """Backward-slice to the target vars (Program.prune; reference
+    `framework/prune.cc`). Without targets this is the identity — an op
+    list has no other notion of liveness. Mutates `program` IN PLACE like
+    every pass (callers commonly ignore apply()'s return value)."""
+    if not targets:
+        return program
+    pruned = program.prune(targets)
+    program.ops = pruned.ops
+    program.feed_vars = pruned.feed_vars
+    program.param_vars = pruned.param_vars
+    return program
+
+
+class PassManager:
+    """Apply named passes in sequence (reference ir::PassRegistry +
+    analysis Argument pipeline)."""
+
+    def __init__(self, passes: List[str] = None):
+        self.passes = list(passes or [])
+
+    def apply(self, program, targets=None):
+        for name in self.passes:
+            program = get_pass(name)(program, targets=targets) or program
+        return program

@@ -166,6 +166,33 @@ def test_device_guard_records_attr(static_mode):
     assert "op_device" not in (main.ops[-1].attrs or {})
 
 
+def test_constant_folding_pass(static_mode):
+    """Const-only subgraphs fold away (reference
+    framework/ir/constant_folding_pass.cc)."""
+    main = static_mode
+    x = static.data("x", [2, 3], "float32")
+    c = paddle.to_tensor(np.full((2, 3), 2.0, np.float32))
+    folded = paddle.exp(c) + paddle.to_tensor(
+        np.ones((2, 3), np.float32))               # pure-const subtree
+    out = x * folded
+    n_before = len(main.ops)
+    pm = static.PassManager(["constant_folding_pass"])
+    pm.apply(main)
+    assert len(main.ops) < n_before
+    feed_x = np.random.RandomState(4).standard_normal((2, 3)).astype(
+        np.float32)
+    got = static.Executor().run(main, feed={"x": feed_x},
+                                fetch_list=[out])[0]
+    np.testing.assert_allclose(got, feed_x * (np.exp(2.0) + 1.0),
+                               rtol=1e-5)
+
+
+def test_pass_registry_unknown_raises():
+    from paddle_tpu.framework.errors import NotFoundError
+    with pytest.raises(NotFoundError):
+        static.get_pass("nope_pass")
+
+
 def test_roundtrip_new_process(static_mode, tmp_path):
     """save → fresh interpreter → load → identical outputs (the reference
     inference-deployment contract, `fluid/io.py:1199`)."""

@@ -1,0 +1,93 @@
+"""Legacy PS program split (reference
+`python/paddle/fluid/transpiler/distribute_transpiler.py:156`): a static
+train Program transpiles into trainer pull→grad→push wrappers and
+pserver table configs; the distributed trajectory must equal local SGD."""
+import socket
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.static as static
+from paddle_tpu.distributed import DistributeTranspiler
+from paddle_tpu.distributed.ps import PsServer, native_available
+
+pytestmark = pytest.mark.skipif(not native_available(),
+                                reason="native ps_core not built")
+
+
+def _build_train_program():
+    main, startup = static.Program(), static.Program()
+    with static.program_guard(main, startup):
+        x = static.data("x", [8, 4], "float32")
+        y = static.data("y", [8, 1], "float32")
+        w = paddle.create_parameter([4, 1], "float32", name="w")
+        b = paddle.create_parameter([1], "float32", name="b")
+        pred = paddle.matmul(x, w) + b
+        loss = paddle.mean((pred - y) * (pred - y))
+        opt = paddle.optimizer.SGD(0.1)
+        opt.minimize(loss)
+    return main, loss
+
+
+def test_transpile_split_and_loss_parity(tmp_path):
+    rs = np.random.RandomState(0)
+    feed_x = rs.standard_normal((8, 4)).astype("float32")
+    feed_y = rs.standard_normal((8, 1)).astype("float32")
+
+    # ---- local baseline ---------------------------------------------------
+    static.enable_static()
+    try:
+        with static.scope_guard({}):
+            paddle.seed(42)
+            main, loss = _build_train_program()
+            exe = static.Executor()
+            local_losses = [
+                exe.run(main, feed={"x": feed_x, "y": feed_y},
+                        fetch_list=[loss])[0] for _ in range(4)]
+
+        # ---- transpiled cluster (2 pservers, 1 trainer) -------------------
+        with static.scope_guard({}):
+            paddle.seed(42)
+            main2, loss2 = _build_train_program()
+            socks = []
+            for _ in range(2):            # two distinct free ports
+                s = socket.socket()
+                s.bind(("127.0.0.1", 0))
+                socks.append(s)
+            eps_str = ",".join(f"127.0.0.1:{s.getsockname()[1]}"
+                               for s in socks)
+            for s in socks:
+                s.close()
+            t = DistributeTranspiler()
+            t.transpile(0, program=main2, pservers=eps_str, trainers=1)
+            # placement split across endpoints
+            eps = {ep for ep, _ in t._placement.values()}
+            assert len(eps) == 2
+
+            servers = []
+            for ep in t._pservers:
+                cfgs = t.get_pserver_program(ep)
+                assert cfgs, f"no tables for {ep}"
+                servers.append(PsServer(ep, cfgs, n_workers=1).start())
+
+            trainer = t.get_trainer_program()
+            real_eps = t._pservers
+            # seed tables with the initial param values
+            srv_of = {ep: i for i, ep in enumerate(real_eps)}
+            for n, (ep, tid) in t._placement.items():
+                init = t.get_startup_program(ep)[tid]
+                trainer.client.set_dense(tid, init, server=srv_of[ep])
+
+            dist_losses = [trainer.run({"x": feed_x, "y": feed_y})
+                           for _ in range(4)]
+            trainer.close()
+            for s in servers:
+                s.stop()
+    finally:
+        static.disable_static()
+
+    np.testing.assert_allclose(
+        dist_losses, [float(np.asarray(l)) for l in local_losses],
+        rtol=2e-4, atol=2e-5)
+    assert dist_losses[-1] < dist_losses[0]
