@@ -73,6 +73,12 @@ def init_parallel_env(strategy=None) -> ParallelEnv:
     if coord and nproc > 1:
         jax.distributed.initialize(coordinator_address=coord,
                                    num_processes=nproc, process_id=pid)
+    # elastic mode: start this rank's heartbeat against the master's KV
+    # server (reference ElasticManager; see fleet/elastic.py)
+    kv_ep = os.environ.get("PADDLE_ELASTIC_KV")
+    if kv_ep:
+        from .fleet.elastic import HeartbeatClient
+        HeartbeatClient(kv_ep, rank=pid).start()
     from ..parallel.mesh import create_mesh, get_mesh
     if get_mesh() is None:
         create_mesh({"dp": len(jax.devices())})

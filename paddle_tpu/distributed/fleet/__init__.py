@@ -1,5 +1,6 @@
-from . import dataset, metrics
+from . import dataset, elastic, metrics
 from .dataset import InMemoryDataset, MultiSlotDataGenerator, QueueDataset
+from .elastic import ElasticManager, ElasticStatus, HeartbeatClient
 from .fleet_base import Fleet, fleet
 from .http_server import KVClient, KVServer
 from .role_maker import PaddleCloudRoleMaker, Role, RoleMakerBase, UserDefinedRoleMaker

@@ -18,8 +18,10 @@ class _Handler(BaseHTTPRequestHandler):
         pass
 
     def do_GET(self):
-        with _Handler.lock:
-            val = _Handler.store.get(self.path)
+        # self.store resolves through the per-server subclass (KVServer
+        # builds one per instance) — never name _Handler here
+        with self.lock:
+            val = self.store.get(self.path)
         if val is None:
             self.send_response(404)
             self.end_headers()
@@ -31,23 +33,27 @@ class _Handler(BaseHTTPRequestHandler):
     def do_PUT(self):
         length = int(self.headers.get("Content-Length", 0))
         data = self.rfile.read(length)
-        with _Handler.lock:
-            _Handler.store[self.path] = data
+        with self.lock:
+            self.store[self.path] = data
         self.send_response(200)
         self.end_headers()
 
     do_POST = do_PUT
 
     def do_DELETE(self):
-        with _Handler.lock:
-            _Handler.store.pop(self.path, None)
+        with self.lock:
+            self.store.pop(self.path, None)
         self.send_response(200)
         self.end_headers()
 
 
 class KVServer:
     def __init__(self, port=0, size=None):
-        self._httpd = ThreadingHTTPServer(("0.0.0.0", port), _Handler)
+        # per-instance store: two KV servers in one process (tests, PS +
+        # elastic side by side) must not share keys
+        handler = type("_KVHandler", (_Handler,),
+                       {"store": {}, "lock": threading.Lock()})
+        self._httpd = ThreadingHTTPServer(("0.0.0.0", port), handler)
         self.port = self._httpd.server_address[1]
         self._thread = None
 

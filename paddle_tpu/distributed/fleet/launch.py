@@ -28,6 +28,15 @@ def _parse():
     p.add_argument("--started_port", type=int, default=6170)
     p.add_argument("--log_dir", type=str, default="log")
     p.add_argument("--run_mode", type=str, default="collective")
+    p.add_argument("--elastic", action="store_true",
+                   help="start a KV heartbeat monitor: ranks that die, "
+                        "fail init, or stop beating fault the job (an "
+                        "in-process deadlock needs the manual touch() "
+                        "mode — see fleet/elastic.py)")
+    p.add_argument("--elastic_timeout", type=float, default=30.0)
+    p.add_argument("--elastic_grace", type=float, default=120.0,
+                   help="seconds a rank may take to its FIRST beat "
+                        "(jax/backend init is slow)")
     p.add_argument("--servers", type=str, default="")
     p.add_argument("--workers", type=str, default="")
     p.add_argument("training_script", type=str)
@@ -42,6 +51,11 @@ def _spawn_procs(args):
     endpoints = [f"{ip}:{args.started_port + i}"
                  for ip in ips for i in range(nproc)]
     os.makedirs(args.log_dir, exist_ok=True)
+    kv_ep = None
+    if getattr(args, "elastic", False):
+        from .http_server import KVServer
+        kv = KVServer().start()
+        kv_ep = f"127.0.0.1:{kv.port}"
     procs = []
     # this launcher instance only starts local ranks (reference behavior)
     local_base = ips.index("127.0.0.1") * nproc if "127.0.0.1" in ips else 0
@@ -59,13 +73,16 @@ def _spawn_procs(args):
             "JAX_PROCESS_ID": str(rank),
             "TRAINING_ROLE": "TRAINER",
         })
+        if kv_ep:
+            env["PADDLE_ELASTIC_KV"] = kv_ep
         logf = open(os.path.join(args.log_dir, f"workerlog.{rank}"), "w")
         cmd = [sys.executable, "-u", args.training_script] + \
             args.training_script_args
         procs.append((subprocess.Popen(cmd, env=env, stdout=logf,
                                        stderr=subprocess.STDOUT), logf,
                       rank))
-    return procs
+    local_ranks = [r for _, _, r in procs]
+    return procs, kv_ep, local_ranks
 
 
 def _watch(procs):
@@ -96,7 +113,23 @@ def _watch(procs):
 
 def launch():
     args = _parse()
-    procs = _spawn_procs(args)
+    procs, kv_ep, local_ranks = _spawn_procs(args)
+    if kv_ep:
+        # liveness on top of the exit watchdog: a local rank that dies,
+        # fails init, or stops beating faults the whole job. Only LOCAL
+        # ranks are watched — the KV is loopback; each node's launcher
+        # watches its own ranks (reference watch_local_trainers scope).
+        from .elastic import ElasticManager
+
+        def on_fault(dead):
+            print(f"[fleet.launch] rank(s) {dead} stopped heartbeating; "
+                  f"terminating job", file=sys.stderr)
+            for p, _, _ in procs:
+                if p.poll() is None:
+                    p.send_signal(signal.SIGTERM)
+        ElasticManager(kv_ep, ranks=local_ranks,
+                       timeout=args.elastic_timeout,
+                       grace=args.elastic_grace).watch(on_fault=on_fault)
     _watch(procs)
 
 

@@ -1,0 +1,113 @@
+"""Elastic failure detection (reference
+`distributed/fleet/elastic/manager.py` heartbeats +
+`launch_utils.py:526` watch_local_trainers): ranks heartbeat through the
+fleet KV server; the master detects a silent rank and fires the fault
+hook that launchers use to restart from auto-checkpoint."""
+import time
+
+from paddle_tpu.distributed.fleet import (ElasticManager, ElasticStatus,
+                                          HeartbeatClient, KVServer)
+
+
+def test_heartbeat_liveness_and_fault_detection():
+    kv = KVServer().start()
+    ep = f"127.0.0.1:{kv.port}"
+    try:
+        w0 = HeartbeatClient(ep, rank=0, interval=0.2).start()
+        w1 = HeartbeatClient(ep, rank=1, interval=0.2).start()
+        mgr = ElasticManager(ep, world_size=2, timeout=1.5)
+        time.sleep(0.5)
+        assert mgr.scan() == ElasticStatus.OK
+        assert mgr.dead_ranks == []
+
+        # rank 1 goes silent → FAULT with the right rank named
+        w1.stop()
+        deadline = time.time() + 6
+        while time.time() < deadline:
+            if mgr.scan() == ElasticStatus.FAULT:
+                break
+            time.sleep(0.3)
+        assert mgr.status == ElasticStatus.FAULT
+        assert mgr.dead_ranks == [1]
+
+        # rank 1 comes back → OK again (elastic rejoin)
+        w1 = HeartbeatClient(ep, rank=1, interval=0.2).start()
+        time.sleep(0.5)
+        assert mgr.scan() == ElasticStatus.OK
+        w0.stop()
+        w1.stop()
+    finally:
+        kv.stop()
+
+
+def test_launcher_elastic_kills_hung_job(tmp_path):
+    """--elastic catches ranks that HANG (never heartbeat), which the
+    exit watchdog alone cannot see."""
+    import os
+    import subprocess
+    import sys
+
+    REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    hung = tmp_path / "hang.py"
+    hung.write_text("import time\ntime.sleep(300)\n")  # never heartbeats
+    env = dict(os.environ, PYTHONPATH=REPO, JAX_PLATFORMS="cpu")
+    r = subprocess.run(
+        [sys.executable, "-m", "paddle_tpu.distributed.fleet.launch",
+         "--nproc_per_node", "2", "--started_port", "7731",
+         "--elastic", "--elastic_timeout", "5", "--elastic_grace", "5",
+         "--log_dir", str(tmp_path / "log"), str(hung)],
+        env=env, cwd=REPO, capture_output=True, text=True, timeout=120)
+    assert r.returncode != 0
+    assert "stopped heartbeating" in r.stderr
+
+
+def test_clean_exit_is_not_a_fault():
+    """A rank that finishes and marks exit must not fault the job; all
+    ranks exited → EXIT status (staggered completion is normal)."""
+    kv = KVServer().start()
+    ep = f"127.0.0.1:{kv.port}"
+    try:
+        w0 = HeartbeatClient(ep, rank=0, interval=0.2).start()
+        w1 = HeartbeatClient(ep, rank=1, interval=0.2).start()
+        mgr = ElasticManager(ep, world_size=2, timeout=1.0)
+        time.sleep(0.4)
+        assert mgr.scan() == ElasticStatus.OK
+        w0.stop(exited=True)               # rank 0 completes early
+        time.sleep(1.5)                    # past the beat timeout
+        assert mgr.scan() == ElasticStatus.OK
+        w1.stop(exited=True)
+        assert mgr.scan() == ElasticStatus.EXIT
+    finally:
+        kv.stop()
+
+
+def test_kv_servers_are_isolated():
+    """Two KV servers in one process must not share keys (the handler
+    store is per-instance, not a class global)."""
+    a, b = KVServer().start(), KVServer().start()
+    try:
+        HeartbeatClient(f"127.0.0.1:{a.port}", rank=0).beat_once()
+        mgr_b = ElasticManager(f"127.0.0.1:{b.port}", world_size=1,
+                               timeout=1.0, grace=0.0)
+        assert mgr_b.scan() == ElasticStatus.FAULT   # b never saw a beat
+    finally:
+        a.stop()
+        b.stop()
+
+
+def test_watch_fires_on_fault_transition():
+    kv = KVServer().start()
+    ep = f"127.0.0.1:{kv.port}"
+    events = []
+    try:
+        w0 = HeartbeatClient(ep, rank=0, interval=0.2).start()
+        mgr = ElasticManager(ep, world_size=2, timeout=2.5)
+        mgr.watch(interval=0.3, on_fault=lambda dead: events.append(dead))
+        deadline = time.time() + 10
+        while time.time() < deadline and not events:
+            time.sleep(0.2)
+        assert events and events[0] == [1]   # rank 1 never beat
+        mgr.stop()
+        w0.stop()
+    finally:
+        kv.stop()
