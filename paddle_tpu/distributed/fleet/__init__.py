@@ -1,6 +1,7 @@
 from . import dataset, elastic, metrics
 from .dataset import InMemoryDataset, MultiSlotDataGenerator, QueueDataset
 from .elastic import ElasticManager, ElasticStatus, HeartbeatClient
+from .device_worker import DownpourWorker
 from .fleet_wrapper import FleetWrapper
 from .fleet_base import Fleet, fleet
 from .http_server import KVClient, KVServer

@@ -1,0 +1,89 @@
+"""Device workers — per-process training loops over a Dataset stream
+(reference `paddle/fluid/framework/device_worker.h:148-637`:
+HogwildWorker (dense, lock-free), DownpourWorker (PS sparse pull/push,
+`downpour_worker.cc`), driven by MultiTrainer/DistMultiTrainer
+(`framework/trainer.h:53`, `executor.cc:152` RunFromDataset).
+
+TPU redesign: a "worker" is not a thread pinned to a card — SPMD covers
+the chips — it is the HOST loop that marries the data stream to ONE jit'd
+XLA step. HogwildWorker ≈ Executor.train_from_dataset (already present).
+DownpourWorker here implements the PS recipe: per batch, pull the touched
+sparse rows through FleetWrapper, run the fused device fwd/bwd, push
+sparse grads (async) and dense grads, with the table applying the rule —
+the same pull→compute→push dataflow as `downpour_worker.cc`, minus the
+thread farm XLA makes unnecessary."""
+from __future__ import annotations
+
+from typing import Callable, Optional
+
+import numpy as np
+
+__all__ = ["DownpourWorker"]
+
+
+class _PsTableView:
+    """Adapts FleetWrapper pull/push to the HostEmbedding interface
+    make_host_embedding_step programs against — the jit'd device kernel
+    stays in ONE place (distributed/ps/host_embedding.py)."""
+
+    def __init__(self, fw, table_id: int, dim: int, async_push: bool):
+        self.fw = fw
+        self.tid = table_id
+        self.dim = dim
+        self.async_push = async_push
+
+    def pull_dedup(self, ids):
+        ids = np.ascontiguousarray(np.asarray(ids, np.int64)).reshape(-1)
+        uniq, inverse = np.unique(ids, return_inverse=True)
+        rows = self.fw.pull_sparse_vars_sync(self.tid, uniq,
+                                             fea_dim=self.dim)
+        # pad to pow2 so varying unique counts don't retrace the step
+        # (same policy as HostEmbedding.pull_dedup)
+        cap = 1 << max(0, int(uniq.size - 1)).bit_length()
+        if cap > uniq.size:
+            rows = np.concatenate(
+                [rows, np.zeros((cap - uniq.size, self.dim), np.float32)])
+        return rows, inverse.astype(np.int32), uniq
+
+    def push(self, uniq_ids, grads):
+        if self.async_push:
+            self.fw.push_sparse_vars_async(self.tid, uniq_ids, grads)
+        else:
+            self.fw._client.push_sparse(
+                self.tid, np.asarray(uniq_ids, np.int64),
+                np.asarray(grads, np.float32))
+
+
+class DownpourWorker:
+    """Train a dense head over PS-resident sparse embeddings.
+
+    dense_layer(emb_flat, *batch_rest) -> out; loss_fn(out, batch) ->
+    scalar Tensor. Batches yield (ids, *rest). Dense params train
+    on-device through `optimizer`; sparse rows train table-side (async
+    push, like the reference Downpour push queues)."""
+
+    def __init__(self, fleet_wrapper, sparse_table_id: int, fea_dim: int,
+                 dense_layer, optimizer, loss_fn: Callable,
+                 async_push: bool = True):
+        from ..ps.host_embedding import make_host_embedding_step
+        self.fw = fleet_wrapper
+        self._view = _PsTableView(fleet_wrapper, sparse_table_id, fea_dim,
+                                  async_push)
+        self._step = make_host_embedding_step(dense_layer, optimizer,
+                                              loss_fn, self._view)
+
+    def train_one_batch(self, ids, *data) -> float:
+        return self._step(ids, *data)
+
+    def train_from_dataset(self, dataset, epochs: int = 1,
+                           flush_every: Optional[int] = None):
+        """reference Executor::RunFromDataset + DownpourWorker::TrainFiles.
+        dataset yields (ids, *rest) batches."""
+        losses = []
+        for _ in range(epochs):
+            for i, batch in enumerate(dataset):
+                losses.append(self.train_one_batch(*batch))
+                if flush_every and (i + 1) % flush_every == 0:
+                    self.fw.client_flush()
+        self.fw.client_flush()
+        return losses

@@ -99,11 +99,37 @@ class Predictor:
         import jax
         from .. import jit
         self._config = config
-        self._translated = jit.load(config.model_path)
+        self._legacy = None
+        if config.model_path is None:
+            raise ValueError("Config has no model path")
+        try:
+            self._translated = jit.load(config.model_path)
+            nin = len(self._translated._exported.in_avals)
+            self._input_names = [f"input_{i}" for i in range(nin)]
+        except Exception as stablehlo_err:
+            # not our StableHLO artifact — try the reference ProgramDesc
+            # format (.pdmodel + combined .pdiparams; pd_import.py)
+            from .pd_import import load_legacy_inference_model
+            model_file = config.model_path + ".pdmodel"
+            if not os.path.exists(model_file):
+                raise
+            params_file = config.params_file
+            if params_file is None:
+                cand = config.model_path + ".pdiparams"
+                params_file = cand if os.path.exists(cand) else None
+            try:
+                self._legacy = load_legacy_inference_model(model_file,
+                                                           params_file)
+            except Exception as legacy_err:
+                raise RuntimeError(
+                    f"{model_file} is neither a loadable StableHLO "
+                    f"artifact ({stablehlo_err!r}) nor a parseable "
+                    f"reference ProgramDesc ({legacy_err!r})"
+                ) from legacy_err
+            self._translated = None
+            self._input_names = list(self._legacy.feed_names)
         self._inputs: Dict[str, PredictorTensor] = {}
         self._outputs: List[PredictorTensor] = []
-        nin = len(self._translated._exported.in_avals)
-        self._input_names = [f"input_{i}" for i in range(nin)]
         for n in self._input_names:
             self._inputs[n] = PredictorTensor(n)
 
@@ -119,7 +145,10 @@ class Predictor:
             for n, a in zip(self._input_names, inputs):
                 self._inputs[n].copy_from_cpu(np.asarray(a))
         args = [self._inputs[n]._value for n in self._input_names]
-        out = self._translated._exported.call(*args)
+        if self._legacy is not None:
+            out = self._legacy.run(dict(zip(self._input_names, args)))
+        else:
+            out = self._translated._exported.call(*args)
         leaves = jax.tree_util.tree_leaves(out)
         self._outputs = []
         for i, leaf in enumerate(leaves):

@@ -1,0 +1,318 @@
+"""Execute reference `.pdmodel` inference graphs (reference
+`paddle/fluid/inference/api/analysis_predictor.h:82` Run → per-op
+executor loop; `fluid/inference/io.cc` Load).
+
+TPU redesign: instead of an op interpreter, the parsed ProgramDesc block
+is bound op-by-op to this framework's jnp semantics and the WHOLE graph
+is one `jax.jit` program (parameters closure-baked as constants so XLA
+folds/fuses them). Covers the op vocabulary v2.0 save_inference_model
+emits for MLP/CNN/transformer-encoder graphs; unmapped op types raise
+UnimplementedError naming them."""
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, List
+
+import numpy as np
+
+from .pd_format import parse_program_desc, read_combined_params
+
+__all__ = ["LegacyInferenceModel", "load_legacy_inference_model"]
+
+
+def _bcast_y(x, y, axis):
+    """elementwise_* `axis` semantics (reference
+    `operators/elementwise/elementwise_op_function.h`): align y's dims to
+    x starting at `axis` (or from the right when axis == -1)."""
+    import jax.numpy as jnp
+    if x.ndim == y.ndim or y.ndim == 0:
+        return y
+    ax = axis if axis >= 0 else x.ndim - y.ndim
+    shape = [1] * x.ndim
+    for i, d in enumerate(y.shape):
+        shape[ax + i] = d
+    return jnp.reshape(y, shape)
+
+
+def _build_op(op_type: str, attrs: Dict[str, Any]) -> Callable:
+    """op type + attrs → fn(*input_arrays) -> tuple(output_arrays).
+    Input order matches _op_input_order below."""
+    import jax
+    import jax.numpy as jnp
+
+    a = attrs
+
+    if op_type == "mul":
+        xd = a.get("x_num_col_dims", 1)
+        yd = a.get("y_num_col_dims", 1)
+
+        def fn(x, y):
+            xm = x.reshape(int(np.prod(x.shape[:xd])), -1)
+            ym = y.reshape(int(np.prod(y.shape[:yd])), -1)
+            out = xm @ ym
+            return out.reshape(tuple(x.shape[:xd]) + tuple(y.shape[yd:]))
+        return fn
+    if op_type in ("matmul", "matmul_v2"):
+        tx = a.get("transpose_X", a.get("trans_x", False))
+        ty = a.get("transpose_Y", a.get("trans_y", False))
+        alpha = a.get("alpha", 1.0)
+
+        def fn(x, y):
+            if tx:
+                x = jnp.swapaxes(x, -1, -2)
+            if ty:
+                y = jnp.swapaxes(y, -1, -2)
+            return jnp.matmul(x, y) * alpha
+        return fn
+    if op_type.startswith("elementwise_"):
+        kind = op_type[len("elementwise_"):]
+        base = {"add": jnp.add, "sub": jnp.subtract,
+                "mul": jnp.multiply, "div": jnp.divide,
+                "max": jnp.maximum, "min": jnp.minimum,
+                "pow": jnp.power}[kind]
+        axis = a.get("axis", -1)
+        return lambda x, y: base(x, _bcast_y(x, y, axis))
+    if op_type == "relu":
+        return lambda x: jnp.maximum(x, 0)
+    if op_type == "gelu":
+        approx = a.get("approximate", False)
+        return lambda x: jax.nn.gelu(x, approximate=bool(approx))
+    if op_type == "sigmoid":
+        return lambda x: jax.nn.sigmoid(x)
+    if op_type == "tanh":
+        return jnp.tanh
+    if op_type == "exp":
+        return jnp.exp
+    if op_type == "sqrt":
+        return jnp.sqrt
+    if op_type == "softmax":
+        ax = a.get("axis", -1)
+        return lambda x: jax.nn.softmax(x, axis=ax)
+    if op_type == "scale":
+        s, b = a.get("scale", 1.0), a.get("bias", 0.0)
+        after = a.get("bias_after_scale", True)
+        return (lambda x: x * s + b) if after else (lambda x: (x + b) * s)
+    if op_type in ("lookup_table_v2", "lookup_table"):
+        pad = a.get("padding_idx", -1)
+
+        def fn(w, ids):
+            ids = ids.reshape(ids.shape[:-1]) \
+                if op_type == "lookup_table" and ids.shape[-1] == 1 else ids
+            out = jnp.take(w, ids, axis=0)
+            if pad is not None and pad >= 0:
+                out = jnp.where((ids == pad)[..., None], 0.0, out)
+            return out
+        return fn
+    if op_type in ("reduce_mean", "reduce_sum", "reduce_max", "reduce_min"):
+        red = {"reduce_mean": jnp.mean, "reduce_sum": jnp.sum,
+               "reduce_max": jnp.max, "reduce_min": jnp.min}[op_type]
+        dims = tuple(a.get("dim", [0]))
+        keep = a.get("keep_dim", False)
+        if a.get("reduce_all", False):
+            return lambda x: red(x)
+        return lambda x: red(x, axis=dims, keepdims=keep)
+    if op_type in ("reshape2", "reshape"):
+        shape = list(a.get("shape", []))
+
+        def fn(x):
+            tgt = [x.shape[i] if s == 0 else s
+                   for i, s in enumerate(shape)]
+            return x.reshape(tgt)
+        return fn
+    if op_type in ("transpose2", "transpose"):
+        perm = a.get("axis", [])
+        return lambda x: jnp.transpose(x, perm)
+    if op_type == "concat":
+        ax = a.get("axis", 0)
+        return lambda *xs: jnp.concatenate(xs, axis=ax)
+    if op_type == "stack":
+        ax = a.get("axis", 0)
+        return lambda *xs: jnp.stack(xs, axis=ax)
+    if op_type == "dropout":
+        return lambda x: x          # inference graphs run is_test=True
+    if op_type == "cast":
+        from .pd_format import DTYPES
+        out_dt = DTYPES.get(a.get("out_dtype", 5), np.float32)
+        return lambda x: x.astype(out_dt)
+    if op_type == "batch_norm":
+        eps = a.get("epsilon", 1e-5)
+
+        def fn(x, scale, bias, mean, var):
+            sh = (1, -1) + (1,) * (x.ndim - 2)
+            return (x - mean.reshape(sh)) / jnp.sqrt(
+                var.reshape(sh) + eps) * scale.reshape(sh) + \
+                bias.reshape(sh)
+        return fn
+    if op_type == "layer_norm":
+        eps = a.get("epsilon", 1e-5)
+        bna = a.get("begin_norm_axis", 1)
+
+        def fn(x, scale, bias):
+            axes = tuple(range(bna, x.ndim))
+            m = jnp.mean(x, axis=axes, keepdims=True)
+            v = jnp.var(x, axis=axes, keepdims=True)
+            y = (x - m) / jnp.sqrt(v + eps)
+            sh = (1,) * bna + tuple(x.shape[bna:])
+            return y * scale.reshape(sh) + bias.reshape(sh)
+        return fn
+    if op_type in ("conv2d", "depthwise_conv2d"):
+        strides = tuple(a.get("strides", [1, 1]))
+        pads = a.get("paddings", [0, 0])
+        dil = tuple(a.get("dilations", [1, 1]))
+        groups = a.get("groups", 1)
+        if len(pads) == 2:
+            pads = [(pads[0], pads[0]), (pads[1], pads[1])]
+        else:
+            pads = [(pads[0], pads[1]), (pads[2], pads[3])]
+
+        def fn(x, w):
+            return jax.lax.conv_general_dilated(
+                x, w, strides, pads, rhs_dilation=dil,
+                feature_group_count=groups,
+                dimension_numbers=("NCHW", "OIHW", "NCHW"))
+        return fn
+    if op_type == "pool2d":
+        ptype = a.get("pooling_type", "max")
+        ks = tuple(a.get("ksize", [2, 2]))
+        strides = tuple(a.get("strides", ks))
+        pads = a.get("paddings", [0, 0])
+        exclusive = a.get("exclusive", True)
+        if a.get("global_pooling", False):
+            if ptype == "max":
+                return lambda x: jnp.max(x, axis=(2, 3), keepdims=True)
+            return lambda x: jnp.mean(x, axis=(2, 3), keepdims=True)
+        pad4 = ((0, 0), (0, 0), (pads[0], pads[0]), (pads[1], pads[1]))
+
+        def fn(x):
+            import jax.lax as lax
+            if ptype == "max":
+                return lax.reduce_window(
+                    x, -jnp.inf, lax.max, (1, 1) + ks,
+                    (1, 1) + strides, pad4)
+            s = lax.reduce_window(x, 0.0, lax.add, (1, 1) + ks,
+                                  (1, 1) + strides, pad4)
+            if exclusive:
+                # reference default: divide by the VALID cell count at
+                # the borders (pool_op.h exclusive=True)
+                ones = jnp.ones_like(x)
+                cnt = lax.reduce_window(ones, 0.0, lax.add, (1, 1) + ks,
+                                        (1, 1) + strides, pad4)
+                return s / cnt
+            return s / (ks[0] * ks[1])
+        return fn
+    if op_type in ("squeeze2", "squeeze"):
+        axes = tuple(a.get("axes", []))
+        return lambda x: jnp.squeeze(x, axis=axes or None)
+    if op_type in ("unsqueeze2", "unsqueeze"):
+        axes = a.get("axes", [])
+
+        def fn(x):
+            for ax in sorted(axes):
+                x = jnp.expand_dims(x, ax)
+            return x
+        return fn
+    if op_type == "slice":
+        axes = a.get("axes", [])
+        starts = a.get("starts", [])
+        ends = a.get("ends", [])
+        dec = a.get("decrease_axis", [])
+
+        def fn(x):
+            idx = [slice(None)] * x.ndim
+            for ax, s, e in zip(axes, starts, ends):
+                idx[ax] = slice(s, e)
+            out = x[tuple(idx)]
+            if dec:   # x[0]-style indexing drops the size-1 dims
+                out = jnp.squeeze(out, axis=tuple(dec))
+            return out
+        return fn
+    if op_type == "assign":
+        return lambda x: x
+    if op_type == "arg_max":
+        ax = a.get("axis", -1)
+        return lambda x: jnp.argmax(x, axis=ax).astype(np.int64)
+    if op_type == "fill_constant":
+        from .pd_format import DTYPES
+        shape = a.get("shape", [])
+        dt = DTYPES.get(a.get("dtype", 5), np.float32)
+        val = a.get("value", 0.0)
+        return lambda: jnp.full(shape, val, dt)
+    raise NotImplementedError(
+        f"reference op type {op_type!r} has no mapping yet "
+        f"(inference/pd_import.py)")
+
+
+# slot order each op's impl expects (reference OpDesc input parameters)
+_INPUT_ORDER = {
+    "mul": ["X", "Y"], "matmul": ["X", "Y"], "matmul_v2": ["X", "Y"],
+    "lookup_table_v2": ["W", "Ids"], "lookup_table": ["W", "Ids"],
+    "batch_norm": ["X", "Scale", "Bias", "Mean", "Variance"],
+    "layer_norm": ["X", "Scale", "Bias"],
+    "conv2d": ["Input", "Filter"], "depthwise_conv2d": ["Input", "Filter"],
+}
+_OUTPUT_SLOT = {"batch_norm": "Y", "layer_norm": "Y", "conv2d": "Output",
+                "depthwise_conv2d": "Output", "pool2d": "Out"}
+
+
+class LegacyInferenceModel:
+    """A loaded reference inference program, compiled as one XLA program."""
+
+    def __init__(self, program: Dict, params: Dict[str, np.ndarray]):
+        import jax
+
+        block = program["blocks"][0]
+        self.feed_names: List[str] = []
+        self.fetch_names: List[str] = []
+        steps = []
+        for op in block["ops"]:
+            t = op["type"]
+            if t == "feed":
+                self.feed_names.append(op["outputs"]["Out"][0])
+                continue
+            if t == "fetch":
+                self.fetch_names.append(op["inputs"]["X"][0])
+                continue
+            fn = _build_op(t, op["attrs"])
+            order = _INPUT_ORDER.get(t)
+            if order:
+                in_names = [op["inputs"][k][0] for k in order
+                            if op["inputs"].get(k)]
+            else:
+                xs = op["inputs"].get("X", [])
+                ys = op["inputs"].get("Y", [])
+                in_names = list(xs) + list(ys)
+            out_slot = _OUTPUT_SLOT.get(t, "Out")
+            out_name = op["outputs"][out_slot][0]
+            steps.append((t, fn, in_names, out_name))
+        self._steps = steps
+        self._params = {k: np.asarray(v) for k, v in params.items()}
+
+        def run_fn(feeds: List):
+            env = dict(self._params)
+            env.update(zip(self.feed_names, feeds))
+            for t, fn, in_names, out_name in self._steps:
+                env[out_name] = fn(*[env[n] for n in in_names])
+            return [env[n] for n in self.fetch_names]
+        self._jit = jax.jit(run_fn)
+
+    def run(self, feed: Dict[str, np.ndarray]) -> List[np.ndarray]:
+        import jax.numpy as jnp
+        feeds = [jnp.asarray(np.asarray(feed[n])) for n in self.feed_names]
+        return [np.asarray(o) for o in self._jit(feeds)]
+
+
+def load_legacy_inference_model(model_path: str,
+                                params_path: str = None
+                                ) -> LegacyInferenceModel:
+    """Load reference `.pdmodel` (+ combined `.pdiparams`).
+
+    Param order in the combined file follows sorted persistable-var names
+    (`fluid/io.py` save_vars sorts by name before save_combine)."""
+    with open(model_path, "rb") as f:
+        program = parse_program_desc(f.read())
+    params: Dict[str, np.ndarray] = {}
+    if params_path:
+        names = sorted(n for n, v in program["blocks"][0]["vars"].items()
+                       if v["persistable"])
+        with open(params_path, "rb") as f:
+            params = read_combined_params(f.read(), names)
+    return LegacyInferenceModel(program, params)

@@ -1,3 +1,5 @@
+from .bert import (BertConfig, BertForPretraining,
+                   BertForSequenceClassification, BertModel)
 from .ernie import (ErnieConfig, ErnieForPretraining,
                     ErnieForSequenceClassification, ErnieModel, tp_annotate)
 from .gpt import GPTConfig, GPTForCausalLM, GPTModel, MoEFeedForward

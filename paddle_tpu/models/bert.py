@@ -1,0 +1,42 @@
+"""BERT model family (reference PaddleNLP `transformers/bert/modeling.py`;
+the in-repo reference op surface is the same encoder ERNIE uses —
+`python/paddle/nn/layer/transformer.py`).
+
+BERT and ERNIE share the identical encoder architecture (the difference
+is pretraining data/objectives, not graph structure), so the BERT classes
+are thin configuration aliases over the ERNIE tower — same fused-QKV
+attention, same TP annotations. Kept as a separate namespace because the
+reference ships them as distinct model families with distinct configs."""
+from __future__ import annotations
+
+from .ernie import (ErnieConfig, ErnieForPretraining,
+                    ErnieForSequenceClassification, ErnieModel)
+
+__all__ = ["BertConfig", "BertModel", "BertForSequenceClassification",
+           "BertForPretraining"]
+
+
+class BertConfig(ErnieConfig):
+    @classmethod
+    def base(cls):
+        return cls(vocab_size=30522, hidden_size=768,
+                   num_hidden_layers=12, num_attention_heads=12,
+                   intermediate_size=3072)
+
+    @classmethod
+    def large(cls):
+        return cls(vocab_size=30522, hidden_size=1024,
+                   num_hidden_layers=24, num_attention_heads=16,
+                   intermediate_size=4096)
+
+
+class BertModel(ErnieModel):
+    pass
+
+
+class BertForSequenceClassification(ErnieForSequenceClassification):
+    pass
+
+
+class BertForPretraining(ErnieForPretraining):
+    pass
