@@ -220,3 +220,152 @@ class GPTForCausalLM(nn.Layer):
     def pipeline_sections(self):
         return (_GPTEmbeddingStage(self.gpt), self.gpt.blocks,
                 _GPTHeadStage(self.gpt, lm=True))
+
+    def generate(self, input_ids, max_new_tokens=32, do_sample=False,
+                 top_k=None, temperature=1.0, seed=0):
+        """Autoregressive decoding with a fixed-size KV cache (reference
+        ecosystem: PaddleNLP GenerationMixin.generate/greedy_search).
+
+        TPU design: ONE jax.jit program — prefill is a single batched
+        [B,S,E] causal pass writing the whole prompt's K/V, decode is a
+        `lax.scan` over `max_new_tokens` steps; K/V live in
+        [L, B, H, T, D] buffers written in place with
+        dynamic_update_slice, so shapes are static for every step and
+        nothing retraces per token. Weights ride as jit ARGUMENTS
+        (value-fresh after training steps) and the compiled program is
+        memoized per static config. Eval-mode math (no dropout); the
+        decode math is anchored to the Layer forward by
+        tests/test_generate.py's full-forward oracle."""
+        import jax
+
+        gpt = self.gpt
+        cfg = gpt.config
+        ids = jnp.asarray(
+            input_ids._value if isinstance(input_ids, Tensor)
+            else input_ids, jnp.int32)
+        B, S = ids.shape
+        T = S + int(max_new_tokens)
+        if T > cfg.max_position_embeddings:
+            raise ValueError(
+                f"{T} positions exceed max_position_embeddings="
+                f"{cfg.max_position_embeddings}")
+        if cfg.use_moe:
+            raise NotImplementedError("generate() with MoE blocks")
+        L, E = cfg.num_layers, cfg.hidden_size
+        H = cfg.num_heads
+        D = E // H
+        scale = 1.0 / D ** 0.5
+
+        weights = {
+            "wte": gpt.wte.weight._value, "wpe": gpt.wpe.weight._value,
+            "lnf": (gpt.ln_f.weight._value, gpt.ln_f.bias._value),
+            "blocks": [(
+                blk.ln1.weight._value, blk.ln1.bias._value,
+                blk.attn.q_proj.weight._value, blk.attn.q_proj.bias._value,
+                blk.attn.k_proj.weight._value, blk.attn.k_proj.bias._value,
+                blk.attn.v_proj.weight._value, blk.attn.v_proj.bias._value,
+                blk.attn.out_proj.weight._value,
+                blk.attn.out_proj.bias._value,
+                blk.ln2.weight._value, blk.ln2.bias._value,
+                blk.mlp[0].weight._value, blk.mlp[0].bias._value,
+                blk.mlp[2].weight._value, blk.mlp[2].bias._value)
+                for blk in gpt.blocks],
+        }
+
+        cfg_key = (B, S, int(max_new_tokens), bool(do_sample),
+                   int(top_k or 0), float(temperature))
+        cached = getattr(self, "_gen_jit_cache", None)
+        if cached is None:
+            cached = self._gen_jit_cache = {}
+        run = cached.get(cfg_key)
+        if run is None:
+            def ln(x, w, b):
+                m = jnp.mean(x, -1, keepdims=True)
+                v = jnp.var(x, -1, keepdims=True)
+                return (x - m) / jnp.sqrt(v + 1e-5) * w + b
+
+            def one_pos(W, tok, pos, kbufs, vbufs):
+                """Single-position forward against the cache. tok [B]."""
+                h = W["wte"][tok] + W["wpe"][pos]
+                for i, (l1w, l1b, wq, bq, wk, bk, wv, bv, wo, bo, l2w,
+                        l2b, w1, b1, w2, b2) in enumerate(W["blocks"]):
+                    x = ln(h, l1w, l1b)
+                    q = (x @ wq + bq).reshape(B, H, D)
+                    k = (x @ wk + bk).reshape(B, H, D)
+                    v = (x @ wv + bv).reshape(B, H, D)
+                    kbufs = jax.lax.dynamic_update_slice(
+                        kbufs, k[None, :, :, None, :], (i, 0, 0, pos, 0))
+                    vbufs = jax.lax.dynamic_update_slice(
+                        vbufs, v[None, :, :, None, :], (i, 0, 0, pos, 0))
+                    s = jnp.einsum("bhd,bhtd->bht", q, kbufs[i]) * scale
+                    s = jnp.where(jnp.arange(T)[None, None, :] <= pos, s,
+                                  -1e30)
+                    p = jax.nn.softmax(s, axis=-1)
+                    o = jnp.einsum("bht,bhtd->bhd", p,
+                                   vbufs[i]).reshape(B, E)
+                    h = h + (o @ wo + bo)
+                    x2 = ln(h, l2w, l2b)
+                    h = h + (jax.nn.gelu(x2 @ w1 + b1,
+                                         approximate=False) @ w2 + b2)
+                lnfw, lnfb = W["lnf"]
+                return ln(h, lnfw, lnfb) @ W["wte"].T, kbufs, vbufs
+
+            def prefill(W, ids, kbufs, vbufs):
+                """One batched causal pass over the whole prompt — the
+                MXU sees [B,S,E] matmuls, not S tiny ones."""
+                h = W["wte"][ids] + W["wpe"][jnp.arange(S)][None]
+                for i, (l1w, l1b, wq, bq, wk, bk, wv, bv, wo, bo, l2w,
+                        l2b, w1, b1, w2, b2) in enumerate(W["blocks"]):
+                    x = ln(h, l1w, l1b)
+
+                    def heads(t):
+                        return t.reshape(B, S, H, D).transpose(0, 2, 1, 3)
+                    q = heads(x @ wq + bq)
+                    k = heads(x @ wk + bk)
+                    v = heads(x @ wv + bv)
+                    kbufs = kbufs.at[i, :, :, :S].set(k)
+                    vbufs = vbufs.at[i, :, :, :S].set(v)
+                    s = jnp.einsum("bhqd,bhkd->bhqk", q, k) * scale
+                    causal = jnp.tril(jnp.ones((S, S), bool))
+                    s = jnp.where(causal, s, -1e30)
+                    p = jax.nn.softmax(s, axis=-1)
+                    o = jnp.einsum("bhqk,bhkd->bhqd", p, v)
+                    o = o.transpose(0, 2, 1, 3).reshape(B, S, E)
+                    h = h + (o @ wo + bo)
+                    x2 = ln(h, l2w, l2b)
+                    h = h + (jax.nn.gelu(x2 @ w1 + b1,
+                                         approximate=False) @ w2 + b2)
+                lnfw, lnfb = W["lnf"]
+                logits = ln(h[:, -1], lnfw, lnfb) @ W["wte"].T
+                return logits, kbufs, vbufs
+
+            def sample(logits, key):
+                if not do_sample:
+                    return jnp.argmax(logits, -1).astype(jnp.int32)
+                lg = logits / jnp.maximum(temperature, 1e-6)
+                if top_k:
+                    kth = jax.lax.top_k(lg, int(top_k))[0][..., -1:]
+                    lg = jnp.where(lg < kth, -1e30, lg)
+                return jax.random.categorical(key, lg).astype(jnp.int32)
+
+            def run_fn(W, ids, key):
+                kbufs = jnp.zeros((L, B, H, T, D), W["wte"].dtype)
+                vbufs = jnp.zeros_like(kbufs)
+                logits, kbufs, vbufs = prefill(W, ids, kbufs, vbufs)
+
+                def dec(carry, _):
+                    lg, pos, kb, vb, key = carry
+                    key, sub = jax.random.split(key)
+                    tok = sample(lg, sub)
+                    lg2, kb, vb = one_pos(W, tok, pos, kb, vb)
+                    return (lg2, pos + 1, kb, vb, key), tok
+                _, toks = jax.lax.scan(
+                    dec, (logits, jnp.asarray(S, jnp.int32), kbufs,
+                          vbufs, key), None,
+                    length=int(max_new_tokens))
+                return jnp.concatenate([ids, toks.T], axis=1)
+
+            run = cached[cfg_key] = jax.jit(run_fn)
+
+        out = run(weights, ids, jax.random.PRNGKey(int(seed)))
+        return Tensor(out)
