@@ -104,3 +104,55 @@ class PTQ:
     def save_quantized_model(self, model, path, input_spec=None):
         from .. import jit
         jit.save(model, path, input_spec=input_spec)
+
+
+class WeightOnlyLinear(nn.Layer):
+    """Weight-only int8 linear (reference direction:
+    `paddle.nn.quant.weight_only_linear` in later versions; the v2.0
+    slim toolchain stops at fake-quant).
+
+    TPU rationale: serving memory/HBM-bandwidth is the bottleneck, not
+    int8 math — weights store as int8 + per-output-channel fp scales
+    (4x smaller, 4x less HBM traffic on the weight stream) and
+    dequantize into the matmul's bf16/fp32 epilogue, which XLA fuses."""
+
+    def __init__(self, inner: "nn.Linear"):
+        super().__init__()
+        import numpy as np
+
+        w = np.asarray(inner.weight._value, np.float32)   # [in, out]
+        scale = np.maximum(np.abs(w).max(axis=0), 1e-8) / 127.0
+        q = np.clip(np.round(w / scale), -127, 127).astype(np.int8)
+        self.register_buffer("weight_int8", Tensor(jnp.asarray(q)))
+        self.register_buffer("weight_scale",
+                             Tensor(jnp.asarray(scale, jnp.float32)))
+        self.bias = inner.bias
+        self._out_features = inner._out_features
+
+    def forward(self, x):
+        def impl(v, q, s, *b):
+            w = q.astype(v.dtype) * s.astype(v.dtype)
+            out = v @ w
+            if b:
+                out = out + b[0]
+            return out
+        args = (x, self.weight_int8, self.weight_scale) + \
+            ((self.bias,) if self.bias is not None else ())
+        return apply_op("weight_only_linear", impl, args, {})
+
+
+def quantize_weights(model: nn.Layer, bits: int = 8) -> nn.Layer:
+    """Swap every nn.Linear for WeightOnlyLinear in place (weight-only
+    PTQ; int8 is the only width the int8 storage path supports)."""
+    if bits != 8:
+        raise NotImplementedError("weight-only quantization supports "
+                                  "bits=8")
+    for name, sub in list(model._sub_layers.items()):
+        if isinstance(sub, nn.Linear):
+            model._sub_layers[name] = WeightOnlyLinear(sub)
+        elif sub is not None:
+            quantize_weights(sub, bits)
+    return model
+
+
+__all__ += ["WeightOnlyLinear", "quantize_weights"]

@@ -1,0 +1,48 @@
+"""Weight-only int8 quantization (serving memory path; reference
+direction `paddle.nn.quant.weight_only_linear`)."""
+import numpy as np
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+from paddle_tpu.quantization import WeightOnlyLinear, quantize_weights
+
+
+def test_weight_only_linear_close_to_fp32():
+    paddle.seed(0)
+    lin = nn.Linear(32, 16)
+    q = WeightOnlyLinear(lin)
+    x = paddle.randn([4, 32])
+    ref = lin(x).numpy()
+    got = q(x).numpy()
+    # int8 per-channel round-off: ~0.4% of the weight magnitude
+    np.testing.assert_allclose(got, ref, rtol=0.05, atol=0.05)
+    assert q.weight_int8.numpy().dtype == np.int8       # 4x smaller
+
+
+def test_quantize_weights_swaps_nested_linears():
+    paddle.seed(1)
+    net = nn.Sequential(nn.Linear(8, 16), nn.ReLU(),
+                        nn.Sequential(nn.Linear(16, 8), nn.Tanh()),
+                        nn.Linear(8, 2))
+    x = paddle.randn([4, 8])
+    ref = net(x).numpy()
+    quantize_weights(net)
+    kinds = [type(l).__name__ for l in net.sublayers()]
+    assert kinds.count("WeightOnlyLinear") == 3
+    assert "Linear" not in kinds
+    got = net(x).numpy()
+    np.testing.assert_allclose(got, ref, rtol=0.08, atol=0.08)
+
+
+def test_quantized_model_still_jit_saves(tmp_path):
+    from paddle_tpu.static.input_spec import InputSpec
+    paddle.seed(2)
+    net = nn.Sequential(nn.Linear(8, 8), nn.ReLU(), nn.Linear(8, 2))
+    quantize_weights(net)
+    prefix = str(tmp_path / "qmodel")
+    paddle.jit.save(net, prefix, input_spec=[InputSpec([2, 8], "float32")])
+    loaded = paddle.jit.load(prefix)
+    x = np.random.RandomState(0).standard_normal((2, 8)).astype("float32")
+    np.testing.assert_allclose(
+        np.asarray(loaded(paddle.to_tensor(x)).numpy()),
+        net(paddle.to_tensor(x)).numpy(), rtol=1e-5, atol=1e-5)
