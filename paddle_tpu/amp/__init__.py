@@ -19,7 +19,8 @@ __all__ = ["auto_cast", "amp_guard", "decorate", "GradScaler",
            "white_list", "black_list"]
 
 # reference fp16_lists.py:20 white/black lists, pruned to our op names
-WHITE_LIST = {"matmul", "mm", "bmm", "linear", "conv1d", "conv2d", "conv3d",
+WHITE_LIST = {"matmul", "mm", "bmm", "linear", "weight_only_linear",
+              "conv1d", "conv2d", "conv3d",
               "conv1d_transpose", "conv2d_transpose", "conv3d_transpose",
               "einsum", "sdpa", "flash_attention"}
 BLACK_LIST = {"exp", "log", "softmax", "log_softmax", "cross_entropy",

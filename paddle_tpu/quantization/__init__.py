@@ -141,17 +141,27 @@ class WeightOnlyLinear(nn.Layer):
         return apply_op("weight_only_linear", impl, args, {})
 
 
-def quantize_weights(model: nn.Layer, bits: int = 8) -> nn.Layer:
+def quantize_weights(model: nn.Layer, bits: int = 8,
+                     _seen=None) -> nn.Layer:
     """Swap every nn.Linear for WeightOnlyLinear in place (weight-only
-    PTQ; int8 is the only width the int8 storage path supports)."""
+    PTQ; int8 is the only width the int8 storage path supports). A
+    Linear shared by several parents (tied heads) is quantized ONCE and
+    the single replacement is re-linked everywhere, preserving tying;
+    fake-quant wrappers (QuantizedLinear/Conv2D) are left intact."""
     if bits != 8:
         raise NotImplementedError("weight-only quantization supports "
                                   "bits=8")
+    seen = _seen if _seen is not None else {}
     for name, sub in list(model._sub_layers.items()):
         if isinstance(sub, nn.Linear):
-            model._sub_layers[name] = WeightOnlyLinear(sub)
+            rep = seen.get(id(sub))
+            if rep is None:
+                rep = seen[id(sub)] = WeightOnlyLinear(sub)
+            model._sub_layers[name] = rep
+        elif isinstance(sub, (QuantizedLinear, QuantizedConv2D)):
+            continue   # fake-quant wrappers own their inner Linear
         elif sub is not None:
-            quantize_weights(sub, bits)
+            quantize_weights(sub, bits, seen)
     return model
 
 

@@ -34,6 +34,49 @@ def test_quantize_weights_swaps_nested_linears():
     np.testing.assert_allclose(got, ref, rtol=0.08, atol=0.08)
 
 
+def test_shared_linear_stays_tied():
+    """A Linear referenced by two parents (tied-head pattern) must map to
+    ONE WeightOnlyLinear, not two divergent int8 copies."""
+    paddle.seed(3)
+    shared = nn.Linear(8, 8)
+
+    class Net(nn.Layer):
+        def __init__(self):
+            super().__init__()
+            self.a = shared
+            self.b = shared
+
+        def forward(self, x):
+            return self.a(x) + self.b(x)
+
+    net = Net()
+    quantize_weights(net)
+    assert net._sub_layers["a"] is net._sub_layers["b"]
+
+
+def test_fake_quant_wrappers_left_intact():
+    from paddle_tpu.quantization import PTQ
+    paddle.seed(4)
+    net = nn.Sequential(nn.Linear(8, 8), nn.ReLU())
+    PTQ().quantize(net)
+    quantize_weights(net)
+    x = paddle.randn([2, 8])
+    net(x)   # QuantizedLinear.forward must still find its inner Linear
+
+
+def test_amp_autocast_covers_weight_only_linear():
+    import jax.numpy as jnp
+
+    from paddle_tpu import amp
+    paddle.seed(5)
+    q = WeightOnlyLinear(nn.Linear(8, 4))
+    x = paddle.randn([2, 8])
+    with amp.auto_cast(level="O1", dtype="bfloat16"):
+        out = q(x)
+    assert out._value.dtype == jnp.bfloat16      # rode the MXU path
+    assert q.weight_int8._value.dtype == jnp.int8  # storage untouched
+
+
 def test_quantized_model_still_jit_saves(tmp_path):
     from paddle_tpu.static.input_spec import InputSpec
     paddle.seed(2)
