@@ -1,8 +1,9 @@
 """paddle.autograd namespace (reference `python/paddle/autograd/`)."""
 from ..framework.autograd import backward, grad, is_grad_enabled, no_grad
+from .functional import hessian, jacobian, jvp, vjp
 
 __all__ = ["backward", "grad", "no_grad", "is_grad_enabled", "PyLayer",
-           "PyLayerContext"]
+           "PyLayerContext", "vjp", "jvp", "jacobian", "hessian"]
 
 
 class PyLayerContext:

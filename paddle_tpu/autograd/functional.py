@@ -1,0 +1,118 @@
+"""Functional higher-order autodiff (reference
+`python/paddle/autograd/functional.py`: vjp/jvp/Jacobian/Hessian, the
+incubate.autograd surface).
+
+TPU-native: these are direct jax transforms over a functionalized view
+of the user's Tensor→Tensor function — exact forward- and reverse-mode
+derivatives, composable and jittable, where the reference double-walks
+its tape."""
+from __future__ import annotations
+
+from typing import Callable, Sequence, Union
+
+import jax
+import jax.numpy as jnp
+
+from ..framework.autograd import trace_mode
+from ..framework.tensor import Tensor
+
+__all__ = ["vjp", "jvp", "jacobian", "hessian"]
+
+
+def _wrap_fn(func: Callable) -> Callable:
+    """Tensor-level func → pure array function (traced under trace_mode
+    so framework ops lower instead of taping)."""
+    def raw(*arrays):
+        with trace_mode():
+            outs = func(*[Tensor(a) for a in arrays])
+        return jax.tree_util.tree_map(
+            lambda t: t._value if isinstance(t, Tensor) else t, outs,
+            is_leaf=lambda t: isinstance(t, Tensor))
+    return raw
+
+
+def _unwrap(xs):
+    seq = isinstance(xs, (list, tuple))
+    items = list(xs) if seq else [xs]
+    arrays = [x._value if isinstance(x, Tensor) else jnp.asarray(x)
+              for x in items]
+    return arrays, seq
+
+
+def _wrap_out(tree):
+    return jax.tree_util.tree_map(Tensor, tree)
+
+
+def _check_no_create_graph(create_graph, name):
+    if create_graph:
+        raise NotImplementedError(
+            f"{name}(create_graph=True): results are detached from the "
+            f"eager tape; compose jax transforms (e.g. nest "
+            f"jacobian/hessian calls) for higher-order graphs instead")
+
+
+def vjp(func, xs, v=None):
+    """reference `paddle.autograd.vjp`: (outputs, vjp_result). `v`
+    defaults to ones like the output; when given it must mirror the
+    output structure (its leaves are matched positionally)."""
+    arrays, seq = _unwrap(xs)
+    raw = _wrap_fn(func)
+    out, pullback = jax.vjp(raw, *arrays)
+    if v is None:
+        cot = jax.tree_util.tree_map(jnp.ones_like, out)
+    else:
+        cot_arr, _ = _unwrap(v)
+        treedef = jax.tree_util.tree_structure(out)
+        cot = jax.tree_util.tree_unflatten(
+            treedef, [jnp.asarray(c) for c in cot_arr])
+    grads = pullback(cot)
+    grads = grads if seq else grads[0]
+    return _wrap_out(out), _wrap_out(grads)
+
+
+def jvp(func, xs, v=None):
+    """reference `paddle.autograd.jvp`: forward-mode tangents."""
+    arrays, _ = _unwrap(xs)
+    raw = _wrap_fn(func)
+    if v is None:
+        tangents = tuple(jnp.ones_like(a) for a in arrays)
+    else:
+        tan_arr, _ = _unwrap(v)
+        tangents = tuple(tan_arr)
+    out, tang = jax.jvp(raw, tuple(arrays), tangents)
+    return _wrap_out(out), _wrap_out(tang)
+
+
+def jacobian(func, xs, create_graph=False, allow_unused=False):
+    """reference `paddle.autograd.jacobian` (batch=False semantics):
+    d func(xs) / d xs, exact reverse-mode. allow_unused is moot here —
+    an unused input yields exact zeros, never None."""
+    _check_no_create_graph(create_graph, "jacobian")
+    arrays, seq = _unwrap(xs)
+    raw = _wrap_fn(func)
+    jac = jax.jacrev(raw, argnums=tuple(range(len(arrays))))(*arrays)
+    jac = jac if seq else (jac[0] if isinstance(jac, tuple) else jac)
+    return _wrap_out(jac)
+
+
+def hessian(func, xs, create_graph=False, allow_unused=False):
+    """reference `paddle.autograd.hessian`: d²(scalar func)/dxs², exact
+    forward-over-reverse. func must return one scalar."""
+    _check_no_create_graph(create_graph, "hessian")
+    arrays, seq = _unwrap(xs)
+    raw = _wrap_fn(func)
+
+    def scalar(*a):
+        out = raw(*a)
+        leaves = jax.tree_util.tree_leaves(out)
+        if len(leaves) != 1 or jnp.size(leaves[0]) != 1:
+            raise ValueError(
+                "hessian: func must return a single scalar "
+                f"(got {len(leaves)} output(s), first of shape "
+                f"{getattr(leaves[0], 'shape', None)})")
+        return jnp.reshape(leaves[0], ())
+    hes = jax.hessian(scalar, argnums=tuple(range(len(arrays))))(*arrays)
+    if not seq:
+        h = hes[0][0] if isinstance(hes, tuple) else hes
+        return _wrap_out(h)
+    return _wrap_out(hes)

@@ -1,0 +1,7 @@
+"""python -m paddle_tpu.distributed.launch — the reference's
+`python -m paddle.distributed.launch` entry (`distributed/launch/main.py`),
+same CLI as fleet.launch."""
+from .fleet.launch import launch, main  # noqa: F401
+
+if __name__ == "__main__":
+    launch()

@@ -1,0 +1,77 @@
+"""Functional higher-order autodiff (reference
+`python/paddle/autograd/functional.py` vjp/jvp/jacobian/hessian) vs
+numpy closed forms."""
+import numpy as np
+
+import paddle_tpu as paddle
+from paddle_tpu.autograd import hessian, jacobian, jvp, vjp
+
+
+def _x(shape=(3,), seed=0):
+    return paddle.to_tensor(
+        np.random.RandomState(seed).standard_normal(shape).astype(
+            "float32"))
+
+
+def test_vjp_matches_manual():
+    x = _x()
+    out, g = vjp(lambda t: (t * t).sum(), x)
+    np.testing.assert_allclose(float(out.numpy()),
+                               (x.numpy() ** 2).sum(), rtol=1e-5)
+    np.testing.assert_allclose(g.numpy(), 2 * x.numpy(), rtol=1e-5)
+
+
+def test_jvp_matches_directional_derivative():
+    x = _x(seed=1)
+    v = _x(seed=2)
+    out, tang = jvp(lambda t: paddle.sin(t), x, v)
+    np.testing.assert_allclose(tang.numpy(),
+                               np.cos(x.numpy()) * v.numpy(), rtol=1e-5)
+
+
+def test_jacobian_of_vector_fn():
+    x = _x((4,), seed=3)
+    jac = jacobian(lambda t: paddle.tanh(t), x)
+    expect = np.diag(1.0 - np.tanh(x.numpy()) ** 2)
+    np.testing.assert_allclose(jac.numpy(), expect, rtol=1e-4, atol=1e-6)
+
+
+def test_hessian_of_quadratic():
+    a = np.array([[2.0, 0.5], [0.5, 1.0]], np.float32)
+    x = _x((2,), seed=4)
+    at = paddle.to_tensor(a)
+    h = hessian(lambda t: 0.5 * (t @ (at @ t)), x)
+    np.testing.assert_allclose(h.numpy(), a, rtol=1e-4, atol=1e-5)
+
+
+def test_multi_input_jacobian():
+    x, y = _x((3,), 5), _x((3,), 6)
+    jx, jy = jacobian(lambda a, b: a * b, [x, y])
+    np.testing.assert_allclose(jx.numpy(), np.diag(y.numpy()), rtol=1e-5)
+    np.testing.assert_allclose(jy.numpy(), np.diag(x.numpy()), rtol=1e-5)
+
+
+def test_vjp_list_output_with_explicit_v():
+    x = _x(seed=7)
+    out, g = vjp(lambda t: [t * t], x, v=[paddle.ones([3])])
+    np.testing.assert_allclose(g.numpy(), 2 * x.numpy(), rtol=1e-5)
+
+
+def test_hessian_rejects_non_scalar():
+    import pytest
+    x = _x((3,), seed=8)
+    with pytest.raises(ValueError, match="single scalar"):
+        hessian(lambda t: t * t, x)
+
+
+def test_create_graph_raises_clearly():
+    import pytest
+    x = _x(seed=9)
+    with pytest.raises(NotImplementedError, match="create_graph"):
+        jacobian(lambda t: t, x, create_graph=True)
+
+
+def test_distributed_launch_module_alias():
+    import importlib
+    m = importlib.import_module("paddle_tpu.distributed.launch")
+    assert callable(m.launch)
