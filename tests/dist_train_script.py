@@ -16,20 +16,48 @@ import numpy as np  # noqa: E402
 def main():
     out_path = sys.argv[1]
     steps = int(sys.argv[2]) if len(sys.argv) > 2 else 5
+    mode = sys.argv[3] if len(sys.argv) > 3 else "dp"
 
+    import jax as _jax
     import paddle_tpu as paddle
     import paddle_tpu.nn as nn
     from paddle_tpu.distributed.env import init_parallel_env
-    from paddle_tpu.parallel.mesh import get_mesh
+    from paddle_tpu.parallel.mesh import create_mesh, get_mesh
     from paddle_tpu.parallel.spmd import make_sharded_train_step
 
     penv = init_parallel_env()   # jax.distributed rendezvous from env vars
-    mesh = get_mesh()
+    if mode == "mp":
+        # model-parallel axis ACROSS processes: matmul partials reduce
+        # over Gloo instead of staying intra-process
+        mesh = create_mesh({"mp": len(_jax.devices())})
+    else:
+        mesh = get_mesh()
 
     paddle.seed(1234)            # identical init on every rank
-    net = nn.Sequential(nn.Linear(16, 32), nn.Tanh(), nn.Linear(32, 4))
+    if mode == "mp":
+        from paddle_tpu.distributed import (ColumnParallelLinear,
+                                            RowParallelLinear)
+
+        class Net(nn.Layer):
+            def __init__(self):
+                super().__init__()
+                self.up = ColumnParallelLinear(16, 32,
+                                               gather_output=False)
+                self.act = nn.Tanh()
+                self.down = RowParallelLinear(32, 4,
+                                              input_is_parallel=True)
+
+            def forward(self, x):
+                return self.down(self.act(self.up(x)))
+
+        net = Net()
+    else:
+        net = nn.Sequential(nn.Linear(16, 32), nn.Tanh(),
+                            nn.Linear(32, 4))
     opt = paddle.optimizer.Momentum(0.05, parameters=net.parameters())
     ce = nn.CrossEntropyLoss()
+    # dp_axis="dp" also in mp mode: the mesh has no "dp" axis then, so
+    # the batch stays replicated — correct for pure tensor parallelism
     step, state = make_sharded_train_step(
         net, opt, lambda out, labels: ce(out, labels[0]), mesh=mesh)
 

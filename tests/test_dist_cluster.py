@@ -23,7 +23,8 @@ def _free_port():
         return s.getsockname()[1]
 
 
-def _run_cluster(nproc, out_path, log_dir, steps=5, timeout=420):
+def _run_cluster(nproc, out_path, log_dir, steps=5, timeout=420,
+                 mode="dp"):
     env = dict(os.environ,
                PYTHONPATH=REPO,
                JAX_PLATFORMS="cpu",
@@ -40,7 +41,7 @@ def _run_cluster(nproc, out_path, log_dir, steps=5, timeout=420):
                "--nproc_per_node", str(nproc),
                "--started_port", str(_free_port()),
                "--log_dir", log_dir,
-               SCRIPT, out_path, str(steps)]
+               SCRIPT, out_path, str(steps), mode]
         r = subprocess.run(cmd, env=env, cwd=REPO, capture_output=True,
                            text=True, timeout=timeout)
         if r.returncode == 0 or attempt == 1:
@@ -67,6 +68,26 @@ def test_cluster_loss_parity(nproc, tmp_path):
     np.testing.assert_allclose(m["losses"], s["losses"],
                                rtol=2e-4, atol=2e-5)
     # losses must actually train
+    assert s["losses"][-1] < s["losses"][0]
+
+
+def test_cluster_tensor_parallel_loss_parity(tmp_path):
+    """mp=2 ACROSS real processes: column/row-parallel matmul partials
+    reduce over the cross-process (Gloo) mesh; losses must match the
+    same model run in one process."""
+    single = str(tmp_path / "single.json")
+    multi = str(tmp_path / "multi.json")
+    r1 = _run_cluster(1, single, str(tmp_path / "log1"), mode="mp")
+    assert r1.returncode == 0, (r1.stdout[-1500:], r1.stderr[-1500:])
+    r2 = _run_cluster(2, multi, str(tmp_path / "log2"), mode="mp")
+    assert r2.returncode == 0, (r2.stdout[-1500:], r2.stderr[-1500:])
+    with open(single) as f:
+        s = json.load(f)
+    with open(multi) as f:
+        m = json.load(f)
+    assert m["n_devices"] == 2
+    np.testing.assert_allclose(m["losses"], s["losses"],
+                               rtol=2e-4, atol=2e-5)
     assert s["losses"][-1] < s["losses"][0]
 
 
