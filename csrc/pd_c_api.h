@@ -1,0 +1,36 @@
+// C inference API header (reference paddle/fluid/inference/capi/
+// paddle_c_api.h). Implemented by inference_capi.cc; consumed by ctypes
+// (tests/test_capi.py), the Go binding (go/paddle/) and any C caller.
+#ifndef PD_C_API_H_
+#define PD_C_API_H_
+
+#include <stdint.h>
+
+#ifdef __cplusplus
+extern "C" {
+#endif
+
+typedef struct PD_Predictor PD_Predictor;
+
+// Last error message from any failed call (never NULL).
+const char* PD_GetLastError();
+
+// Create a predictor from a saved model prefix ({prefix}.pdmodel /
+// {prefix}.pdiparams, as written by jit.save). NULL on failure.
+PD_Predictor* PD_NewPredictor(const char* model_prefix);
+
+// Run with one float32 input tensor. *out_data is malloc'd (free with
+// PD_FreeBuffer); out_shape must hold 8 dims. Returns 0 on success.
+int PD_PredictorRun(PD_Predictor* pred, const float* input,
+                    const int64_t* shape, int ndim, float** out_data,
+                    int64_t* out_shape, int* out_ndim);
+
+void PD_FreeBuffer(void* p);
+
+void PD_DeletePredictor(PD_Predictor* pred);
+
+#ifdef __cplusplus
+}  // extern "C"
+#endif
+
+#endif  // PD_C_API_H_

@@ -1,0 +1,22 @@
+// Package paddle is the Go inference binding (reference
+// go/paddle/config.go — cgo over paddle_c_api.h; here over csrc/pd_c_api.h
+// backed by the XLA predictor).
+package paddle
+
+// Config holds predictor creation options. The reference exposes dozens of
+// AnalysisConfig knobs (GPU memory, IR passes, TensorRT); on TPU the XLA
+// runtime owns those decisions, so the surface is the model location.
+type Config struct {
+	modelPrefix string
+}
+
+// SetModel points the config at a saved model ({prefix}.pdmodel +
+// {prefix}.pdiparams, written by jit.save / save_inference_model).
+func (c *Config) SetModel(prefix string) {
+	c.modelPrefix = prefix
+}
+
+// Model returns the configured model prefix.
+func (c *Config) Model() string {
+	return c.modelPrefix
+}

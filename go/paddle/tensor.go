@@ -1,0 +1,32 @@
+// Tensor type for the Go binding (reference go/paddle/tensor.go).
+package paddle
+
+import "fmt"
+
+// Tensor is a dense float32 tensor in row-major order.
+type Tensor struct {
+	Shape []int64
+	Data  []float32
+}
+
+// NewTensor builds a tensor and validates that len(data) matches shape.
+func NewTensor(shape []int64, data []float32) (*Tensor, error) {
+	n := int64(1)
+	for _, d := range shape {
+		n *= d
+	}
+	if int64(len(data)) != n {
+		return nil, fmt.Errorf("tensor data length %d != shape volume %d",
+			len(data), n)
+	}
+	return &Tensor{Shape: shape, Data: data}, nil
+}
+
+// Numel returns the element count.
+func (t *Tensor) Numel() int64 {
+	n := int64(1)
+	for _, d := range t.Shape {
+		n *= d
+	}
+	return n
+}

@@ -1,24 +1,14 @@
-"""paddle.onnx (reference `python/paddle/onnx/export.py` delegates to the
-external paddle2onnx package). That package isn't in this image; export()
-produces the framework's native serving artifact instead (StableHLO via
-jit.save) and raises a clear error for strict ONNX requests."""
+"""paddle.onnx — real ONNX export, no external packages.
+
+The reference (`python/paddle/onnx/export.py`) delegates to the separate
+paddle2onnx package, which walks the saved ProgramDesc. Here the exporter
+is native: the layer is traced to a jaxpr (the same trace XLA compiles)
+and each primitive maps to an ONNX-13 op; the protobuf wire format is
+emitted directly (`wire.py`/`proto.py`), so the export works in an image
+with neither `onnx` nor `protobuf` installed.
+"""
 from __future__ import annotations
 
-__all__ = ["export"]
+from .export import JaxprToOnnx, UnsupportedOnnxExport, export
 
-
-def export(layer, path, input_spec=None, opset_version=9,
-           enable_onnx_checker=True, **configs):
-    try:
-        import paddle2onnx  # noqa: F401
-    except ImportError:
-        import warnings
-        warnings.warn(
-            "paddle2onnx is unavailable in this offline image; exporting "
-            "the portable StableHLO serving artifact (jit.save) at the "
-            "same path instead — loadable with paddle_tpu.jit.load / the "
-            "inference predictor.")
-        from .. import jit
-        jit.save(layer, path, input_spec=input_spec)
-        return path + ".pdmodel"
-    raise NotImplementedError("paddle2onnx delegation not wired")
+__all__ = ["export", "JaxprToOnnx", "UnsupportedOnnxExport"]

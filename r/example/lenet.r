@@ -1,0 +1,14 @@
+# LeNet inference from R (reference r/example/mobilenet.r).
+# Save a model first, e.g. in Python:
+#   import paddle_tpu as paddle
+#   from paddle_tpu.vision.models import LeNet
+#   from paddle_tpu.static import InputSpec
+#   paddle.jit.save(LeNet(), "/tmp/lenet",
+#                   input_spec=[InputSpec([1, 1, 28, 28], "float32", "x")])
+
+source(file.path(dirname(sys.frame(1)$ofile), "..", "paddle_infer.R"))
+
+predictor <- pd_create_predictor("/tmp/lenet")
+img <- array(runif(1 * 1 * 28 * 28), c(1, 1, 28, 28))
+logits <- pd_run(predictor, img)[[1]]
+cat("predicted class:", which.max(logits) - 1, "\n")

@@ -1,0 +1,21 @@
+# R inference binding (reference r/example/mobilenet.r drives the Python
+# API through reticulate; same approach here over paddle_tpu.inference).
+#
+#   source("r/paddle_infer.R")
+#   predictor <- pd_create_predictor("path/to/model_prefix")
+#   out <- pd_run(predictor, array(runif(1*3*224*224), c(1, 3, 224, 224)))
+
+library(reticulate)
+
+pd_create_predictor <- function(model_prefix) {
+  inference <- import("paddle_tpu.inference")
+  config <- inference$Config(model_prefix)
+  inference$create_predictor(config)
+}
+
+pd_run <- function(predictor, x) {
+  np <- import("numpy")
+  arr <- np$asarray(x, dtype = "float32")
+  outs <- predictor$run(list(arr))
+  lapply(outs, function(o) py_to_r(np$asarray(o)))
+}

@@ -105,3 +105,48 @@ def test_c_program_runs_saved_model(tmp_path):
         oshape = np.fromfile(f, dtype=np.int64, count=ondim)
         out = np.fromfile(f, dtype=np.float32).reshape(oshape)
     np.testing.assert_allclose(out, ref, rtol=1e-5, atol=1e-6)
+
+
+class TestLanguageBindings:
+    """Go/R bindings (reference `go/paddle/*.go`, `r/`): no Go toolchain or
+    R runtime in this image, so validate the bindings statically — every C
+    symbol the cgo layer references must exist in the built .so and be
+    declared in pd_c_api.h."""
+
+    def _cgo_symbols(self):
+        import re
+        syms = set()
+        go_dir = os.path.join(REPO, "go", "paddle")
+        for fn in os.listdir(go_dir):
+            if fn.endswith(".go"):
+                with open(os.path.join(go_dir, fn)) as f:
+                    # function calls only — C.PD_Predictor is a type
+                    syms |= set(re.findall(r"C\.(PD_\w+)\(", f.read()))
+        return syms
+
+    def test_go_symbols_exist_in_library(self):
+        if not os.path.exists(LIB):
+            pytest.skip("libpd_infer_capi.so not built")
+        out = subprocess.run(["nm", "-D", LIB], capture_output=True,
+                             text=True, check=True).stdout
+        exported = {line.split()[-1] for line in out.splitlines()
+                    if " T " in line}
+        syms = self._cgo_symbols()
+        assert syms, "no C.PD_* references found in go/paddle"
+        missing = syms - exported
+        assert not missing, f"cgo references unexported symbols: {missing}"
+
+    def test_header_declares_all_symbols(self):
+        with open(os.path.join(CSRC, "pd_c_api.h")) as f:
+            header = f.read()
+        for sym in self._cgo_symbols():
+            assert sym in header, f"{sym} missing from pd_c_api.h"
+
+    def test_r_binding_targets_real_api(self):
+        """The R shim drives the same Python inference API the C layer
+        embeds; check the functions it calls exist."""
+        with open(os.path.join(REPO, "r", "paddle_infer.R")) as f:
+            src = f.read()
+        assert 'import("paddle_tpu.inference")' in src
+        import paddle_tpu.inference as inf
+        assert hasattr(inf, "Config") and hasattr(inf, "create_predictor")
