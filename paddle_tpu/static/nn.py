@@ -4,6 +4,8 @@ Each builds the same Layers the dygraph API uses; in static mode their ops
 record into the current Program."""
 from __future__ import annotations
 
+import contextlib
+
 from .. import nn as _nn
 from ..nn import functional as F
 
@@ -111,14 +113,54 @@ def dropout(x, dropout_prob=0.5, is_test=False, name=None, **kwargs):
 # branches traced; carried shapes static).
 # ---------------------------------------------------------------------------
 
+def _maybe_sub_blocks(branches):
+    """In static mode, trace each branch into a child Block of the current
+    Program (reference conditional_block/while ops carry a `sub_block`
+    BlockDesc index) so the nested structure is inspectable/serializable.
+    Execution still lowers the fused lax op recorded in the parent block.
+
+    Returns (attrs, external_vars): the sub_block attr dict plus the
+    parent-scope Variables the branches capture — the caller must pass
+    those as explicit op inputs (reference conditional_block Input(X)) and
+    substitute their values at trace time via `_substituted`, otherwise
+    the lowered op would bake in the build-time placeholder values."""
+    from ..framework import autograd
+    from .program import default_main_program, in_static_mode
+    if not in_static_mode() or autograd.in_trace_mode():
+        return {}, []
+    prog = default_main_program()
+    attrs, ext = {}, {}
+    for name, fn in branches:
+        idx, blk_ext = prog._record_sub_block(fn)
+        attrs[name] = idx
+        ext.update(blk_ext)
+    return attrs, list(ext.values())
+
+
+@contextlib.contextmanager
+def _substituted(ext_vars, values):
+    """Temporarily swap the captured Variables' placeholder values for the
+    traced/fed values while lax traces the branch closures."""
+    saved = [(v, v._value) for v in ext_vars]
+    for v, val in zip(ext_vars, values):
+        v._value = val
+    try:
+        yield
+    finally:
+        for v, old in saved:
+            v._value = old
+
+
 def cond(pred, true_fn=None, false_fn=None, name=None):
     import jax
-    from ..framework.functional import tree_unwrap, tree_wrap
-    from ..framework.tensor import Tensor, apply_op
-
     from ..framework.autograd import trace_mode
+    from ..framework.functional import tree_unwrap
+    from ..framework.tensor import apply_op
 
-    def impl(p):
+    attrs, ext = _maybe_sub_blocks([("sub_block", true_fn),
+                                    ("sub_block_false", false_fn)])
+
+    def impl(p, *ext_vals, **_attrs):
         def tf(_):
             with trace_mode():
                 return tree_unwrap(true_fn())
@@ -126,16 +168,18 @@ def cond(pred, true_fn=None, false_fn=None, name=None):
         def ff(_):
             with trace_mode():
                 return tree_unwrap(false_fn())
-        return jax.lax.cond(p, tf, ff, operand=None)
-    return apply_op("cond", impl, (pred,), {})
+        with _substituted(ext, ext_vals):
+            return jax.lax.cond(p, tf, ff, operand=None)
+
+    return apply_op("cond", impl, (pred, *ext), attrs)
 
 
 def while_loop(cond_fn, body_fn, loop_vars, is_test=False, name=None):
     import jax
-    from ..framework.functional import tree_unwrap, tree_wrap
-    from ..framework.tensor import Tensor
-
     from ..framework.autograd import trace_mode
+    from ..framework.functional import tree_unwrap, tree_wrap
+    from ..framework.tensor import Tensor, apply_op
+    from .program import in_static_mode
 
     raw = tree_unwrap(loop_vars)
 
@@ -148,6 +192,41 @@ def while_loop(cond_fn, body_fn, loop_vars, is_test=False, name=None):
         with trace_mode():
             out = body_fn(*tree_wrap(state))
         return tree_unwrap(out)
+
+    from ..framework import autograd
+    if in_static_mode() and not autograd.in_trace_mode():
+        # record ONE `while` op into the Program (plus sub-blocks mirroring
+        # body/condition) — replay through Executor.run stays feed-
+        # dependent; the old direct-eager path would bake the placeholder
+        # result in as a constant
+        flat, treedef = jax.tree_util.tree_flatten(
+            tuple(loop_vars), is_leaf=lambda x: isinstance(x, Tensor))
+        attrs, ext = _maybe_sub_blocks([
+            ("sub_block", lambda: body_fn(*loop_vars)),
+            ("cond_block", lambda: cond_fn(*loop_vars))])
+        loop_slots = {getattr(t, "slot", None) for t in flat}
+        ext = [v for v in ext if v.slot not in loop_slots]
+        n = len(flat)
+
+        def impl(*vals, **_attrs):
+            state = jax.tree_util.tree_unflatten(treedef, vals[:n])
+            ext_vals = vals[n:]
+
+            # fresh closures per trace: lax caches the cond/body jaxpr by
+            # function identity, so reusing `c`/`b` across impl calls
+            # would bake the first trace's captured values in as consts
+            def c2(st):
+                with _substituted(ext, ext_vals):
+                    return c(st)
+
+            def b2(st):
+                with _substituted(ext, ext_vals):
+                    return b(st)
+            out = jax.lax.while_loop(c2, b2, state)
+            return tuple(jax.tree_util.tree_leaves(out))
+        outs = apply_op("while", impl, (*flat, *ext), attrs)
+        outs = outs if isinstance(outs, tuple) else (outs,)
+        return jax.tree_util.tree_unflatten(treedef, list(outs))
 
     out = jax.lax.while_loop(c, b, tuple(raw))
     return tree_wrap(out)
@@ -170,7 +249,19 @@ def switch_case(branch_index, branch_fns, default=None, name=None):
     elif fns and isinstance(fns[0], tuple):
         fns = [f for _, f in sorted(fns)]
 
-    def impl(idx):
-        return jax.lax.switch(idx, [lambda _, f=f: tree_unwrap(f())
-                                    for f in fns], None)
-    return apply_op("switch_case", impl, (branch_index,), {})
+    attrs, ext = _maybe_sub_blocks([(f"sub_block_{i}", f)
+                                    for i, f in enumerate(fns)])
+
+    from ..framework.autograd import trace_mode
+
+    def _branch(f):
+        def run(_):
+            with trace_mode():
+                return tree_unwrap(f())
+        return run
+
+    def impl(idx, *ext_vals, **_attrs):
+        with _substituted(ext, ext_vals):
+            return jax.lax.switch(idx, [_branch(f) for f in fns], None)
+
+    return apply_op("switch_case", impl, (branch_index, *ext), attrs)

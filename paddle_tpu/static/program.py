@@ -48,6 +48,15 @@ class _SlotCounter:
 _slot_counter = _SlotCounter()
 
 
+def _flatten_tensors(obj):
+    """Tensor leaves of a branch-fn return, via the canonical pytree
+    traversal."""
+    from ..framework.tensor import Tensor
+    leaves = jax.tree_util.tree_leaves(
+        obj, is_leaf=lambda x: isinstance(x, Tensor))
+    return [x for x in leaves if isinstance(x, Tensor)]
+
+
 class Variable(Tensor):
     """A static-graph variable: a Tensor whose value is a placeholder zeros
     array (for shape/dtype propagation during graph building) plus an SSA
@@ -61,6 +70,9 @@ class Variable(Tensor):
 
 
 class _Op:
+    """One recorded op (the OpDesc analogue: reference
+    `framework/op_desc.h:32` — type + attrs + input/output wiring)."""
+
     __slots__ = ("name", "fn", "in_refs", "out_slots", "attrs")
 
     def __init__(self, name, fn, in_refs, out_slots, attrs=None):
@@ -70,18 +82,143 @@ class _Op:
         self.out_slots = out_slots
         self.attrs = attrs or {}  # inspectable op attributes (OpDesc parity)
 
+    # OpDesc-parity introspection surface
+    @property
+    def type(self):
+        return self.name
+
+    def has_attr(self, name):
+        return name in self.attrs
+
+    def attr(self, name):
+        return self.attrs[name]
+
+    def all_attrs(self):
+        return dict(self.attrs)
+
+    @property
+    def input_slots(self):
+        return [ref for tag, ref in self.in_refs if tag == "s"]
+
+    def __repr__(self):
+        return f"_Op({self.name}: {self.input_slots} -> {self.out_slots})"
+
+
+class Block:
+    """reference `framework/block_desc.h:40` / Python `fluid/framework.py`
+    Block: an op list + a variable table, with parent nesting. Block 0 is
+    the executed program; sub-blocks mirror control-flow branches
+    (conditional_block/while sub_block attrs in the reference) for
+    introspection and serialization — execution stays whole-program XLA."""
+
+    def __init__(self, program, idx, parent_idx=-1):
+        self.program = program
+        self.idx = idx
+        self.parent_idx = parent_idx
+        self.ops: List[_Op] = []
+        self.vars: Dict[int, Variable] = {}
+
+    def var(self, name):
+        for v in self.vars.values():
+            if getattr(v, "name", None) == name:
+                return v
+        raise ValueError(f"no variable named {name!r} in block {self.idx}")
+
+    def all_parameters(self):
+        return self.program.all_parameters()
+
+    @property
+    def parent_block(self):
+        return (self.program.blocks[self.parent_idx]
+                if self.parent_idx >= 0 else None)
+
+    def __repr__(self):
+        return (f"Block(idx={self.idx}, parent={self.parent_idx}, "
+                f"{len(self.ops)} ops)")
+
 
 class Program:
     def __init__(self):
-        self.ops: List[_Op] = []
-        self.vars: Dict[int, Variable] = {}
+        self.blocks: List[Block] = [Block(self, 0)]
+        self._cur_block_idx = 0
         self.feed_vars: Dict[str, Variable] = {}
         self.param_vars: Dict[str, Variable] = {}
         self.random_ops = False
         self._opt_hooks: List[Callable] = []
 
+    # ops/vars live on block 0 (the executed block); properties keep the
+    # flat-program view every consumer (lowering, passes, serde) uses
+    @property
+    def ops(self) -> List[_Op]:
+        return self.blocks[0].ops
+
+    @ops.setter
+    def ops(self, value):
+        self.blocks[0].ops = value
+
+    @property
+    def vars(self) -> Dict[int, Variable]:
+        return self.blocks[0].vars
+
+    @vars.setter
+    def vars(self, value):
+        self.blocks[0].vars = value
+
+    @property
+    def num_blocks(self):
+        return len(self.blocks)
+
+    def block(self, idx):
+        return self.blocks[idx]
+
+    def current_block(self):
+        return self.blocks[self._cur_block_idx]
+
+    def _record_sub_block(self, fn, args=()):
+        """Trace `fn` with recording redirected into a fresh child Block.
+
+        Returns (block_idx, external_vars): the block index (the
+        reference's sub_block attr value) and the parent-block Variables
+        the branch consumes or returns — the control-flow op must take
+        those as explicit inputs (reference conditional_block's Input(X))
+        so lowering substitutes fed/updated values for the placeholders
+        the branch closures captured."""
+        blk = Block(self, len(self.blocks), parent_idx=self._cur_block_idx)
+        self.blocks.append(blk)
+        prev = self._cur_block_idx
+        self._cur_block_idx = blk.idx
+        try:
+            ret = fn(*args)
+        finally:
+            self._cur_block_idx = prev
+        produced = {s for op in blk.ops for s in op.out_slots}
+        ext: Dict[int, Variable] = {}
+        for op in blk.ops:
+            for tag, ref in op.in_refs:
+                if tag == "s" and ref not in produced:
+                    # captured Parameters promote into block 0, not the
+                    # sub-block — search both so branch weights become
+                    # explicit inputs (else optimizer updates would never
+                    # reach the lowered branch)
+                    v = blk.vars.get(ref)
+                    if v is None:
+                        v = self._find_var(ref)
+                    if v is not None:
+                        ext[ref] = v
+        for leaf in _flatten_tensors(ret):
+            if hasattr(leaf, "slot") and leaf.slot not in produced:
+                ext[leaf.slot] = leaf
+        return blk.idx, ext
+
+    def _find_var(self, slot):
+        for b in self.blocks:
+            if slot in b.vars:
+                return b.vars[slot]
+        return None
+
     def record(self, name, fn, inputs, output_tensors, attrs=None):
         from ..framework.tensor import Parameter
+        blk = self.current_block()
         in_refs = []
         for t in inputs:
             if isinstance(t, Parameter):
@@ -89,24 +226,24 @@ class Program:
                 if not hasattr(t, "slot"):
                     t.slot = next(_slot_counter)
                     self.param_vars[t.name] = t
-                    self.vars[t.slot] = t
+                    self.blocks[0].vars[t.slot] = t
                     _state.scope[t.name] = np.asarray(t._value)
                 in_refs.append(("s", t.slot))
             elif isinstance(t, Variable):
                 in_refs.append(("s", t.slot))
-                self.vars[t.slot] = t
+                blk.vars[t.slot] = t
             else:
                 in_refs.append(("c", t._value))
         out_slots = [t.slot for t in output_tensors]
         for t in output_tensors:
-            self.vars[t.slot] = t
-        self.ops.append(_Op(name, fn, in_refs, out_slots, attrs))
+            blk.vars[t.slot] = t
+        blk.ops.append(_Op(name, fn, in_refs, out_slots, attrs))
 
     def clone(self, for_test=False):
         return self
 
     def global_block(self):
-        return self
+        return self.blocks[0]
 
     def all_parameters(self):
         return list(self.param_vars.values())
@@ -135,6 +272,14 @@ class Program:
         out.param_vars = {n: v for n, v in self.param_vars.items()
                           if v.slot in live}
         out._opt_hooks = list(self._opt_hooks)
+        # kept control-flow ops hold sub_block indices — carry all
+        # sub-blocks so those attrs stay resolvable (indices must not
+        # shift, so none are dropped even if their op was pruned)
+        for b in self.blocks[1:]:
+            nb = Block(out, b.idx, b.parent_idx)
+            nb.ops = list(b.ops)
+            nb.vars = dict(b.vars)
+            out.blocks.append(nb)
         return out
 
     # -- serialization (reference ProgramDesc.SerializeToString) ----------

@@ -97,13 +97,25 @@ def program_to_doc(program, scope: Optional[Dict[str, np.ndarray]] = None,
         for s in op.out_slots:
             note_var(s)
         exported = jexport.export(jax.jit(op.fn))(*avals)
+        try:
+            blob = exported.serialize(vjp_order=1)
+        except Exception as e:
+            # lax.while_loop has no reverse-mode rule — forward-only is
+            # expected for `while` ops. Anything else is a lossy export
+            # the user must hear about now, not at load+grad time.
+            if op.name != "while":
+                import warnings
+                warnings.warn(
+                    f"op '{op.name}' exported WITHOUT gradient support "
+                    f"(vjp serialization failed: {e}); append_backward "
+                    "on the loaded Program will not differentiate it")
+            blob = exported.serialize(vjp_order=0)
         ops.append({
             "type": op.name,
             "attrs": _json_safe_attrs(getattr(op, "attrs", None)),
             "inputs": in_docs,
             "outputs": list(op.out_slots),
-            "stablehlo_b64": base64.b64encode(
-                exported.serialize(vjp_order=1)).decode("ascii"),
+            "stablehlo_b64": base64.b64encode(blob).decode("ascii"),
         })
 
     doc = {
@@ -113,6 +125,21 @@ def program_to_doc(program, scope: Optional[Dict[str, np.ndarray]] = None,
         "feed_vars": {n: v.slot for n, v in program.feed_vars.items()},
         "param_vars": {n: v.slot for n, v in program.param_vars.items()},
     }
+    # control-flow sub-blocks (reference BlockDesc nesting): structural
+    # mirror only — execution replays block 0, whose fused lax op already
+    # contains the branch computations
+    if getattr(program, "num_blocks", 1) > 1:
+        doc["blocks"] = [{
+            "idx": b.idx,
+            "parent_idx": b.parent_idx,
+            "ops": [{
+                "type": op.name,
+                "attrs": _json_safe_attrs(op.attrs),
+                "inputs": [["s", ref] if tag == "s" else
+                           ["c", _npy_b64(ref)] for tag, ref in op.in_refs],
+                "outputs": list(op.out_slots),
+            } for op in b.ops],
+        } for b in program.blocks[1:]]
     if hasattr(program, "_loss_slot"):
         doc["loss_slot"] = program._loss_slot
     if include_params and scope is not None:
@@ -168,6 +195,18 @@ def program_from_doc(doc) -> Tuple[Any, Dict[str, np.ndarray]]:
         op = _Op(od["type"], exported.call, in_refs, list(od["outputs"]))
         op.attrs = od.get("attrs") or {}
         program.ops.append(op)
+
+    from .program import Block
+    for bd in doc.get("blocks") or []:
+        blk = Block(program, bd["idx"], bd["parent_idx"])
+        for od in bd["ops"]:
+            in_refs = [("s", int(r)) if t == "s" else
+                       ("c", jnp.asarray(_npy_unb64(r)))
+                       for t, r in od["inputs"]]
+            op = _Op(od["type"], None, in_refs, list(od["outputs"]),
+                     od.get("attrs") or {})
+            blk.ops.append(op)
+        program.blocks.append(blk)
 
     params = {n: _npy_unb64(d) for n, d in (doc.get("params") or {}).items()}
     program._doc_extra = doc.get("extra") or {}

@@ -1,0 +1,193 @@
+"""Block-structured Program IR (reference `framework/block_desc.h:40`,
+Python `fluid/framework.py` Program/Block/Operator): control-flow ops
+carry sub-block mirrors, OpDesc-style introspection, serde preserves
+nesting, and static while replay stays feed-dependent."""
+import numpy as np
+
+import paddle_tpu as paddle
+from paddle_tpu import static
+from paddle_tpu.static.nn import cond, while_loop
+
+
+def _fresh_programs():
+    return static.Program(), static.Program()
+
+
+def test_cond_records_sub_blocks():
+    paddle.enable_static()
+    try:
+        main, startup = _fresh_programs()
+        with static.program_guard(main, startup):
+            x = static.data("x", [4], "float32")
+            out = cond(x.sum() > 0, lambda: x * 2.0, lambda: x - 1.0)
+        assert main.num_blocks == 3          # global + true + false
+        op = main.ops[-1]
+        assert op.type == "cond"
+        tb, fb = op.attr("sub_block"), op.attr("sub_block_false")
+        assert {tb, fb} == {1, 2}
+        # branch bodies were mirrored into the sub-blocks
+        assert main.block(tb).ops and main.block(fb).ops
+        assert main.block(tb).parent_idx == 0
+        types = [o.type for o in main.block(tb).ops]
+        assert any(t in ("scale", "multiply", "elementwise_mul", "mul")
+                   for t in types), types
+
+        exe = static.Executor()
+        pos, = exe.run(main, feed={"x": np.ones(4, "float32")},
+                       fetch_list=[out])
+        neg, = exe.run(main, feed={"x": -np.ones(4, "float32")},
+                       fetch_list=[out])
+        np.testing.assert_allclose(pos, 2.0 * np.ones(4), rtol=1e-6)
+        np.testing.assert_allclose(neg, -2.0 * np.ones(4), rtol=1e-6)
+    finally:
+        paddle.disable_static()
+
+
+def test_static_while_is_feed_dependent():
+    """Regression: the old direct-eager while_loop baked the placeholder
+    result into the Program as a constant."""
+    paddle.enable_static()
+    try:
+        main, startup = _fresh_programs()
+        with static.program_guard(main, startup):
+            x = static.data("x", [1], "float32")
+            i0 = paddle.zeros([1], "int32")
+            iN, acc = while_loop(
+                lambda i, s: (i < 3).all(),
+                lambda i, s: (i + 1, s + x),
+                (i0, paddle.zeros([1], "float32")))
+        wop = [op for op in main.ops if op.type == "while"]
+        assert len(wop) == 1
+        assert wop[0].has_attr("sub_block")
+        assert main.block(wop[0].attr("sub_block")).ops
+
+        exe = static.Executor()
+        a, = exe.run(main, feed={"x": np.asarray([2.0], "float32")},
+                     fetch_list=[acc])
+        b, = exe.run(main, feed={"x": np.asarray([5.0], "float32")},
+                     fetch_list=[acc])
+        assert float(a[0]) == 6.0
+        assert float(b[0]) == 15.0
+    finally:
+        paddle.disable_static()
+
+
+def test_block_var_lookup_and_operator_surface():
+    paddle.enable_static()
+    try:
+        main, startup = _fresh_programs()
+        with static.program_guard(main, startup):
+            x = static.data("x", [2, 3], "float32")
+            y = x * 3.0
+        blk = main.global_block()
+        assert blk.idx == 0 and blk.parent_block is None
+        assert blk.var("x") is x
+        op = main.ops[-1]
+        assert op.out_slots == [y.slot]
+        assert x.slot in op.input_slots
+        assert isinstance(op.all_attrs(), dict)
+    finally:
+        paddle.disable_static()
+
+
+def test_serde_preserves_block_structure(tmp_path):
+    paddle.enable_static()
+    try:
+        main, startup = _fresh_programs()
+        with static.program_guard(main, startup):
+            x = static.data("x", [2], "float32")
+            out = cond(x.sum() > 0, lambda: x * 2.0, lambda: x * -1.0)
+        path = str(tmp_path / "prog.json")
+        main.save(path)
+        loaded, _ = static.Program.load(path)
+        assert loaded.num_blocks == main.num_blocks == 3
+        lop = loaded.ops[-1]
+        assert lop.type == "cond"
+        assert loaded.block(lop.attr("sub_block")).ops
+        # loaded program still executes (block-0 fused lax op replays)
+        exe = static.Executor()
+        got, = exe.run(loaded, feed={"x": np.asarray([1., 1.], "float32")},
+                       fetch_list=[loaded.vars[out.slot]])
+        np.testing.assert_allclose(got, [2., 2.], rtol=1e-6)
+    finally:
+        paddle.disable_static()
+
+
+def test_dygraph_control_flow_unchanged():
+    x = paddle.to_tensor(3.0)
+    out = cond(x > 2, lambda: x * 2, lambda: x - 1)
+    assert float(out) == 6.0
+    i2, s2 = while_loop(lambda i, s: i < 5,
+                        lambda i, s: (i + 1, s + 2.0),
+                        (paddle.to_tensor(0), paddle.to_tensor(0.0)))
+    assert int(i2) == 5 and float(s2) == 10.0
+
+
+def test_branch_captured_parameters_stay_live():
+    """Review regression: nn.Layer weights used inside a branch must be
+    explicit op inputs, so optimizer/scope updates reach the lowered
+    branch and grads flow."""
+    paddle.enable_static()
+    try:
+        main, startup = _fresh_programs()
+        with static.program_guard(main, startup):
+            lin = paddle.nn.Linear(2, 2)
+            x = static.data("x", [1, 2], "float32")
+            out = cond(x.sum() > -1e9, lambda: lin(x), lambda: x)
+        cop = main.ops[-1]
+        param_slots = {p.slot for p in main.all_parameters()}
+        assert param_slots & set(cop.input_slots), \
+            "branch-captured parameters missing from cond op inputs"
+
+        exe = static.Executor()
+        xv = np.ones((1, 2), "float32")
+        before, = exe.run(main, feed={"x": xv}, fetch_list=[out])
+        # simulate an optimizer step: overwrite weights in the scope
+        scope = static.global_scope()
+        wname = [n for n in main.param_vars
+                 if scope[n].shape == (2, 2)][0]
+        bname = [n for n in main.param_vars
+                 if scope[n].shape == (2,)][0]
+        scope[wname] = scope[wname] * 0.0
+        scope[bname] = scope[bname] * 0.0 + 7.0
+        after, = exe.run(main, feed={"x": xv}, fetch_list=[out])
+        np.testing.assert_allclose(after, np.full((1, 2), 7.0), rtol=1e-6)
+        assert not np.allclose(before, after)
+    finally:
+        paddle.disable_static()
+
+
+def test_static_while_nested_pytree_loop_vars():
+    paddle.enable_static()
+    try:
+        main, startup = _fresh_programs()
+        with static.program_guard(main, startup):
+            x = static.data("x", [1], "float32")
+            i0 = paddle.zeros([1], "int32")
+            state = {"s": paddle.zeros([1], "float32")}
+            iN, stN = while_loop(
+                lambda i, st: (i < 3).all(),
+                lambda i, st: (i + 1, {"s": st["s"] + x}), (i0, state))
+        assert isinstance(stN, dict) and "s" in stN
+        exe = static.Executor()
+        got, = exe.run(main, feed={"x": np.asarray([4.0], "float32")},
+                       fetch_list=[stN["s"]])
+        assert float(got[0]) == 12.0
+    finally:
+        paddle.disable_static()
+
+
+def test_prune_keeps_sub_block_attrs_resolvable():
+    paddle.enable_static()
+    try:
+        main, startup = _fresh_programs()
+        with static.program_guard(main, startup):
+            x = static.data("x", [2], "float32")
+            out = cond(x.sum() > 0, lambda: x * 2.0, lambda: x * -1.0)
+        pruned = main.prune([out])
+        cop = [op for op in pruned.ops if op.type == "cond"][0]
+        sb = pruned.block(cop.attr("sub_block"))
+        assert sb.ops, "pruned program lost the cond sub-block"
+        assert pruned.num_blocks == main.num_blocks
+    finally:
+        paddle.disable_static()

@@ -1,0 +1,97 @@
+"""On-chip flash-attention tuning sweep.
+
+Times the Pallas flash kernel (fwd and fwd+bwd) across block sizes and
+MXU input precision against XLA's fused dense attention, on the GPT
+long-seq bench shape. Drives the block-size/precision choices baked into
+ops/pallas_ops.py. Run on the real chip: `python tools/perf_flash_sweep.py`.
+"""
+import functools
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from paddle_tpu.ops import pallas_ops as P
+
+B, H, S, D = 4, 12, 2048, 64
+CAUSAL = True
+SCALE = 1.0 / (D ** 0.5)
+
+
+def timeit(fn, *args, iters=20):
+    out = fn(*args)
+    jax.block_until_ready(out)
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        out = fn(*args)
+    jax.block_until_ready(out)
+    return (time.perf_counter() - t0) / iters * 1e3  # ms
+
+
+def dense_ref(q, k, v):
+    s = jnp.einsum("bhqd,bhkd->bhqk", q, k).astype(jnp.float32) * SCALE
+    if CAUSAL:
+        mask = np.tril(np.ones((S, S), bool))
+        s = jnp.where(mask, s, -1e30)
+    p = jax.nn.softmax(s, axis=-1).astype(q.dtype)
+    return jnp.einsum("bhqk,bhkd->bhqd", p, v)
+
+
+def main():
+    rng = np.random.RandomState(0)
+    q = jnp.asarray(rng.randn(B, H, S, D), jnp.bfloat16)
+    k = jnp.asarray(rng.randn(B, H, S, D), jnp.bfloat16)
+    v = jnp.asarray(rng.randn(B, H, S, D), jnp.bfloat16)
+    bias = jnp.zeros((B, S), jnp.float32)
+    seed = jnp.zeros((), jnp.int32)
+
+    def flash(bq, bk):
+        def f(q, k, v):
+            out, _ = P._flash_call(q, k, v, bias, seed, CAUSAL, SCALE,
+                                   0.0, bq, bk)
+            return out
+        return jax.jit(f)
+
+    def flash_grad(bq, bk):
+        def loss(q, k, v):
+            old_q, old_k = P._BLOCK_Q, P._BLOCK_K
+            return P.flash_attention_raw(q, k, v, bias, seed, CAUSAL,
+                                         SCALE, 0.0).astype(
+                                             jnp.float32).sum()
+        return jax.jit(jax.grad(loss, argnums=(0, 1, 2)))
+
+    def dense_grad():
+        def loss(q, k, v):
+            return dense_ref(q, k, v).astype(jnp.float32).sum()
+        return jax.jit(jax.grad(loss, argnums=(0, 1, 2)))
+
+    print(f"shape B{B} H{H} S{S} D{D} causal={CAUSAL} bf16")
+    t = timeit(jax.jit(dense_ref), q, k, v)
+    print(f"dense fwd:           {t:8.3f} ms")
+    tg = timeit(dense_grad(), q, k, v)
+    print(f"dense fwd+bwd:       {tg:8.3f} ms")
+
+    for bq, bk in [(128, 128), (256, 256), (256, 512), (512, 512),
+                   (512, 1024), (1024, 1024)]:
+        if S % bq or S % bk:
+            continue
+        try:
+            t = timeit(flash(bq, bk), q, k, v)
+            P._BLOCK_Q, P._BLOCK_K = bq, bk
+            tg = timeit(flash_grad(bq, bk), q, k, v)
+            print(f"flash bq={bq:4d} bk={bk:4d}: fwd {t:8.3f} ms   "
+                  f"fwd+bwd {tg:8.3f} ms")
+        except Exception as e:  # noqa: BLE001
+            print(f"flash bq={bq:4d} bk={bk:4d}: FAILED {type(e).__name__}: "
+                  f"{str(e)[:120]}")
+        finally:
+            P._BLOCK_Q, P._BLOCK_K = 128, 128
+
+
+if __name__ == "__main__":
+    main()
