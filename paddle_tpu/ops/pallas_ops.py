@@ -29,9 +29,19 @@ import numpy as np
 
 __all__ = ["flash_attention", "flash_attention_raw", "STATS"]
 
-_BLOCK_Q = 128
-_BLOCK_K = 128
+_BLOCK_MIN = 128        # alignment the kernels require of S_q / S_kv
+_BLOCK_Q = 512          # preferred tile sizes: the on-chip sweep
+_BLOCK_K = 512          # (tools/perf_flash_sweep.py, v5e, S=2048, bf16)
+                        # picked 512/512; with native-dtype MXU dots the
+                        # GPT seq-2048 bench runs 1.47x dense (bench.py)
 _NEG_INF = -1e30
+
+
+def _pick_blocks(Sq, Sk):
+    """Largest preferred tile that divides the sequence lengths."""
+    bq = max(b for b in (128, 256, _BLOCK_Q) if Sq % b == 0 and b <= Sq)
+    bk = max(b for b in (128, 256, _BLOCK_K) if Sk % b == 0 and b <= Sk)
+    return bq, bk
 
 from ..framework.monitor import stat_add as _stat_add, stat_get as _stat_get
 
@@ -110,7 +120,9 @@ def _fwd_kernel(seed_ref, q_ref, k_ref, v_ref, b_ref, o_ref, lse_ref, *,
                 scale, causal, block_k, dropout_p):
     bh = pl.program_id(0)
     qi = pl.program_id(1)
-    q = q_ref[:].astype(jnp.float32) * scale
+    # MXU dots run on the INPUT dtype (bf16 in production — 4x the f32
+    # path on v5e) with f32 accumulation; softmax stats stay f32
+    q = q_ref[:]
     S, D = k_ref.shape
     bq = q_ref.shape[0]
     nkb = S // block_k
@@ -119,10 +131,11 @@ def _fwd_kernel(seed_ref, q_ref, k_ref, v_ref, b_ref, o_ref, lse_ref, *,
 
     def body(kb, carry):
         m, l, acc = carry
-        k_blk = k_ref[pl.ds(kb * block_k, block_k), :].astype(jnp.float32)
-        v_blk = v_ref[pl.ds(kb * block_k, block_k), :].astype(jnp.float32)
+        k_blk = k_ref[pl.ds(kb * block_k, block_k), :]
+        v_blk = v_ref[pl.ds(kb * block_k, block_k), :]
         s = jax.lax.dot_general(q, k_blk, (((1,), (1,)), ((), ())),
-                                preferred_element_type=jnp.float32)
+                                preferred_element_type=jnp.float32,
+            precision=jax.lax.Precision.DEFAULT) * scale
         s += b_ref[0, pl.ds(kb * block_k, block_k)][None, :]  # b_ref [1,S]
         if causal:
             k_offs = kb * block_k + jax.lax.broadcasted_iota(
@@ -136,8 +149,9 @@ def _fwd_kernel(seed_ref, q_ref, k_ref, v_ref, b_ref, o_ref, lse_ref, *,
             keep = _dropout_bits(seed, bh, qi, kb, p.shape, dropout_p)
             p = jnp.where(keep, p / (1.0 - dropout_p), 0.0)
         acc_new = alpha * acc + jax.lax.dot_general(
-            p, v_blk, (((1,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32)
+            p.astype(v_blk.dtype), v_blk, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+            precision=jax.lax.Precision.DEFAULT)
         return m_new, l_new, acc_new
 
     m0 = jnp.full((bq, 1), _NEG_INF, jnp.float32)
@@ -158,7 +172,8 @@ def _fwd_kernel(seed_ref, q_ref, k_ref, v_ref, b_ref, o_ref, lse_ref, *,
 
 def _recompute_p(q, k_blk, bias_row, q_offs, k_offs, lse, scale, causal):
     s = jax.lax.dot_general(q, k_blk, (((1,), (1,)), ((), ())),
-                            preferred_element_type=jnp.float32) * scale
+                            preferred_element_type=jnp.float32,
+            precision=jax.lax.Precision.DEFAULT) * scale
     s += bias_row
     if causal:
         s = jnp.where(q_offs >= k_offs, s, _NEG_INF)
@@ -169,8 +184,8 @@ def _dq_kernel(seed_ref, q_ref, k_ref, v_ref, b_ref, do_ref, lse_ref,
                dl_ref, dq_ref, *, scale, causal, block_k, dropout_p):
     bh = pl.program_id(0)
     qi = pl.program_id(1)
-    q = q_ref[:].astype(jnp.float32)
-    do = do_ref[:].astype(jnp.float32)
+    q = q_ref[:]
+    do = do_ref[:]
     lse = lse_ref[:]          # [bq, 1]
     delta = dl_ref[:]         # [bq, 1]
     S, D = k_ref.shape
@@ -181,22 +196,24 @@ def _dq_kernel(seed_ref, q_ref, k_ref, v_ref, b_ref, do_ref, lse_ref,
     inv_keep = 1.0 / (1.0 - dropout_p) if dropout_p > 0.0 else 1.0
 
     def body(kb, dq):
-        k_blk = k_ref[pl.ds(kb * block_k, block_k), :].astype(jnp.float32)
-        v_blk = v_ref[pl.ds(kb * block_k, block_k), :].astype(jnp.float32)
+        k_blk = k_ref[pl.ds(kb * block_k, block_k), :]
+        v_blk = v_ref[pl.ds(kb * block_k, block_k), :]
         k_offs = kb * block_k + jax.lax.broadcasted_iota(
             jnp.int32, (1, block_k), 1)
         bias_row = b_ref[0, pl.ds(kb * block_k, block_k)][None, :]
         p = _recompute_p(q, k_blk, bias_row, q_offs, k_offs, lse, scale,
                          causal)
         dp = jax.lax.dot_general(do, v_blk, (((1,), (1,)), ((), ())),
-                                 preferred_element_type=jnp.float32)
+                                 preferred_element_type=jnp.float32,
+            precision=jax.lax.Precision.DEFAULT)
         if dropout_p > 0.0:
             keep = _dropout_bits(seed, bh, qi, kb, p.shape, dropout_p)
             dp = jnp.where(keep, dp * inv_keep, 0.0)
         ds = p * (dp - delta)
         return dq + jax.lax.dot_general(
-            ds, k_blk, (((1,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32)
+            ds.astype(k_blk.dtype), k_blk, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+            precision=jax.lax.Precision.DEFAULT)
 
     dq0 = jnp.zeros((bq, D), jnp.float32)
     if causal:
@@ -212,8 +229,8 @@ def _dkv_kernel(seed_ref, q_ref, k_ref, v_ref, b_ref, do_ref, lse_ref,
                 dropout_p):
     bh = pl.program_id(0)
     kb = pl.program_id(1)
-    k_blk = k_ref[:].astype(jnp.float32)    # [bk, D]
-    v_blk = v_ref[:].astype(jnp.float32)
+    k_blk = k_ref[:]                        # [bk, D]
+    v_blk = v_ref[:]
     S, D = q_ref.shape
     bk = k_ref.shape[0]
     nqb = S // block_q
@@ -224,8 +241,8 @@ def _dkv_kernel(seed_ref, q_ref, k_ref, v_ref, b_ref, do_ref, lse_ref,
 
     def body(qi, carry):
         dk, dv = carry
-        q = q_ref[pl.ds(qi * block_q, block_q), :].astype(jnp.float32)
-        do = do_ref[pl.ds(qi * block_q, block_q), :].astype(jnp.float32)
+        q = q_ref[pl.ds(qi * block_q, block_q), :]
+        do = do_ref[pl.ds(qi * block_q, block_q), :]
         lse = lse_ref[pl.ds(qi * block_q, block_q), :]
         delta = dl_ref[pl.ds(qi * block_q, block_q), :]
         q_offs = qi * block_q + jax.lax.broadcasted_iota(
@@ -233,7 +250,8 @@ def _dkv_kernel(seed_ref, q_ref, k_ref, v_ref, b_ref, do_ref, lse_ref,
         p = _recompute_p(q, k_blk, bias_row, q_offs, k_offs, lse, scale,
                          causal)
         dp = jax.lax.dot_general(do, v_blk, (((1,), (1,)), ((), ())),
-                                 preferred_element_type=jnp.float32)
+                                 preferred_element_type=jnp.float32,
+            precision=jax.lax.Precision.DEFAULT)
         if dropout_p > 0.0:
             keep = _dropout_bits(seed, bh, qi, kb, p.shape, dropout_p)
             pd = jnp.where(keep, p * inv_keep, 0.0)
@@ -241,10 +259,14 @@ def _dkv_kernel(seed_ref, q_ref, k_ref, v_ref, b_ref, do_ref, lse_ref,
         else:
             pd = p
         ds = p * (dp - delta)
-        dv = dv + jax.lax.dot_general(pd, do, (((0,), (0,)), ((), ())),
-                                      preferred_element_type=jnp.float32)
-        dk = dk + jax.lax.dot_general(ds, q, (((0,), (0,)), ((), ())),
-                                      preferred_element_type=jnp.float32)
+        dv = dv + jax.lax.dot_general(pd.astype(do.dtype), do,
+                                      (((0,), (0,)), ((), ())),
+                                      preferred_element_type=jnp.float32,
+            precision=jax.lax.Precision.DEFAULT)
+        dk = dk + jax.lax.dot_general(ds.astype(q.dtype), q,
+                                      (((0,), (0,)), ((), ())),
+                                      preferred_element_type=jnp.float32,
+            precision=jax.lax.Precision.DEFAULT)
         return dk, dv
 
     dk0 = jnp.zeros((bk, D), jnp.float32)
@@ -377,15 +399,17 @@ def flash_attention_raw(q, k, v, bias, seed, causal, scale, dropout_p):
 
 
 def _flash_fwd_rule(q, k, v, bias, seed, causal, scale, dropout_p):
+    bq, bk = _pick_blocks(q.shape[2], k.shape[2])
     out, lse = _flash_call(q, k, v, bias, seed, causal, scale, dropout_p,
-                           _BLOCK_Q, _BLOCK_K)
+                           bq, bk)
     return out, (q, k, v, bias, seed, out, lse)
 
 
 def _flash_bwd_rule(causal, scale, dropout_p, res, g):
     q, k, v, bias, seed, out, lse = res
+    bq, bk = _pick_blocks(q.shape[2], k.shape[2])
     dq, dk, dv = _flash_bwd_call(q, k, v, bias, seed, out, lse, g, causal,
-                                 scale, dropout_p, _BLOCK_Q, _BLOCK_K)
+                                 scale, dropout_p, bq, bk)
     dbias = jnp.zeros(bias.shape, jax.dtypes.float0) \
         if not jnp.issubdtype(bias.dtype, jnp.floating) \
         else jnp.zeros_like(bias)
@@ -417,7 +441,8 @@ def flash_supported(q_shape, k_shape=None, v_shape=None, mask=None,
         return False
     if is_causal and Sk != Sq:  # causal ranges assume aligned diagonals
         return False
-    if Sq % _BLOCK_Q != 0 or Sk % _BLOCK_K != 0 or D % 8 != 0 or D > 512:
+    if Sq % _BLOCK_MIN != 0 or Sk % _BLOCK_MIN != 0 or D % 8 != 0 \
+            or D > 512:
         return False
     if min_seq is None:
         from ..framework.flags import flag

@@ -1,11 +1,9 @@
-"""On-chip flash-attention tuning sweep.
+"""Flash sweep v3: on-device iteration chaining.
 
-Times the Pallas flash kernel (fwd and fwd+bwd) across block sizes and
-MXU input precision against XLA's fused dense attention, on the GPT
-long-seq bench shape. Drives the block-size/precision choices baked into
-ops/pallas_ops.py. Run on the real chip: `python tools/perf_flash_sweep.py`.
+One RPC dispatch per measurement; the op repeats CHAIN times inside the
+jit with a data dependency (q := out), so tunnel/dispatch overhead is
+amortized and the per-iteration time is the kernel's own.
 """
-import functools
 import os
 import sys
 import time
@@ -21,76 +19,92 @@ from paddle_tpu.ops import pallas_ops as P
 B, H, S, D = 4, 12, 2048, 64
 CAUSAL = True
 SCALE = 1.0 / (D ** 0.5)
+CHAIN = 16
 
 
-def timeit(fn, *args, iters=20):
-    out = fn(*args)
-    jax.block_until_ready(out)
-    t0 = time.perf_counter()
-    for _ in range(iters):
-        out = fn(*args)
-    jax.block_until_ready(out)
-    return (time.perf_counter() - t0) / iters * 1e3  # ms
+def _sync(out):
+    leaves = jax.tree_util.tree_leaves(out)
+    return float(jnp.sum(leaves[0].astype(jnp.float32).ravel()[:8]))
 
 
-def dense_ref(q, k, v):
+def time_chained(one_step, q, k, v, reps=3):
+    """one_step(q, k, v) -> out with out.shape == q.shape."""
+    def chained(q, k, v):
+        def body(_, qq):
+            return one_step(qq, k, v)
+        return jax.lax.fori_loop(0, CHAIN, body, q)
+    fn = jax.jit(chained)
+    _sync(fn(q, k, v))
+    best = float("inf")
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        _sync(fn(q, k, v))
+        best = min(best, time.perf_counter() - t0)
+    return best / CHAIN * 1e3
+
+
+def dense_step(q, k, v):
     s = jnp.einsum("bhqd,bhkd->bhqk", q, k).astype(jnp.float32) * SCALE
     if CAUSAL:
-        mask = np.tril(np.ones((S, S), bool))
-        s = jnp.where(mask, s, -1e30)
+        idx = jnp.arange(S)
+        s = jnp.where(idx[None, None, :, None] >= idx[None, None, None, :],
+                      s, -1e30)
     p = jax.nn.softmax(s, axis=-1).astype(q.dtype)
     return jnp.einsum("bhqk,bhkd->bhqd", p, v)
 
 
 def main():
     rng = np.random.RandomState(0)
+    a = jnp.asarray(rng.randn(4096, 4096), jnp.bfloat16)
+    t = time_chained(lambda x, _k, _v: x @ a, a, a, a)
+    print(f"calib 4096^3 matmul: {t:8.3f} ms "
+          f"({2*4096**3/(t/1e3)/1e12:.0f} TFLOP/s)")
+
     q = jnp.asarray(rng.randn(B, H, S, D), jnp.bfloat16)
     k = jnp.asarray(rng.randn(B, H, S, D), jnp.bfloat16)
     v = jnp.asarray(rng.randn(B, H, S, D), jnp.bfloat16)
     bias = jnp.zeros((B, S), jnp.float32)
     seed = jnp.zeros((), jnp.int32)
 
-    def flash(bq, bk):
-        def f(q, k, v):
-            out, _ = P._flash_call(q, k, v, bias, seed, CAUSAL, SCALE,
+    t = time_chained(dense_step, q, k, v)
+    print(f"dense fwd:           {t:8.3f} ms")
+
+    def dense_gstep(qq, k, v):
+        g = jax.grad(lambda q_: dense_step(q_, k, v).astype(
+            jnp.float32).sum())(qq)
+        return g.astype(qq.dtype)
+    t = time_chained(dense_gstep, q, k, v)
+    print(f"dense dq-grad step:  {t:8.3f} ms")
+
+    for bq, bk in [(128, 128), (256, 512), (512, 512), (512, 2048),
+                   (256, 2048)]:
+        def fstep(qq, k, v, bq=bq, bk=bk):
+            out, _ = P._flash_call(qq, k, v, bias, seed, CAUSAL, SCALE,
                                    0.0, bq, bk)
             return out
-        return jax.jit(f)
-
-    def flash_grad(bq, bk):
-        def loss(q, k, v):
-            old_q, old_k = P._BLOCK_Q, P._BLOCK_K
-            return P.flash_attention_raw(q, k, v, bias, seed, CAUSAL,
-                                         SCALE, 0.0).astype(
-                                             jnp.float32).sum()
-        return jax.jit(jax.grad(loss, argnums=(0, 1, 2)))
-
-    def dense_grad():
-        def loss(q, k, v):
-            return dense_ref(q, k, v).astype(jnp.float32).sum()
-        return jax.jit(jax.grad(loss, argnums=(0, 1, 2)))
-
-    print(f"shape B{B} H{H} S{S} D{D} causal={CAUSAL} bf16")
-    t = timeit(jax.jit(dense_ref), q, k, v)
-    print(f"dense fwd:           {t:8.3f} ms")
-    tg = timeit(dense_grad(), q, k, v)
-    print(f"dense fwd+bwd:       {tg:8.3f} ms")
-
-    for bq, bk in [(128, 128), (256, 256), (256, 512), (512, 512),
-                   (512, 1024), (1024, 1024)]:
-        if S % bq or S % bk:
-            continue
         try:
-            t = timeit(flash(bq, bk), q, k, v)
-            P._BLOCK_Q, P._BLOCK_K = bq, bk
-            tg = timeit(flash_grad(bq, bk), q, k, v)
-            print(f"flash bq={bq:4d} bk={bk:4d}: fwd {t:8.3f} ms   "
-                  f"fwd+bwd {tg:8.3f} ms")
+            t = time_chained(fstep, q, k, v)
         except Exception as e:  # noqa: BLE001
-            print(f"flash bq={bq:4d} bk={bk:4d}: FAILED {type(e).__name__}: "
-                  f"{str(e)[:120]}")
+            print(f"flash bq={bq:4d} bk={bk:4d}: FAILED "
+                  f"{str(e)[:100]}")
+            continue
+
+        orig_pick = P._pick_blocks
+        P._pick_blocks = lambda Sq, Sk, bq=bq, bk=bk: (bq, bk)
+
+        def gstep(qq, k, v):
+            g = jax.grad(lambda q_: P.flash_attention_raw(
+                q_, k, v, bias, seed, CAUSAL, SCALE, 0.0).astype(
+                    jnp.float32).sum())(qq)
+            return g.astype(qq.dtype)
+        try:
+            tg = time_chained(gstep, q, k, v)
+        except Exception:  # noqa: BLE001
+            tg = float("nan")
         finally:
-            P._BLOCK_Q, P._BLOCK_K = 128, 128
+            P._pick_blocks = orig_pick
+        print(f"flash bq={bq:4d} bk={bk:4d}: fwd {t:8.3f} ms   "
+              f"dq-grad step {tg:8.3f} ms")
 
 
 if __name__ == "__main__":
