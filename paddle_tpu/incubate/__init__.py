@@ -9,3 +9,8 @@ from .optimizers import (GradientMergeOptimizer, LookAhead, LookaheadOptimizer,
 __all__ = ["LookAhead", "LookaheadOptimizer", "ModelAverage",
            "GradientMergeOptimizer", "RecomputeOptimizer",
            "TrainEpochRange", "train_epoch_range", "AutoCheckpointChecker"]
+
+from ..ops.extra_ops import (segment_max, segment_mean,  # noqa: F401,E402
+                             segment_min, segment_sum)
+
+__all__ += ["segment_sum", "segment_mean", "segment_max", "segment_min"]

@@ -5,3 +5,6 @@ from .conv import *  # noqa: F401,F403
 from .loss import *  # noqa: F401,F403
 from .norm import *  # noqa: F401,F403
 from .pooling import *  # noqa: F401,F403
+from ...ops.extra_ops import (affine_grid, channel_shuffle,  # noqa: F401
+                              gather_tree, max_unpool2d, pixel_unshuffle,
+                              temporal_shift)

@@ -1,0 +1,706 @@
+"""Op-library gap closers, batch 2 (round 5).
+
+Each op cites its reference implementation under
+`/root/reference/paddle/fluid/operators/`. All are jittable static-shape
+jnp/lax compositions recorded through apply_op, so autograd, AMP and
+static-program recording work uniformly.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..framework.tensor import Tensor, apply_op
+
+__all__ = [
+    "pixel_unshuffle", "channel_shuffle", "max_unpool2d", "temporal_shift",
+    "affine_grid", "segment_sum", "segment_mean", "segment_max",
+    "segment_min", "gather_tree", "affine_channel", "row_conv",
+    "conv_shift", "cvm", "data_norm", "space_to_depth",
+    "pad_constant_like", "partial_concat", "partial_sum", "l1_norm",
+    "squared_l2_norm", "rank_loss", "bpr_loss", "center_loss",
+    "hinge_loss", "im2sequence", "linear_chain_crf", "roi_pool",
+    "shuffle_batch",
+]
+
+
+def _val(x):
+    return x._value if isinstance(x, Tensor) else jnp.asarray(x)
+
+
+# ---------------------------------------------------------------------------
+# vision / layout
+# ---------------------------------------------------------------------------
+
+def pixel_unshuffle(x, downscale_factor, data_format="NCHW", name=None):
+    """Inverse of pixel_shuffle (reference `pixel_shuffle_op.cc` inverse
+    path; space-to-depth layout)."""
+    r = int(downscale_factor)
+
+    def impl(v):
+        if data_format == "NHWC":
+            v = v.transpose(0, 3, 1, 2)
+        B, C, H, W = v.shape
+        v = v.reshape(B, C, H // r, r, W // r, r)
+        v = v.transpose(0, 1, 3, 5, 2, 4).reshape(B, C * r * r, H // r,
+                                                  W // r)
+        if data_format == "NHWC":
+            v = v.transpose(0, 2, 3, 1)
+        return v
+    return apply_op("pixel_unshuffle", impl, (x,), {})
+
+
+def space_to_depth(x, blocksize, name=None):
+    """reference `space_to_depth_op.cc` — same layout transform as
+    pixel_unshuffle."""
+    return pixel_unshuffle(x, blocksize)
+
+
+def channel_shuffle(x, groups, data_format="NCHW", name=None):
+    """reference `shuffle_channel_op.cc` (ShuffleNet)."""
+    g = int(groups)
+
+    def impl(v):
+        if data_format == "NHWC":
+            v = v.transpose(0, 3, 1, 2)
+        B, C, H, W = v.shape
+        v = v.reshape(B, g, C // g, H, W).transpose(0, 2, 1, 3, 4)
+        v = v.reshape(B, C, H, W)
+        if data_format == "NHWC":
+            v = v.transpose(0, 2, 3, 1)
+        return v
+    return apply_op("channel_shuffle", impl, (x,), {})
+
+
+def max_unpool2d(x, indices, kernel_size, stride=None, padding=0,
+                 output_size=None, data_format="NCHW", name=None):
+    """reference `unpool_op.cc`: scatter pooled values back to the
+    positions recorded by max_pool2d(return_mask=True)."""
+    if isinstance(kernel_size, int):
+        kernel_size = (kernel_size, kernel_size)
+    if stride is None:
+        stride = kernel_size
+    if isinstance(stride, int):
+        stride = (stride, stride)
+    if isinstance(padding, int):
+        padding = (padding, padding)
+
+    def impl(v, idx):
+        B, C, Hp, Wp = v.shape
+        if output_size is not None:
+            Ho, Wo = output_size[-2:]
+        else:
+            Ho = (Hp - 1) * stride[0] + kernel_size[0] - 2 * padding[0]
+            Wo = (Wp - 1) * stride[1] + kernel_size[1] - 2 * padding[1]
+        flat = jnp.zeros((B, C, Ho * Wo), v.dtype)
+        vi = v.reshape(B, C, -1)
+        ii = idx.reshape(B, C, -1).astype(jnp.int32)
+        flat = jax.vmap(jax.vmap(
+            lambda f, i, s: f.at[i].add(s)))(flat, ii, vi)
+        return flat.reshape(B, C, Ho, Wo)
+    return apply_op("unpool", impl, (x, indices), {})
+
+
+def temporal_shift(x, seg_num, shift_ratio=0.25, data_format="NCHW",
+                   name=None):
+    """reference `temporal_shift_op.cc` (TSM): shift a channel slice one
+    step along the segment (time) axis in each direction."""
+    T = int(seg_num)
+
+    def impl(v):
+        if data_format == "NHWC":
+            v = v.transpose(0, 3, 1, 2)
+        NT, C, H, W = v.shape
+        N = NT // T
+        c1 = int(C * shift_ratio)
+        c2 = int(C * 2 * shift_ratio)
+        v5 = v.reshape(N, T, C, H, W)
+        fwd = jnp.concatenate([v5[:, 1:, :c1], jnp.zeros_like(
+            v5[:, :1, :c1])], axis=1)
+        back = jnp.concatenate([jnp.zeros_like(v5[:, :1, c1:c2]),
+                                v5[:, :-1, c1:c2]], axis=1)
+        out = jnp.concatenate([fwd, back, v5[:, :, c2:]], axis=2)
+        out = out.reshape(NT, C, H, W)
+        if data_format == "NHWC":
+            out = out.transpose(0, 2, 3, 1)
+        return out
+    return apply_op("temporal_shift", impl, (x,), {})
+
+
+def affine_grid(theta, out_shape, align_corners=True, name=None):
+    """reference `affine_grid_op.cc`: 2D sampling grid [N,H,W,2] from
+    batched affine matrices [N,2,3] (pairs with F.grid_sample)."""
+    if isinstance(out_shape, Tensor):
+        from ..static.program import Variable
+        if isinstance(out_shape, Variable):
+            raise ValueError(
+                "affine_grid: pass out_shape as a Python list in static "
+                "mode — a placeholder Variable has no concrete value at "
+                "graph-build time")
+        out_shape = [int(s) for s in np.asarray(out_shape.numpy())]
+    N, C, H, W = [int(s) for s in out_shape]
+
+    def impl(th):
+        def axis(n):
+            if align_corners:
+                return jnp.linspace(-1.0, 1.0, n)
+            step = 2.0 / n
+            return jnp.linspace(-1.0 + step / 2, 1.0 - step / 2, n)
+        ys = axis(H)
+        xs = axis(W)
+        gx, gy = jnp.meshgrid(xs, ys)            # [H, W]
+        base = jnp.stack([gx, gy, jnp.ones_like(gx)], axis=-1)  # [H,W,3]
+        return jnp.einsum("hwk,nak->nhwa", base,
+                          th.astype(jnp.float32)).astype(th.dtype)
+    return apply_op("affine_grid", impl, (theta,), {})
+
+
+def roi_pool(x, boxes, boxes_num, output_size, spatial_scale=1.0,
+             name=None):
+    """reference `roi_pool_op.cc`: max-pool each RoI into a fixed grid
+    (quantized bins, unlike roi_align's bilinear sampling)."""
+    if isinstance(output_size, int):
+        output_size = (output_size, output_size)
+    oh, ow = output_size
+
+    def impl(feat, rois, rois_num):
+        N, C, H, W = feat.shape
+        R = rois.shape[0]
+        batch_idx = jnp.repeat(jnp.arange(N), rois_num, axis=0,
+                               total_repeat_length=R)
+        r = jnp.round(rois * spatial_scale).astype(jnp.int32)
+        x1, y1, x2, y2 = r[:, 0], r[:, 1], r[:, 2], r[:, 3]
+        bh = jnp.maximum(y2 - y1 + 1, 1)
+        bw = jnp.maximum(x2 - x1 + 1, 1)
+
+        iy = jnp.arange(H)
+        ix = jnp.arange(W)
+        bins_h = jnp.arange(oh)
+        bins_w = jnp.arange(ow)
+
+        def one(b, xx1, yy1, hh, ww):
+            fmap = feat[b].astype(jnp.float32)    # [C, H, W]
+            # reference bins overlap: bin i covers
+            # [floor(i*h/oh), ceil((i+1)*h/oh)) relative to y1
+            y_lo = yy1 + jnp.floor(bins_h * hh / oh).astype(jnp.int32)
+            y_hi = yy1 + jnp.ceil((bins_h + 1) * hh / oh).astype(jnp.int32)
+            x_lo = xx1 + jnp.floor(bins_w * ww / ow).astype(jnp.int32)
+            x_hi = xx1 + jnp.ceil((bins_w + 1) * ww / ow).astype(jnp.int32)
+            in_y = ((iy[None, :] >= jnp.maximum(y_lo, 0)[:, None])
+                    & (iy[None, :] < jnp.minimum(y_hi, H)[:, None]))
+            in_x = ((ix[None, :] >= jnp.maximum(x_lo, 0)[:, None])
+                    & (ix[None, :] < jnp.minimum(x_hi, W)[:, None]))
+            neg = jnp.finfo(jnp.float32).min
+            # two cheap masked reductions instead of one [oh,ow,C,H,W]
+            # broadcast: rows first -> [oh, C, W], then cols -> [oh,ow,C]
+            rowmax = jnp.where(in_y[:, None, :, None], fmap[None], neg
+                               ).max(2)                     # [oh, C, W]
+            sel = jnp.where(in_x[None, :, None, :],
+                            rowmax[:, None], neg).max(3)    # [oh, ow, C]
+            # empty bins output 0 (reference roi_pool_op.cc `is_empty`)
+            empty = ~(in_y.any(1)[:, None] & in_x.any(1)[None, :])
+            sel = jnp.where(empty[:, :, None], 0.0, sel)
+            return sel.transpose(2, 0, 1)                   # [C,oh,ow]
+        out = jax.vmap(one)(batch_idx, x1, y1, bh, bw)
+        return out.astype(feat.dtype)
+    return apply_op("roi_pool", impl, (x, boxes, boxes_num), {})
+
+
+# ---------------------------------------------------------------------------
+# segment / tree ops
+# ---------------------------------------------------------------------------
+
+def _segment(name, reducer):
+    def op(data, segment_ids, num_segments=None, name=None):
+        if num_segments is None:
+            # XLA needs a static segment count; derive it only from a
+            # concrete eager ids array — placeholders/tracers would bake
+            # a wrong count silently
+            from ..static.program import Variable
+            if isinstance(segment_ids, Variable):
+                raise ValueError(
+                    f"segment_{name}: pass num_segments explicitly in "
+                    "static mode (the count cannot be derived from a "
+                    "placeholder)")
+            try:
+                ids_np = np.asarray(_val(segment_ids))
+            except Exception as e:
+                raise ValueError(
+                    f"segment_{name}: pass num_segments explicitly "
+                    "under tracing") from e
+            num_segments = int(ids_np.max()) + 1 if ids_np.size else 0
+        num = int(num_segments)
+
+        def impl(d, ids):
+            return reducer(d, ids.astype(jnp.int32), num)
+        return apply_op(f"segment_{name}", impl, (data, segment_ids), {})
+    op.__name__ = f"segment_{name}"
+    return op
+
+
+def _seg_mean(d, ids, num):
+    s = jax.ops.segment_sum(d, ids, num)
+    cnt = jax.ops.segment_sum(jnp.ones((d.shape[0],), d.dtype), ids, num)
+    return s / jnp.maximum(cnt, 1).reshape((-1,) + (1,) * (d.ndim - 1))
+
+
+segment_sum = _segment("sum", lambda d, i, n: jax.ops.segment_sum(d, i, n))
+segment_mean = _segment("mean", _seg_mean)
+segment_max = _segment("max", lambda d, i, n: jax.ops.segment_max(d, i, n))
+segment_min = _segment("min", lambda d, i, n: jax.ops.segment_min(d, i, n))
+
+
+def gather_tree(ids, parents, name=None):
+    """reference `gather_tree_op.cc`: walk beam-search parent pointers
+    backwards to assemble full sequences. ids/parents: [T, B, beam]."""
+    def impl(idv, parv):
+        T, B, W = idv.shape
+        beam = jnp.broadcast_to(jnp.arange(W), (B, W))
+
+        def step(path, t):
+            out = jnp.take_along_axis(idv[t], path, axis=1)
+            nxt = jnp.take_along_axis(parv[t].astype(jnp.int32), path,
+                                      axis=1)
+            return nxt, out
+        _, outs = jax.lax.scan(step, beam, jnp.arange(T - 1, -1, -1))
+        return outs[::-1]
+    return apply_op("gather_tree", impl, (ids, parents), {})
+
+
+# ---------------------------------------------------------------------------
+# fluid-era CTR / sequence ops
+# ---------------------------------------------------------------------------
+
+def affine_channel(x, scale=None, bias=None, data_layout="NCHW", name=None):
+    """reference `affine_channel_op.cc`: per-channel x*scale+bias."""
+    def impl(v, s, b):
+        shape = ((1, -1, 1, 1) if data_layout == "NCHW" and v.ndim == 4
+                 else (1,) * (v.ndim - 1) + (-1,))
+        return v * s.reshape(shape) + b.reshape(shape)
+    return apply_op("affine_channel", impl, (x, scale, bias), {})
+
+
+def row_conv(x, weight, name=None):
+    """reference `row_conv_op.cc` (lookahead conv for streaming ASR):
+    out[t] = sum_i x[t+i] @diag w[i], x [B,T,D], weight [ctx+1, D]."""
+    def impl(v, w):
+        ctx = w.shape[0]
+        B, T, D = v.shape
+        pad = jnp.concatenate([v, jnp.zeros((B, ctx - 1, D), v.dtype)], 1)
+        out = jnp.zeros_like(v)
+        for i in range(ctx):
+            out = out + pad[:, i:i + T, :] * w[i][None, None, :]
+        return out
+    return apply_op("row_conv", impl, (x, weight), {})
+
+
+def conv_shift(x, y, name=None):
+    """reference `conv_shift_op.cc`: per-row circular convolution,
+    x [B, M], y [B, N] (N odd, N <= M)."""
+    def impl(xv, yv):
+        B, M = xv.shape
+        N = yv.shape[1]
+        half = N // 2
+        out = jnp.zeros_like(xv)
+        for j in range(N):
+            out = out + jnp.roll(xv, half - j, axis=1) * yv[:, j:j + 1]
+        return out
+    return apply_op("conv_shift", impl, (x, y), {})
+
+
+def cvm(x, cvm_input, use_cvm=True, name=None):
+    """reference `cvm_op.cc` (CTR show/click feature): keep or strip the
+    leading 2 show/click slots; gradients mirror the slice."""
+    def impl(v, c):
+        if use_cvm:
+            return jnp.concatenate([jnp.log(c + 1.0), v[:, 2:]], axis=1)
+        return v[:, 2:]
+    return apply_op("cvm", impl, (x, cvm_input), {})
+
+
+def data_norm(x, batch_size, batch_sum, batch_square_sum, epsilon=1e-4,
+              name=None):
+    """reference `data_norm_op.cc`: normalize by accumulated batch
+    statistics (large-scale CTR models)."""
+    def impl(v, n, s, sq):
+        mean = s / n
+        scale = jnp.sqrt(n / jnp.maximum(sq - n * mean * mean, epsilon))
+        return (v - mean) * scale
+    return apply_op("data_norm", impl,
+                    (x, batch_size, batch_sum, batch_square_sum), {})
+
+
+def pad_constant_like(x, y, pad_value=0.0, name=None):
+    """reference `pad_constant_like_op.cc`: pad y up to x's shape."""
+    def impl(xv, yv):
+        pads = [(0, xv.shape[i] - yv.shape[i]) for i in range(yv.ndim)]
+        return jnp.pad(yv, pads, constant_values=pad_value)
+    return apply_op("pad_constant_like", impl, (x, y), {})
+
+
+def partial_concat(xs, start_index=0, length=-1, name=None):
+    """reference `partial_concat_op.cc`: concat a column slice of each
+    input."""
+    def impl(*vals):
+        stop = None if length < 0 else start_index + length
+        return jnp.concatenate([v[:, start_index:stop] for v in vals], 1)
+    return apply_op("partial_concat", impl, tuple(xs), {})
+
+
+def partial_sum(xs, start_index=0, length=-1, name=None):
+    """reference `partial_sum_op.cc`."""
+    def impl(*vals):
+        stop = None if length < 0 else start_index + length
+        out = vals[0][:, start_index:stop]
+        for v in vals[1:]:
+            out = out + v[:, start_index:stop]
+        return out
+    return apply_op("partial_sum", impl, tuple(xs), {})
+
+
+def l1_norm(x, name=None):
+    """reference `l1_norm_op.cc`."""
+    return apply_op("l1_norm", lambda v: jnp.abs(v).sum(), (x,), {})
+
+
+def squared_l2_norm(x, name=None):
+    """reference `squared_l2_norm_op.cc`."""
+    return apply_op("squared_l2_norm", lambda v: (v * v).sum(), (x,), {})
+
+
+def shuffle_batch(x, seed=None, name=None):
+    """reference `shuffle_batch_op.cc`: random permutation of rows.
+
+    Like F.dropout, the random key is drawn at op-build time — a static
+    Program replays the recorded permutation (the framework's random ops
+    share this build-time-key convention)."""
+    from ..framework import random as frandom
+    key = frandom.get_rng_key() if seed is None \
+        else jax.random.PRNGKey(int(seed))
+    perm = jax.random.permutation(key, int(x.shape[0]))
+
+    def impl(v):
+        return jnp.take(v, perm, axis=0)
+    return apply_op("shuffle_batch", impl, (x,), {})
+
+
+# ---------------------------------------------------------------------------
+# ranking / metric-learning losses
+# ---------------------------------------------------------------------------
+
+def rank_loss(label, left, right, name=None):
+    """reference `rank_loss_op.cc` (RankNet): C = log(1+e^o) - t*o."""
+    def impl(t, l, r):
+        o = l - r
+        return jnp.logaddexp(0.0, o) - t * o
+    return apply_op("rank_loss", impl, (label, left, right), {})
+
+
+def bpr_loss(logit, label, name=None):
+    """reference `bpr_loss_op.cc` (Bayesian Personalized Ranking):
+    -mean_j log(sigmoid(logit_pos - logit_j)), j != pos."""
+    def impl(lv, yv):
+        B, C = lv.shape
+        pos = jnp.take_along_axis(lv, yv.reshape(B, 1).astype(jnp.int32),
+                                  axis=1)
+        diff = pos - lv                      # [B, C]
+        lsm = jax.nn.log_sigmoid(diff)
+        mask = jnp.arange(C)[None, :] != yv.reshape(B, 1)
+        return -(lsm * mask).sum(1, keepdims=True) / jnp.maximum(C - 1, 1)
+    return apply_op("bpr_loss", impl, (logit, label), {})
+
+
+def center_loss(x, label, centers, alpha=0.1, update_center=True,
+                name=None):
+    """reference `center_loss_op.cc`: 0.5*||x - c_y||^2; returns
+    (loss [B,1], updated centers)."""
+    def impl(xv, yv, cv):
+        y = yv.astype(jnp.int32).reshape(-1)
+        cy = jnp.take(cv, y, axis=0)
+        diff = xv - cy
+        loss = 0.5 * (diff * diff).sum(1, keepdims=True)
+        if update_center:
+            num = jax.ops.segment_sum(jnp.ones_like(y, cv.dtype), y,
+                                      cv.shape[0])
+            upd = jax.ops.segment_sum(diff, y, cv.shape[0])
+            new_c = cv + alpha * upd / (1.0 + num)[:, None]
+        else:
+            new_c = cv
+        return loss, new_c
+    return apply_op("center_loss", impl, (x, label, centers), {})
+
+
+def hinge_loss(logits, labels, name=None):
+    """reference `hinge_loss_op.cc`: max(0, 1 - (2y-1)*logit)."""
+    def impl(lv, yv):
+        return jnp.maximum(0.0, 1.0 - (2.0 * yv - 1.0) * lv)
+    return apply_op("hinge_loss", impl, (logits, labels), {})
+
+
+# ---------------------------------------------------------------------------
+# sequence ops
+# ---------------------------------------------------------------------------
+
+def im2sequence(x, filter_size=1, stride=1, padding=0, name=None):
+    """reference `im2sequence_op.cc`: sliding windows to sequence rows
+    [B*oh*ow, C*kh*kw]."""
+    ks = (filter_size, filter_size) if isinstance(filter_size, int) \
+        else tuple(filter_size)
+    st = (stride, stride) if isinstance(stride, int) else tuple(stride)
+    pd = (padding, padding) if isinstance(padding, int) else tuple(padding)
+
+    def impl(v):
+        B, C, H, W = v.shape
+        patches = jax.lax.conv_general_dilated_patches(
+            v, ks, st, [(pd[0], pd[0]), (pd[1], pd[1])],
+            dimension_numbers=("NCHW", "OIHW", "NCHW"))
+        # patches: [B, C*kh*kw, oh, ow]
+        Bp, CK, oh, ow = patches.shape
+        return patches.transpose(0, 2, 3, 1).reshape(B * oh * ow, CK)
+    return apply_op("im2sequence", impl, (x,), {})
+
+
+def linear_chain_crf(emission, transition, label, length, name=None):
+    """reference `linear_chain_crf_op.cc`: per-sequence negative
+    log-likelihood of a linear-chain CRF (training-time counterpart of
+    paddle.text.viterbi_decode).
+
+    emission [B,T,C]; transition [C+2,C] (row0=start, row1=stop, rows
+    2..=pairwise); label [B,T] int; length [B] int. Returns nll [B,1].
+    """
+    def impl(em, tr, yv, ln):
+        em = em.astype(jnp.float32)
+        tr = tr.astype(jnp.float32)
+        B, T, C = em.shape
+        start, stop, trans = tr[0], tr[1], tr[2:]
+        y = yv.astype(jnp.int32)
+        ln = ln.astype(jnp.int32).reshape(-1)
+        t_idx = jnp.arange(T)
+        valid = t_idx[None, :] < ln[:, None]               # [B,T]
+
+        # gold score
+        em_y = jnp.take_along_axis(em, y[:, :, None], axis=2)[..., 0]
+        score = (em_y * valid).sum(1) + jnp.take(start, y[:, 0])
+        pair = trans[y[:, :-1], y[:, 1:]]                  # [B,T-1]
+        score = score + (pair * valid[:, 1:]).sum(1)
+        last = jnp.take_along_axis(y, (ln - 1)[:, None], axis=1)[:, 0]
+        score = score + jnp.take(stop, last)
+
+        # partition function
+        def step(alpha, t):
+            nxt = jax.scipy.special.logsumexp(
+                alpha[:, :, None] + trans[None], axis=1) + em[:, t]
+            keep = valid[:, t][:, None]
+            return jnp.where(keep, nxt, alpha), None
+        alpha0 = start[None] + em[:, 0]
+        alpha, _ = jax.lax.scan(step, alpha0, jnp.arange(1, T))
+        logz = jax.scipy.special.logsumexp(alpha + stop[None], axis=1)
+        return (logz - score)[:, None]
+    return apply_op("linear_chain_crf", impl,
+                    (emission, transition, label, length), {})
+
+
+# ---------------------------------------------------------------------------
+# distillation / detection / flow ops (round-5 batch 3)
+# ---------------------------------------------------------------------------
+
+def fsp(x, y, name=None):
+    """reference `fsp_op.cc` (flow-of-solution-procedure matrix for
+    distillation): [B,C1,H,W] x [B,C2,H,W] -> [B,C1,C2] / (H*W)."""
+    def impl(a, b):
+        H, W = a.shape[2], a.shape[3]
+        return jnp.einsum("bchw,bdhw->bcd", a, b) / (H * W)
+    return apply_op("fsp", impl, (x, y), {})
+
+
+def cross_entropy2(input, label, ignore_index=-100, name=None):
+    """reference `cross_entropy_op.cc` (cross_entropy2): -log(prob[label])
+    over POST-softmax probabilities, with ignore_index rows zeroed."""
+    def impl(p, y):
+        yi = y.astype(jnp.int32).reshape(p.shape[0], 1)
+        picked = jnp.take_along_axis(p, jnp.maximum(yi, 0), axis=-1)
+        loss = -jnp.log(jnp.maximum(picked, 1e-12))
+        return jnp.where(yi == ignore_index, 0.0, loss)
+    return apply_op("cross_entropy2", impl, (input, label), {})
+
+
+def psroi_pool(x, boxes, boxes_num, output_channels, spatial_scale,
+               pooled_height, pooled_width, name=None):
+    """reference `psroi_pool_op.cc` (R-FCN position-sensitive RoI
+    pooling): input C = output_channels*oh*ow; bin (i,j) AVERAGES its own
+    channel group."""
+    oh, ow = int(pooled_height), int(pooled_width)
+    oc = int(output_channels)
+
+    def impl(feat, rois, rois_num):
+        N, C, H, W = feat.shape
+        R = rois.shape[0]
+        batch_idx = jnp.repeat(jnp.arange(N), rois_num, axis=0,
+                               total_repeat_length=R)
+        r = rois * spatial_scale
+        x1, y1, x2, y2 = r[:, 0], r[:, 1], r[:, 2], r[:, 3]
+        bh = jnp.maximum(y2 - y1, 0.1)
+        bw = jnp.maximum(x2 - x1, 0.1)
+        iy = jnp.arange(H).astype(jnp.float32)
+        ix = jnp.arange(W).astype(jnp.float32)
+
+        def one(b, xx1, yy1, hh, ww):
+            fmap = feat[b].astype(jnp.float32)   # [C,H,W]
+            grp = fmap.reshape(oc, oh, ow, H, W)
+            outs = []
+            for i in range(oh):
+                row = []
+                for j in range(ow):
+                    ylo = yy1 + i * hh / oh
+                    yhi = yy1 + (i + 1) * hh / oh
+                    xlo = xx1 + j * ww / ow
+                    xhi = xx1 + (j + 1) * ww / ow
+                    my = (iy >= jnp.floor(ylo)) & (iy < jnp.ceil(yhi))
+                    mx = (ix >= jnp.floor(xlo)) & (ix < jnp.ceil(xhi))
+                    m = my[:, None] & mx[None, :]
+                    cnt = jnp.maximum(m.sum(), 1)
+                    row.append((grp[:, i, j] * m[None]).sum((1, 2)) / cnt)
+                outs.append(jnp.stack(row, -1))   # [oc, ow]
+            return jnp.stack(outs, -2)            # [oc, oh, ow]
+        out = jax.vmap(one)(batch_idx, x1, y1, bh, bw)
+        return out.astype(feat.dtype)
+    return apply_op("psroi_pool", impl, (x, boxes, boxes_num), {})
+
+
+def prroi_pool(x, boxes, boxes_num, output_size, spatial_scale=1.0,
+               name=None):
+    """reference `prroi_pool_op.cc` (Precise RoI Pooling: exact integral
+    of the bilinearly-interpolated feature). TPU stand-in: dense bilinear
+    average via roi_align with a fine sampling grid — converges to the
+    same integral as the sampling density grows."""
+    from ..vision.ops import roi_align
+    return roi_align(x, boxes, boxes_num, output_size, spatial_scale,
+                     sampling_ratio=4, aligned=False)
+
+
+def correlation(x1, x2, pad_size, kernel_size, max_displacement,
+                stride1=1, stride2=1, corr_type_multiply=1, name=None):
+    """reference `correlation_op.cc` (FlowNet cost volume): per-pixel dot
+    products between x1 and x2 shifted over a (2d+1)^2 displacement grid
+    (kernel_size=1, stride 1 fast path — the FlowNet-C configuration)."""
+    d = int(max_displacement)
+
+    def impl(a, b):
+        B, C, H, W = a.shape
+        maps = []
+        for dy in range(-d, d + 1):
+            for dx in range(-d, d + 1):
+                shifted = jnp.roll(b, (dy, dx), axis=(2, 3))
+                # zero out the wrapped border
+                ygood = jnp.zeros((H,), bool).at[
+                    max(0, dy):H + min(0, dy)].set(True)
+                xgood = jnp.zeros((W,), bool).at[
+                    max(0, dx):W + min(0, dx)].set(True)
+                valid = ygood[:, None] & xgood[None, :]
+                corr = (a * shifted).mean(1)
+                maps.append(jnp.where(valid[None], corr, 0.0))
+        return jnp.stack(maps, 1)     # [B, (2d+1)^2, H, W]
+    return apply_op("correlation", impl, (x1, x2), {})
+
+
+def nce(input, label, num_total_classes, nid_weight=None, bias=None,
+        num_neg_samples=10, sampler="uniform", seed=None, name=None,
+        param_attr=None, bias_attr=None):
+    """reference `nce_op.cc` (noise-contrastive estimation): positive
+    class + sampled negatives through a logistic loss. Weights/bias are
+    created lazily if not given (param_attr/bias_attr names share them
+    across calls, fluid LayerHelper-style); negatives use the framework
+    PRNG (same build-time-key convention as F.dropout)."""
+    from ..framework import random as frandom
+    from ..static.nn import shared_parameter
+
+    D = input.shape[-1]
+    C = int(num_total_classes)
+    w = nid_weight if nid_weight is not None else \
+        shared_parameter([C, D], "float32", attr=param_attr)
+    b = bias if bias is not None else \
+        shared_parameter([C], "float32", attr=bias_attr, is_bias=True)
+    key = frandom.get_rng_key() if seed is None \
+        else jax.random.PRNGKey(int(seed))
+    B = input.shape[0]
+    neg = jax.random.randint(key, (B, int(num_neg_samples)), 0, C)
+
+    def impl(xv, yv, wv, bv):
+        y = yv.astype(jnp.int32).reshape(-1)
+        pos_w = jnp.take(wv, y, axis=0)                  # [B, D]
+        pos_s = (xv * pos_w).sum(-1) + jnp.take(bv, y)
+        neg_w = jnp.take(wv, neg, axis=0)                # [B, S, D]
+        neg_s = jnp.einsum("bd,bsd->bs", xv, neg_w) + jnp.take(bv, neg)
+        loss = -jax.nn.log_sigmoid(pos_s) \
+            - jax.nn.log_sigmoid(-neg_s).sum(-1)
+        return loss[:, None]
+    return apply_op("nce", impl, (input, label, w, b), {})
+
+
+def deformable_conv(x, offset, weight, mask=None, stride=1, padding=0,
+                    dilation=1, deformable_groups=1, groups=1,
+                    im2col_step=1, name=None):
+    """reference `deformable_conv_op.cc` (v2; v1 = mask None): sample the
+    input at offset-perturbed kernel positions via bilinear interpolation,
+    then contract with the kernel — built on the same bilinear gather as
+    F.grid_sample."""
+    if deformable_groups != 1 or groups != 1:
+        raise NotImplementedError("deformable_conv: deformable_groups/"
+                                  "groups > 1")
+    s = (stride, stride) if isinstance(stride, int) else tuple(stride)
+    p = (padding, padding) if isinstance(padding, int) else tuple(padding)
+    dl = (dilation, dilation) if isinstance(dilation, int) \
+        else tuple(dilation)
+
+    def _bilinear(img, yy, xx):
+        """img [C,H,W]; yy/xx [Ho,Wo] float -> [C,Ho,Wo] (zeros OOB)."""
+        C, H, W = img.shape
+        y0 = jnp.floor(yy)
+        x0 = jnp.floor(xx)
+        wy = yy - y0
+        wx = xx - x0
+        out = 0.0
+        for oy, wy_ in ((0, 1 - wy), (1, wy)):
+            for ox, wx_ in ((0, 1 - wx), (1, wx)):
+                yi = (y0 + oy).astype(jnp.int32)
+                xi = (x0 + ox).astype(jnp.int32)
+                ok = (yi >= 0) & (yi < H) & (xi >= 0) & (xi < W)
+                v = img[:, jnp.clip(yi, 0, H - 1), jnp.clip(xi, 0, W - 1)]
+                out = out + jnp.where(ok[None], v, 0.0) * (wy_ * wx_)[None]
+        return out
+
+    def impl(xv, ov, wv, *mv):
+        B, C, H, W = xv.shape
+        O, _, kh, kw = wv.shape
+        Ho = (H + 2 * p[0] - dl[0] * (kh - 1) - 1) // s[0] + 1
+        Wo = (W + 2 * p[1] - dl[1] * (kw - 1) - 1) // s[1] + 1
+        base_y = jnp.arange(Ho) * s[0] - p[0]
+        base_x = jnp.arange(Wo) * s[1] - p[1]
+
+        def one(img, off, *m):
+            cols = []
+            for ki in range(kh):
+                for kj in range(kw):
+                    k = ki * kw + kj
+                    dy = off[2 * k]
+                    dx = off[2 * k + 1]
+                    yy = base_y[:, None] + ki * dl[0] + dy[:Ho, :Wo]
+                    xx = base_x[None, :] + kj * dl[1] + dx[:Ho, :Wo]
+                    samp = _bilinear(img, yy, xx)        # [C,Ho,Wo]
+                    if m:
+                        samp = samp * m[0][k][None, :Ho, :Wo]
+                    cols.append(samp)
+            col = jnp.stack(cols, 1)                     # [C,kh*kw,Ho,Wo]
+            return jnp.einsum("ckhw,ock->ohw",
+                              col, wv.reshape(O, C, kh * kw))
+        if mv:
+            return jax.vmap(one)(xv, ov, mv[0])
+        return jax.vmap(one)(xv, ov)
+
+    args = (x, offset, weight) + ((mask,) if mask is not None else ())
+    return apply_op("deformable_conv", impl, args, {})
+
+
+__all__ += ["fsp", "cross_entropy2", "psroi_pool", "prroi_pool",
+            "correlation", "nce", "deformable_conv"]

@@ -29,7 +29,25 @@ __all__ = [
     "array_length", "tensor_array_to_tensor", "LoDTensor", "LoDTensorArray",
     "set_printoptions", "get_default_dtype", "set_default_dtype",
     "create_parameter", "create_global_var",
+    # fluid-era op surface (round-5 gap closers; ops/extra_ops.py)
+    "affine_channel", "row_conv", "conv_shift", "cvm", "data_norm",
+    "space_to_depth", "pad_constant_like", "partial_concat", "partial_sum",
+    "l1_norm", "squared_l2_norm", "rank_loss", "bpr_loss", "center_loss",
+    "hinge_loss", "im2sequence", "linear_chain_crf", "shuffle_batch",
+    "gather_tree", "affine_grid", "temporal_shift", "fsp",
+    "cross_entropy2", "psroi_pool", "prroi_pool", "correlation", "nce",
+    "deformable_conv", "lod_reset", "sequence_reshape", "sequence_slice",
+    "sequence_scatter",
 ]
+
+from .extra_ops import (affine_channel, affine_grid, bpr_loss,  # noqa: E402
+                        center_loss, conv_shift, correlation,
+                        cross_entropy2, cvm, data_norm, deformable_conv,
+                        fsp, gather_tree, hinge_loss, im2sequence,
+                        l1_norm, linear_chain_crf, nce, pad_constant_like,
+                        partial_concat, partial_sum, prroi_pool,
+                        psroi_pool, rank_loss, row_conv, shuffle_batch,
+                        space_to_depth, squared_l2_norm, temporal_shift)
 
 
 # --------------------------------------------------------------------------
@@ -424,3 +442,65 @@ def sequence_expand(x, y, ref_level=0, name=None):
 __all__ += ["sequence_pad", "sequence_unpad", "sequence_pool",
             "sequence_softmax", "sequence_reverse", "sequence_concat",
             "sequence_expand"]
+
+
+def lod_reset(x, y=None, target_lod=None):
+    """reference `lod_reset_op.cc`: replace x's LoD with y's (or the
+    given offsets)."""
+    if y is not None:
+        lod = [_seq_offsets(y)] if isinstance(y, LoDTensor) else \
+            [list(np.asarray(y.numpy()).astype(int))]
+    elif target_lod is not None:
+        lod = [list(target_lod)]
+    else:
+        raise ValueError("lod_reset needs y or target_lod")
+    return LoDTensor(x._value if isinstance(x, Tensor) else x, lod)
+
+
+def sequence_reshape(input, new_dim):
+    """reference `sequence_reshape_op.cc`: re-chunk each sequence's
+    flattened payload to rows of new_dim."""
+    offs = _seq_offsets(input)
+    v = np.asarray(input._value)
+    old_dim = v.shape[1]
+    new_offs = [0]
+    rows = []
+    for a, b in zip(offs[:-1], offs[1:]):
+        payload = v[a:b].reshape(-1)
+        assert payload.size % new_dim == 0, \
+            "sequence payload not divisible by new_dim"
+        rows.append(payload.reshape(-1, new_dim))
+        new_offs.append(new_offs[-1] + rows[-1].shape[0])
+    return LoDTensor(jnp.asarray(np.concatenate(rows, 0)), [new_offs])
+
+
+def sequence_slice(input, offset, length):
+    """reference `sequence_slice_op.cc`: per-sequence [offset, length)
+    slices."""
+    offs = _seq_offsets(input)
+    v = np.asarray(input._value)
+    off = np.asarray(offset.numpy() if isinstance(offset, Tensor)
+                     else offset).reshape(-1).astype(int)
+    ln = np.asarray(length.numpy() if isinstance(length, Tensor)
+                    else length).reshape(-1).astype(int)
+    rows = []
+    new_offs = [0]
+    for i, (a, b) in enumerate(zip(offs[:-1], offs[1:])):
+        rows.append(v[a + off[i]:a + off[i] + ln[i]])
+        new_offs.append(new_offs[-1] + rows[-1].shape[0])
+    return LoDTensor(jnp.asarray(np.concatenate(rows, 0)), [new_offs])
+
+
+def sequence_scatter(input, index, updates):
+    """reference `sequence_scatter_op.cc`: add `updates` rows into
+    `input` at per-sequence `index` positions (sequence i of the LoD
+    pair addresses row i of the dense input)."""
+    out = np.array(np.asarray(input._value), copy=True)
+    offs = _seq_offsets(index)
+    iv = np.asarray(index._value).reshape(-1).astype(int)
+    uv = np.asarray(updates._value)
+    for i, (a, b) in enumerate(zip(offs[:-1], offs[1:])):
+        # np.add.at accumulates duplicate indices (fancy += would not)
+        np.add.at(out[i], iv[a:b],
+                  uv[a:b] if uv.ndim == 1 else uv[a:b, 0])
+    return Tensor(jnp.asarray(out))

@@ -118,8 +118,10 @@ def roi_align(x, boxes, boxes_num, output_size, spatial_scale=1.0,
 
 
 def roi_pool(x, boxes, boxes_num, output_size, spatial_scale=1.0, name=None):
-    return roi_align(x, boxes, boxes_num, output_size, spatial_scale,
-                     sampling_ratio=1, aligned=False)
+    """reference `operators/roi_pool_op.cc` — true quantized-bin max pool
+    (roi_align's bilinear sampling is the smooth variant)."""
+    from ..ops.extra_ops import roi_pool as _impl
+    return _impl(x, boxes, boxes_num, output_size, spatial_scale)
 
 
 def yolo_box(x, img_size, anchors, class_num, conf_thresh=0.01,

@@ -1,0 +1,446 @@
+"""Round-5 op-gap closers (ops/extra_ops.py): numpy-reference parity and
+finite-difference gradients (the OpTest pattern, reference
+`unittests/op_test.py`)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+
+F = paddle.nn.functional
+RNG = np.random.RandomState(7)
+
+
+def _num_grad(fn, x, eps=1e-3):
+    g = np.zeros_like(x)
+    it = np.nditer(x, flags=["multi_index"])
+    while not it.finished:
+        i = it.multi_index
+        xp, xm = x.copy(), x.copy()
+        xp[i] += eps
+        xm[i] -= eps
+        g[i] = (fn(xp) - fn(xm)) / (2 * eps)
+        it.iternext()
+    return g
+
+
+class TestLayoutOps:
+    def test_pixel_unshuffle_roundtrip(self):
+        x = RNG.rand(2, 3, 4, 6).astype("float32")
+        down = F.pixel_unshuffle(paddle.to_tensor(x), 2)
+        assert down.shape == [2, 12, 2, 3]
+        up = F.pixel_shuffle(down, 2)
+        np.testing.assert_allclose(up.numpy(), x, rtol=1e-6)
+
+    def test_space_to_depth_alias(self):
+        x = RNG.rand(1, 2, 4, 4).astype("float32")
+        a = F.pixel_unshuffle(paddle.to_tensor(x), 2).numpy()
+        b = paddle.space_to_depth(paddle.to_tensor(x), 2).numpy()
+        np.testing.assert_array_equal(a, b)
+
+    def test_channel_shuffle(self):
+        x = np.arange(8, dtype="float32").reshape(1, 8, 1, 1)
+        out = F.channel_shuffle(paddle.to_tensor(x), 4).numpy().ravel()
+        np.testing.assert_array_equal(out, [0, 2, 4, 6, 1, 3, 5, 7])
+
+    def test_temporal_shift_values(self):
+        x = RNG.rand(4, 8, 2, 2).astype("float32")   # N=2 segments of T=2
+        out = paddle.temporal_shift(paddle.to_tensor(x), seg_num=2,
+                                    shift_ratio=0.25).numpy()
+        v = x.reshape(2, 2, 8, 2, 2)
+        o = out.reshape(2, 2, 8, 2, 2)
+        np.testing.assert_allclose(o[:, 0, :2], v[:, 1, :2])   # fwd shift
+        np.testing.assert_allclose(o[:, 1, :2], 0.0)
+        np.testing.assert_allclose(o[:, 1, 2:4], v[:, 0, 2:4])  # back
+        np.testing.assert_allclose(o[:, 0, 2:4], 0.0)
+        np.testing.assert_allclose(o[:, :, 4:], v[:, :, 4:])    # rest
+
+    def test_affine_grid_identity_matches_grid_sample(self):
+        theta = np.tile(np.array([[1., 0, 0], [0, 1, 0]], "float32"),
+                        (2, 1, 1))
+        x = RNG.rand(2, 3, 5, 7).astype("float32")
+        grid = F.affine_grid(paddle.to_tensor(theta), [2, 3, 5, 7])
+        assert grid.shape == [2, 5, 7, 2]
+        out = F.grid_sample(paddle.to_tensor(x), grid)
+        np.testing.assert_allclose(out.numpy(), x, rtol=1e-4, atol=1e-5)
+
+    def test_max_unpool2d_inverts_positions(self):
+        x = RNG.rand(1, 2, 4, 4).astype("float32")
+        pooled = F.max_pool2d(paddle.to_tensor(x), 2, 2)
+        # indices: flat position of each max within the input plane
+        flat = x.reshape(1, 2, 4, 4)
+        idx = np.zeros((1, 2, 2, 2), "int32")
+        for c in range(2):
+            for i in range(2):
+                for j in range(2):
+                    win = flat[0, c, 2*i:2*i+2, 2*j:2*j+2]
+                    r, s = np.unravel_index(np.argmax(win), (2, 2))
+                    idx[0, c, i, j] = (2*i + r) * 4 + (2*j + s)
+        up = F.max_unpool2d(pooled, paddle.to_tensor(idx), 2, 2)
+        assert up.shape == [1, 2, 4, 4]
+        # every pooled max lands back at its source position
+        for c in range(2):
+            for i in range(2):
+                for j in range(2):
+                    pos = idx[0, c, i, j]
+                    assert up.numpy()[0, c, pos // 4, pos % 4] == \
+                        pooled.numpy()[0, c, i, j]
+
+    def test_roi_pool_small_roi_no_sentinels(self):
+        x = paddle.to_tensor(RNG.rand(1, 2, 8, 8).astype("float32"))
+        boxes = paddle.to_tensor(np.array([[1., 1., 3., 3.]], "float32"))
+        num = paddle.to_tensor(np.array([1], "int32"))
+        out = paddle.vision.ops.roi_pool(x, boxes, num, 7).numpy()
+        assert out.shape == (1, 2, 7, 7)
+        assert np.isfinite(out).all()
+        assert out.min() >= 0.0          # empty bins are 0, not -3.4e38
+
+
+class TestSegmentAndTree:
+    def test_segment_reductions(self):
+        d = np.array([[1., 2], [3, 4], [5, 6], [7, 8]], "float32")
+        ids = np.array([0, 0, 1, 1])
+        t, i = paddle.to_tensor(d), paddle.to_tensor(ids)
+        np.testing.assert_allclose(
+            paddle.incubate.segment_sum(t, i).numpy(), [[4, 6], [12, 14]])
+        np.testing.assert_allclose(
+            paddle.incubate.segment_mean(t, i).numpy(), [[2, 3], [6, 7]])
+        np.testing.assert_allclose(
+            paddle.incubate.segment_max(t, i).numpy(), [[3, 4], [7, 8]])
+        np.testing.assert_allclose(
+            paddle.incubate.segment_min(t, i).numpy(), [[1, 2], [5, 6]])
+
+    def test_segment_static_requires_num(self):
+        paddle.enable_static()
+        try:
+            from paddle_tpu import static
+            main, startup = static.Program(), static.Program()
+            with static.program_guard(main, startup):
+                ids = static.data("ids", [4], "int32")
+                d = static.data("d", [4, 2], "float32")
+                with pytest.raises(ValueError):
+                    paddle.incubate.segment_sum(d, ids)
+                out = paddle.incubate.segment_sum(d, ids, num_segments=2)
+            exe = static.Executor()
+            got, = exe.run(main, feed={
+                "ids": np.array([0, 1, 1, 0], "int32"),
+                "d": np.ones((4, 2), "float32")}, fetch_list=[out])
+            np.testing.assert_allclose(got, [[2, 2], [2, 2]])
+        finally:
+            paddle.disable_static()
+
+    def test_gather_tree(self):
+        ids = np.array([[[2, 2]], [[6, 1]], [[3, 9]]], "int64")
+        parents = np.array([[[0, 0]], [[1, 1]], [[0, 1]]], "int64")
+        out = F.gather_tree(paddle.to_tensor(ids),
+                            paddle.to_tensor(parents)).numpy()
+        # beam 0: t2 emits 3, parent chain 0 -> t1 emits ids[1,0]=6,
+        # whose parent is 1 -> t0 emits ids[0,1]=2
+        np.testing.assert_array_equal(out[:, 0, 0], [2, 6, 3])
+        np.testing.assert_array_equal(out[:, 0, 1], [2, 1, 9])
+
+
+class TestFluidOps:
+    def test_affine_channel(self):
+        x = RNG.rand(2, 3, 2, 2).astype("float32")
+        s = np.array([1., 2, 3], "float32")
+        b = np.array([.5, 0, -1], "float32")
+        out = paddle.affine_channel(paddle.to_tensor(x),
+                                    paddle.to_tensor(s),
+                                    paddle.to_tensor(b)).numpy()
+        ref = x * s.reshape(1, 3, 1, 1) + b.reshape(1, 3, 1, 1)
+        np.testing.assert_allclose(out, ref, rtol=1e-6)
+
+    def test_row_conv_matches_reference_formula(self):
+        x = RNG.rand(2, 5, 3).astype("float32")
+        w = RNG.rand(3, 3).astype("float32")   # context 3
+        out = paddle.row_conv(paddle.to_tensor(x),
+                              paddle.to_tensor(w)).numpy()
+        ref = np.zeros_like(x)
+        for t in range(5):
+            for i in range(3):
+                if t + i < 5:
+                    ref[:, t] += x[:, t + i] * w[i]
+        np.testing.assert_allclose(out, ref, rtol=1e-5)
+
+    def test_conv_shift_circular(self):
+        x = RNG.rand(2, 6).astype("float32")
+        y = RNG.rand(2, 3).astype("float32")
+        out = paddle.conv_shift(paddle.to_tensor(x),
+                                paddle.to_tensor(y)).numpy()
+        ref = np.zeros_like(x)
+        for b in range(2):
+            for i in range(6):
+                for j in range(3):
+                    ref[b, i] += x[b, (i + j - 1) % 6] * y[b, j]
+        np.testing.assert_allclose(out, ref, rtol=1e-5)
+
+    def test_cvm(self):
+        x = RNG.rand(3, 6).astype("float32")
+        c = np.abs(RNG.rand(3, 2)).astype("float32")
+        keep = paddle.cvm(paddle.to_tensor(x), paddle.to_tensor(c),
+                          use_cvm=True).numpy()
+        np.testing.assert_allclose(keep[:, 2:], x[:, 2:], rtol=1e-6)
+        np.testing.assert_allclose(keep[:, :2], np.log(c + 1), rtol=1e-5)
+        strip = paddle.cvm(paddle.to_tensor(x), paddle.to_tensor(c),
+                           use_cvm=False).numpy()
+        assert strip.shape == (3, 4)
+
+    def test_data_norm(self):
+        x = RNG.rand(4, 3).astype("float32")
+        n = np.full((3,), 10.0, "float32")
+        s = RNG.rand(3).astype("float32") * 10
+        sq = s * s / 10 + 10.0           # variance 1-ish
+        out = paddle.data_norm(paddle.to_tensor(x), paddle.to_tensor(n),
+                               paddle.to_tensor(s),
+                               paddle.to_tensor(sq)).numpy()
+        mean = s / n
+        scale = np.sqrt(n / np.maximum(sq - n * mean * mean, 1e-4))
+        np.testing.assert_allclose(out, (x - mean) * scale, rtol=1e-4)
+
+    def test_pad_constant_like_and_partials(self):
+        x = paddle.to_tensor(np.zeros((3, 4), "float32"))
+        y = paddle.to_tensor(np.ones((2, 3), "float32"))
+        out = paddle.pad_constant_like(x, y, pad_value=5.0).numpy()
+        assert out.shape == (3, 4)
+        assert out[0, 0] == 1.0 and out[2, 3] == 5.0
+
+        a = paddle.to_tensor(RNG.rand(2, 5).astype("float32"))
+        b = paddle.to_tensor(RNG.rand(2, 5).astype("float32"))
+        pc = paddle.partial_concat([a, b], start_index=1, length=2)
+        assert pc.shape == [2, 4]
+        ps = paddle.partial_sum([a, b], start_index=1, length=2)
+        np.testing.assert_allclose(
+            ps.numpy(), a.numpy()[:, 1:3] + b.numpy()[:, 1:3], rtol=1e-6)
+
+    def test_norm_ops_with_grads(self):
+        x = RNG.rand(3, 4).astype("float32")
+        t = paddle.to_tensor(x)
+        t.stop_gradient = False
+        l1 = paddle.l1_norm(t)
+        np.testing.assert_allclose(float(l1.numpy()), np.abs(x).sum(),
+                                   rtol=1e-5)
+        l1.backward()
+        np.testing.assert_allclose(t.grad.numpy(), np.sign(x), rtol=1e-6)
+
+        t2 = paddle.to_tensor(x)
+        t2.stop_gradient = False
+        sq = paddle.squared_l2_norm(t2)
+        np.testing.assert_allclose(float(sq.numpy()), (x * x).sum(),
+                                   rtol=1e-5)
+        sq.backward()
+        np.testing.assert_allclose(t2.grad.numpy(), 2 * x, rtol=1e-5)
+
+    def test_im2sequence(self):
+        x = RNG.rand(2, 3, 4, 4).astype("float32")
+        out = paddle.im2sequence(paddle.to_tensor(x), filter_size=2,
+                                 stride=2).numpy()
+        assert out.shape == (2 * 2 * 2, 3 * 2 * 2)
+        first = x[0, :, 0:2, 0:2].reshape(-1)
+        np.testing.assert_allclose(out[0], first, rtol=1e-6)
+
+    def test_shuffle_batch_is_permutation(self):
+        x = np.arange(12, dtype="float32").reshape(6, 2)
+        out = paddle.shuffle_batch(paddle.to_tensor(x), seed=3).numpy()
+        assert sorted(out[:, 0].tolist()) == x[:, 0].tolist()
+
+
+class TestRankingLosses:
+    def test_rank_loss_formula(self):
+        t = np.array([[1.0], [0.0]], "float32")
+        left = np.array([[2.0], [0.5]], "float32")
+        right = np.array([[1.0], [1.5]], "float32")
+        out = paddle.rank_loss(paddle.to_tensor(t), paddle.to_tensor(left),
+                               paddle.to_tensor(right)).numpy()
+        o = left - right
+        ref = np.logaddexp(0, o) - t * o
+        np.testing.assert_allclose(out, ref, rtol=1e-5)
+
+    def test_bpr_loss_positive_and_grad(self):
+        logit = RNG.rand(4, 5).astype("float32")
+        label = np.array([0, 2, 4, 1])
+        t = paddle.to_tensor(logit)
+        t.stop_gradient = False
+        loss = paddle.bpr_loss(t, paddle.to_tensor(label))
+        assert loss.shape == [4, 1]
+        assert (loss.numpy() > 0).all()
+        loss.sum().backward()
+        g = t.grad.numpy()
+        num = _num_grad(
+            lambda lv: float(np.sum(-np.sum(
+                np.log(1 / (1 + np.exp(-(lv[np.arange(4), label][:, None]
+                                         - lv))))
+                * (np.arange(5)[None] != label[:, None]), 1) / 4)), logit)
+        np.testing.assert_allclose(g, num, rtol=2e-2, atol=2e-3)
+
+    def test_center_loss(self):
+        x = RNG.rand(4, 3).astype("float32")
+        y = np.array([0, 1, 0, 1])
+        centers = RNG.rand(2, 3).astype("float32")
+        loss, new_c = paddle.center_loss(
+            paddle.to_tensor(x), paddle.to_tensor(y),
+            paddle.to_tensor(centers), alpha=0.5)
+        diff = x - centers[y]
+        np.testing.assert_allclose(
+            loss.numpy(), 0.5 * (diff ** 2).sum(1, keepdims=True),
+            rtol=1e-5)
+        assert not np.allclose(new_c.numpy(), centers)
+
+    def test_hinge_loss(self):
+        logits = np.array([[0.5], [-2.0]], "float32")
+        labels = np.array([[1.0], [0.0]], "float32")
+        out = paddle.hinge_loss(paddle.to_tensor(logits),
+                                paddle.to_tensor(labels)).numpy()
+        np.testing.assert_allclose(out, [[0.5], [0.0]], rtol=1e-6)
+
+
+class TestLinearChainCRF:
+    def test_crf_nll_matches_brute_force(self):
+        B, T, C = 2, 4, 3
+        em = RNG.rand(B, T, C).astype("float32")
+        tr = RNG.rand(C + 2, C).astype("float32")
+        y = RNG.randint(0, C, (B, T)).astype("int64")
+        ln = np.array([4, 3])
+        nll = paddle.linear_chain_crf(
+            paddle.to_tensor(em), paddle.to_tensor(tr),
+            paddle.to_tensor(y), paddle.to_tensor(ln)).numpy()
+
+        import itertools
+        start, stop, trans = tr[0], tr[1], tr[2:]
+        for b in range(B):
+            L = ln[b]
+            def score(seq):
+                s = start[seq[0]] + em[b, 0, seq[0]]
+                for t in range(1, L):
+                    s += trans[seq[t - 1], seq[t]] + em[b, t, seq[t]]
+                return s + stop[seq[L - 1]]
+            logz = np.logaddexp.reduce(
+                [score(s) for s in itertools.product(range(C), repeat=L)])
+            ref = logz - score(y[b, :L])
+            np.testing.assert_allclose(nll[b, 0], ref, rtol=1e-4)
+
+    def test_crf_gradient_flows(self):
+        em = paddle.to_tensor(RNG.rand(2, 3, 3).astype("float32"))
+        em.stop_gradient = False
+        tr = paddle.to_tensor(RNG.rand(5, 3).astype("float32"))
+        tr.stop_gradient = False
+        nll = paddle.linear_chain_crf(
+            em, tr, paddle.to_tensor(np.zeros((2, 3), "int64")),
+            paddle.to_tensor(np.array([3, 3])))
+        nll.sum().backward()
+        assert np.isfinite(em.grad.numpy()).all()
+        assert np.isfinite(tr.grad.numpy()).all()
+        assert float(np.abs(tr.grad.numpy()).sum()) > 0
+
+
+class TestDetectionDistillOps:
+    def test_fsp_matrix(self):
+        x = RNG.rand(2, 3, 4, 4).astype("float32")
+        y = RNG.rand(2, 5, 4, 4).astype("float32")
+        out = paddle.fsp(paddle.to_tensor(x), paddle.to_tensor(y)).numpy()
+        ref = np.einsum("bchw,bdhw->bcd", x, y) / 16
+        np.testing.assert_allclose(out, ref, rtol=1e-5)
+
+    def test_cross_entropy2(self):
+        import paddle_tpu.nn.functional as F
+        logits = RNG.rand(3, 6).astype("float32")
+        prob = np.exp(logits) / np.exp(logits).sum(1, keepdims=True)
+        y = np.array([1, 2, 3])
+        out = paddle.cross_entropy2(paddle.to_tensor(prob),
+                                    paddle.to_tensor(y)).numpy()
+        np.testing.assert_allclose(out[:, 0],
+                                   -np.log(prob[np.arange(3), y]),
+                                   rtol=1e-5)
+
+    def test_psroi_pool_groups(self):
+        # constant feature per channel group -> each bin returns its
+        # group's constant
+        oc, oh, ow = 2, 2, 2
+        feat = np.zeros((1, oc * oh * ow, 6, 6), "float32")
+        for c in range(oc * oh * ow):
+            feat[0, c] = c
+        boxes = paddle.to_tensor(np.array([[0., 0., 5., 5.]], "float32"))
+        bn = paddle.to_tensor(np.array([1], "int32"))
+        out = paddle.psroi_pool(paddle.to_tensor(feat), boxes, bn,
+                                oc, 1.0, oh, ow).numpy()
+        for c in range(oc):
+            for i in range(oh):
+                for j in range(ow):
+                    assert out[0, c, i, j] == c * oh * ow + i * ow + j
+
+    def test_correlation_self_is_mean_square(self):
+        x = RNG.rand(1, 4, 5, 5).astype("float32")
+        out = paddle.correlation(paddle.to_tensor(x), paddle.to_tensor(x),
+                                 pad_size=1, kernel_size=1,
+                                 max_displacement=1).numpy()
+        assert out.shape == (1, 9, 5, 5)
+        center = out[0, 4]               # zero displacement plane
+        np.testing.assert_allclose(center, (x[0] ** 2).mean(0), rtol=1e-5)
+
+    def test_nce_positive_loss_and_grad(self):
+        x = paddle.to_tensor(RNG.rand(4, 6).astype("float32"))
+        x.stop_gradient = False
+        loss = paddle.nce(x, paddle.to_tensor(np.array([0, 1, 2, 3])),
+                          num_total_classes=9, num_neg_samples=4, seed=5)
+        assert (loss.numpy() > 0).all()
+        loss.sum().backward()
+        assert np.isfinite(x.grad.numpy()).all()
+
+    def test_deformable_conv_zero_offset_equals_conv(self):
+        import paddle_tpu.nn.functional as F
+        x = paddle.to_tensor(RNG.rand(2, 3, 6, 6).astype("float32"))
+        off = paddle.to_tensor(np.zeros((2, 18, 6, 6), "float32"))
+        w = paddle.to_tensor(RNG.rand(4, 3, 3, 3).astype("float32"))
+        out = paddle.deformable_conv(x, off, w, padding=1)
+        ref = F.conv2d(x, w, padding=1)
+        np.testing.assert_allclose(out.numpy(), ref.numpy(), rtol=1e-4,
+                                   atol=1e-5)
+
+    def test_deformable_conv_offset_shifts_sampling(self):
+        # constant +1.0 x-offset == sampling the input shifted by one
+        x = paddle.to_tensor(RNG.rand(1, 1, 6, 6).astype("float32"))
+        off = np.zeros((1, 2, 6, 6), "float32")
+        off[0, 1] = 1.0                  # dx = +1 for the 1x1 kernel
+        w = paddle.to_tensor(np.ones((1, 1, 1, 1), "float32"))
+        out = paddle.deformable_conv(x, paddle.to_tensor(off), w).numpy()
+        ref = np.zeros_like(x.numpy())
+        ref[0, 0, :, :-1] = x.numpy()[0, 0, :, 1:]
+        np.testing.assert_allclose(out, ref, rtol=1e-5, atol=1e-6)
+
+
+class TestSequenceLoD:
+    def _lt(self, arr, lod):
+        import jax.numpy as jnp
+        from paddle_tpu.ops.legacy import LoDTensor
+        return LoDTensor(jnp.asarray(arr), [lod])
+
+    def test_sequence_reshape(self):
+        lt = self._lt(np.arange(12, dtype="float32").reshape(6, 2),
+                      [0, 2, 6])
+        out = paddle.sequence_reshape(lt, 4)
+        assert np.asarray(out._value).shape == (3, 4)
+        assert out.lod()[0] == [0, 1, 3]
+
+    def test_sequence_slice(self):
+        lt = self._lt(np.arange(12, dtype="float32").reshape(6, 2),
+                      [0, 3, 6])
+        out = paddle.sequence_slice(lt, np.array([1, 0]), np.array([2, 1]))
+        v = np.asarray(out._value)
+        np.testing.assert_allclose(v[0], [2, 3])     # row 1 of seq 0
+        assert out.lod()[0] == [0, 2, 3]
+
+    def test_sequence_scatter_and_lod_reset(self):
+        base = paddle.to_tensor(np.zeros((2, 5), "float32"))
+        idx = self._lt(np.array([1, 3, 0], "int64"), [0, 2, 3])
+        upd = self._lt(np.array([10., 20., 30.], "float32"), [0, 2, 3])
+        out = paddle.sequence_scatter(base, idx, upd).numpy()
+        assert out[0, 1] == 10 and out[0, 3] == 20 and out[1, 0] == 30
+        lt = paddle.lod_reset(paddle.to_tensor(
+            np.zeros((4, 2), "float32")), target_lod=[0, 1, 4])
+        assert lt.lod()[0] == [0, 1, 4]
+
+    def test_sequence_scatter_accumulates_duplicates(self):
+        base = paddle.to_tensor(np.zeros((1, 4), "float32"))
+        idx = self._lt(np.array([0, 0], "int64"), [0, 2])
+        upd = self._lt(np.array([1., 1.], "float32"), [0, 2])
+        out = paddle.sequence_scatter(base, idx, upd).numpy()
+        assert out[0, 0] == 2.0          # both updates land
