@@ -16,7 +16,10 @@ from typing import Dict, List, Optional
 import numpy as np
 
 __all__ = ["Config", "create_predictor", "Predictor", "PredictorTensor",
-           "AnalysisConfig"]
+           "AnalysisConfig", "Analyzer", "Argument",
+           "compile_subgraph_engine"]
+
+from .analysis import Analyzer, Argument, compile_subgraph_engine  # noqa: E402
 
 
 class Config:

@@ -14,3 +14,4 @@ from ..framework.tensor import Parameter  # noqa: F401
 
 from .clip import (ClipGradByGlobalNorm, ClipGradByNorm,  # noqa: F401
                    ClipGradByValue)
+from .decode import BeamSearchDecoder, Decoder, dynamic_decode  # noqa: F401,E402

@@ -1,0 +1,165 @@
+"""Seq2seq decoding: BeamSearchDecoder + dynamic_decode.
+
+Reference: `python/paddle/fluid/layers/rnn.py` (`BeamSearchDecoder`,
+`dynamic_decode`) over the C++ `beam_search_op`/`gather_tree_op`. The TPU
+redesign keeps the same Decoder protocol (initialize/step/finalize) but
+runs the loop host-side over jitted steps — decode is a generate-style
+driver loop (same stance as GPT.generate), with gather_tree assembling
+the final beams.
+"""
+from __future__ import annotations
+
+from typing import Any, Callable, Optional, Tuple
+
+import numpy as np
+
+from ..framework.tensor import Tensor, apply_op
+
+__all__ = ["Decoder", "BeamSearchDecoder", "dynamic_decode"]
+
+
+class Decoder:
+    """Protocol (reference rnn.py Decoder): initialize -> (inputs,
+    states, finished); step -> (outputs, states, next_inputs, finished);
+    finalize -> (outputs, final_states)."""
+
+    def initialize(self, inits):
+        raise NotImplementedError
+
+    def step(self, time, inputs, states, **kwargs):
+        raise NotImplementedError
+
+    def finalize(self, outputs, final_states, sequence_lengths):
+        return outputs, final_states
+
+
+class BeamSearchDecoder(Decoder):
+    """Beam search over an RNN cell (reference
+    `fluid/layers/rnn.py:BeamSearchDecoder`).
+
+    cell: an RNNCell (LSTMCell/GRUCell/SimpleRNNCell) or any callable
+    `(inputs, states) -> (out, new_states)`; embedding_fn maps token ids
+    to cell inputs; output_fn maps cell outputs to vocab logits.
+    """
+
+    def __init__(self, cell, start_token: int, end_token: int,
+                 beam_size: int, embedding_fn: Optional[Callable] = None,
+                 output_fn: Optional[Callable] = None):
+        self.cell = cell
+        self.start_token = int(start_token)
+        self.end_token = int(end_token)
+        self.beam_size = int(beam_size)
+        self.embedding_fn = embedding_fn
+        self.output_fn = output_fn
+
+    # -- helpers ----------------------------------------------------------
+    def _merge(self, t):
+        """[batch, beam, ...] -> [batch*beam, ...]"""
+        import jax
+
+        def impl(v):
+            return v.reshape((-1,) + v.shape[2:])
+        return jax.tree_util.tree_map(
+            lambda x: apply_op("merge_beam", impl, (x,), {}), t,
+            is_leaf=lambda x: isinstance(x, Tensor))
+
+    # -- protocol ---------------------------------------------------------
+    def initialize(self, inits):
+        """inits: cell states for batch rows -> tiled to beams, with beam
+        0 active (score 0) and the rest -inf."""
+        import jax
+        import jax.numpy as jnp
+
+        leaves = jax.tree_util.tree_leaves(
+            inits, is_leaf=lambda x: isinstance(x, Tensor))
+        batch = leaves[0].shape[0]
+        B, W = batch, self.beam_size
+
+        def tile(x):
+            def impl(v):
+                return jnp.repeat(v[:, None], W, axis=1).reshape(
+                    (B * W,) + v.shape[1:])
+            return apply_op("tile_beam", impl, (x,), {})
+        states = jax.tree_util.tree_map(
+            tile, inits, is_leaf=lambda x: isinstance(x, Tensor))
+        ids = Tensor(jnp.full((B, W), self.start_token, jnp.int32))
+        scores = Tensor(jnp.where(jnp.arange(W)[None, :] == 0, 0.0,
+                                  -1e9) * jnp.ones((B, 1)))
+        finished = Tensor(jnp.zeros((B, W), bool))
+        return (ids, scores), states, finished
+
+    def step(self, time, inputs, states, **kwargs):
+        import jax
+        import jax.numpy as jnp
+
+        ids, scores = inputs
+        B, W = ids.shape
+        flat_ids = self._merge(ids)
+        emb = self.embedding_fn(flat_ids) if self.embedding_fn else flat_ids
+        cell_out, new_states = self.cell(emb, states)
+        logits = self.output_fn(cell_out) if self.output_fn else cell_out
+
+        def impl(lg, sc, fin):
+            V = lg.shape[-1]
+            logp = jax.nn.log_softmax(lg.astype(jnp.float32), axis=-1)
+            logp = logp.reshape(B, W, V)
+            # finished beams only extend with end_token at no cost
+            end_mask = jnp.where(jnp.arange(V) == self.end_token,
+                                 0.0, -1e9)
+            logp = jnp.where(fin[:, :, None], end_mask[None, None, :],
+                             logp)
+            total = sc[:, :, None] + logp                     # [B,W,V]
+            flat = total.reshape(B, W * V)
+            top_sc, top_ix = jax.lax.top_k(flat, W)           # [B,W]
+            parent = (top_ix // V).astype(jnp.int32)
+            token = (top_ix % V).astype(jnp.int32)
+            new_fin = jnp.take_along_axis(fin, parent, axis=1) | \
+                (token == self.end_token)
+            return top_sc, token, parent, new_fin
+
+        finished = kwargs["finished"]
+        top_sc, token, parent, new_fin = apply_op(
+            "beam_search", impl,
+            (logits, scores, finished), {})
+
+        # reorder cell states by parent beam
+        def reorder(x):
+            def impl_r(v, par):
+                v = v.reshape((B, W) + v.shape[1:])
+                out = jnp.take_along_axis(
+                    v, par.reshape((B, W) + (1,) * (v.ndim - 2)), axis=1)
+                return out.reshape((B * W,) + v.shape[2:])
+            return apply_op("reorder_beam", impl_r, (x, parent), {})
+        new_states = jax.tree_util.tree_map(
+            reorder, new_states, is_leaf=lambda x: isinstance(x, Tensor))
+
+        outputs = (token, parent, top_sc)
+        next_inputs = (token, top_sc)
+        return outputs, new_states, next_inputs, new_fin
+
+    def finalize(self, outputs, final_states, sequence_lengths):
+        """outputs: list of per-step (token, parent, score) -> gather_tree
+        assembled ids [T, B, W] plus final beam scores."""
+        from ..ops.extra_ops import gather_tree
+        from ..ops.manipulation import stack
+        tokens = stack([o[0] for o in outputs], axis=0)   # [T,B,W]
+        parents = stack([o[1] for o in outputs], axis=0)
+        seqs = gather_tree(tokens, parents)
+        return (seqs, outputs[-1][2]), final_states
+
+
+def dynamic_decode(decoder: Decoder, inits=None, max_step_num: int = 100,
+                   **kwargs) -> Tuple[Any, Any]:
+    """Run the decoder until every beam finishes or max_step_num
+    (reference `fluid/layers/rnn.py:dynamic_decode`)."""
+    import numpy as np
+
+    inputs, states, finished = decoder.initialize(inits)
+    outputs = []
+    for t in range(int(max_step_num)):
+        out, states, inputs, finished = decoder.step(
+            t, inputs, states, finished=finished, **kwargs)
+        outputs.append(out)
+        if bool(np.asarray(finished.numpy()).all()):
+            break
+    return decoder.finalize(outputs, states, None)

@@ -265,3 +265,211 @@ def switch_case(branch_index, branch_fns, default=None, name=None):
             return jax.lax.switch(idx, [_branch(f) for f in fns], None)
 
     return apply_op("switch_case", impl, (branch_index, *ext), attrs)
+
+
+# ---------------------------------------------------------------------------
+# fluid.layers-style wrappers (reference `fluid/layers/nn.py` — the static
+# builder API; each delegates to the shared functional/op surface, which
+# records into the current Program in static mode)
+# ---------------------------------------------------------------------------
+
+_shared_params = {}
+
+
+def shared_parameter(shape, dtype, attr=None, is_bias=False,
+                     default_name=None):
+    """fluid LayerHelper contract: a param_attr WITH a name shares the
+    parameter across call sites (reference `fluid/layer_helper_base.py`
+    create_parameter); unnamed attrs create fresh parameters per call."""
+    from ..ops.legacy import create_parameter
+    name = getattr(attr, "name", attr if isinstance(attr, str) else None)
+    if name is None:
+        return create_parameter(list(shape), dtype, attr=attr,
+                                is_bias=is_bias, name=default_name)
+    from .program import default_main_program, in_static_mode
+    if in_static_mode():
+        reg = default_main_program().param_vars
+        if name in reg:
+            return reg[name]
+    if name in _shared_params:
+        return _shared_params[name]
+    p = create_parameter(list(shape), dtype, attr=attr, is_bias=is_bias,
+                         name=name)
+    _shared_params[name] = p
+    return p
+
+def pool2d(input, pool_size=-1, pool_type="max", pool_stride=1,
+           pool_padding=0, global_pooling=False, ceil_mode=False,
+           data_format="NCHW", name=None):
+    if global_pooling:
+        axis = (2, 3) if data_format == "NCHW" else (1, 2)
+        from ..ops import reduction
+        red = reduction.max if pool_type == "max" else reduction.mean
+        return red(input, axis=axis, keepdim=True)
+    fn = F.max_pool2d if pool_type == "max" else F.avg_pool2d
+    return fn(input, pool_size, pool_stride, pool_padding,
+              ceil_mode=ceil_mode, data_format=data_format)
+
+
+def relu(x, name=None):
+    return F.relu(x)
+
+
+def softmax(input, axis=-1, name=None):
+    return F.softmax(input, axis=axis)
+
+
+def cross_entropy(input, label, soft_label=False, ignore_index=-100,
+                  name=None):
+    """fluid.layers.cross_entropy contract: `input` is POST-softmax
+    probabilities (-log p[label]); use_softmax=False avoids the silent
+    double-softmax a ported fluid model would otherwise get."""
+    return F.cross_entropy(input, label, soft_label=soft_label,
+                           ignore_index=ignore_index, reduction="none",
+                           use_softmax=False)
+
+
+def mean(x, name=None):
+    from ..ops import reduction
+    return reduction.mean(x)
+
+
+def mul(x, y, x_num_col_dims=1, y_num_col_dims=1, name=None):
+    from ..ops.linalg import matmul
+    from ..ops.manipulation import reshape
+    xs, ys = list(x.shape), list(y.shape)
+    if len(xs) > 2:
+        import numpy as _np
+        x = reshape(x, [int(_np.prod(xs[:x_num_col_dims])), -1])
+    if len(ys) > 2:
+        import numpy as _np
+        y = reshape(y, [int(_np.prod(ys[:y_num_col_dims])), -1])
+    return matmul(x, y)
+
+
+def concat(input, axis=0, name=None):
+    from ..ops.manipulation import concat as _concat
+    return _concat(input, axis)
+
+
+def accuracy(input, label, k=1, name=None):
+    import jax.numpy as jnp
+
+    from ..framework.tensor import apply_op
+
+    def impl(pred, lab):
+        idx = jnp.argsort(-pred, axis=-1)[:, :k]
+        hit = (idx == lab.reshape(-1, 1)).any(axis=1)
+        return hit.astype(jnp.float32).mean()
+    return apply_op("accuracy", impl, (input, label), {})
+
+
+def topk(input, k, name=None):
+    from ..ops.search import topk as _topk
+    return _topk(input, k)
+
+
+def l2_normalize(x, axis=-1, epsilon=1e-12, name=None):
+    return F.normalize(x, p=2, axis=axis, epsilon=epsilon)
+
+
+def one_hot(input, depth, name=None):
+    return F.one_hot(input, depth)
+
+
+def reduce_sum(input, dim=None, keep_dim=False, name=None):
+    from ..ops import reduction
+    return reduction.sum(input, axis=dim, keepdim=keep_dim)
+
+
+def reduce_mean(input, dim=None, keep_dim=False, name=None):
+    from ..ops import reduction
+    return reduction.mean(input, axis=dim, keepdim=keep_dim)
+
+
+def sigmoid_cross_entropy_with_logits(x, label, ignore_index=-100,
+                                      name=None, normalize=False):
+    """reference `sigmoid_cross_entropy_with_logits_op.cc`: elementwise
+    BCE-with-logits where label==ignore_index contributes 0; normalize
+    divides by the non-ignored count."""
+    import jax
+    import jax.numpy as jnp
+
+    from ..framework.tensor import apply_op
+
+    def impl(lv, yv):
+        loss = jnp.maximum(lv, 0.0) - lv * yv + jnp.log1p(
+            jnp.exp(-jnp.abs(lv)))
+        keep = yv != ignore_index
+        loss = jnp.where(keep, loss, 0.0)
+        if normalize:
+            loss = loss / jnp.maximum(keep.sum().astype(loss.dtype), 1.0)
+        return loss
+    return apply_op("sigmoid_cross_entropy_with_logits", impl,
+                    (x, label), {})
+
+
+def lstm_unit(x_t, hidden_t_prev, cell_t_prev, forget_bias=0.0,
+              param_attr=None, bias_attr=None, name=None):
+    """reference `fluid/layers/nn.py` lstm_unit / `lstm_unit_op.cc`:
+    FC(concat(x, h)) -> i,f,c̃,o with forget_bias added to the forget
+    gate pre-activation; returns (hidden, cell)."""
+    import jax.numpy as jnp
+
+    from ..framework.tensor import apply_op
+
+    D = hidden_t_prev.shape[-1]
+    w = shared_parameter([x_t.shape[-1] + D, 4 * D], "float32",
+                         attr=param_attr)
+    b = shared_parameter([4 * D], "float32", attr=bias_attr,
+                         is_bias=True)
+
+    def impl(x, h, c, wv, bv):
+        z = jnp.concatenate([x, h], axis=-1) @ wv + bv
+        i, f, g, o = jnp.split(z, 4, axis=-1)
+        i = jax.nn.sigmoid(i)
+        f = jax.nn.sigmoid(f + forget_bias)
+        o = jax.nn.sigmoid(o)
+        c_new = f * c + i * jnp.tanh(g)
+        return o * jnp.tanh(c_new), c_new
+    import jax
+    h, c = apply_op("lstm_unit", impl,
+                    (x_t, hidden_t_prev, cell_t_prev, w, b), {})
+    return h, c
+
+
+def gru_unit(input, hidden, size, param_attr=None, bias_attr=None,
+             activation="tanh", gate_activation="sigmoid", name=None):
+    """reference `gru_unit_op.cc`: input is the PRE-PROJECTED [B, 3*D]
+    tensor (an fc output, D = size//3); hidden weights [D, 3*D] live in
+    this op. Returns (hidden, reset_hidden_prev, gate) like the
+    reference's 3-output contract."""
+    import jax
+    import jax.numpy as jnp
+
+    from ..framework.tensor import apply_op
+
+    D = size // 3
+    w = shared_parameter([D, 3 * D], "float32", attr=param_attr)
+    b = shared_parameter([3 * D], "float32", attr=bias_attr, is_bias=True)
+
+    act = jnp.tanh if activation == "tanh" else jax.nn.relu
+
+    def impl(x, h, wv, bv):
+        x = x + bv
+        xu, xr, xc = jnp.split(x, 3, axis=-1)
+        wu, wr, wc = jnp.split(wv, 3, axis=-1)
+        u = jax.nn.sigmoid(xu + h @ wu)
+        r = jax.nn.sigmoid(xr + h @ wr)
+        rh = r * h
+        c = act(xc + rh @ wc)
+        h_new = (1.0 - u) * h + u * c
+        gate = jnp.concatenate([u, r, c], axis=-1)
+        return h_new, rh, gate
+    return apply_op("gru_unit", impl, (input, hidden, w, b), {})
+
+
+__all__ += ["pool2d", "relu", "softmax", "cross_entropy", "mean", "mul",
+            "concat", "accuracy", "topk", "l2_normalize", "one_hot",
+            "reduce_sum", "reduce_mean",
+            "sigmoid_cross_entropy_with_logits", "lstm_unit", "gru_unit"]

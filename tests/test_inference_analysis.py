@@ -1,0 +1,89 @@
+"""Inference Analyzer + engine subgraph (reference `inference/analysis/`
+Analyzer pass pipeline; `operators/lite/lite_engine_op.h` /
+`tensorrt_engine_op.h` subgraph engines)."""
+import numpy as np
+
+import paddle_tpu as paddle
+from paddle_tpu import static
+from paddle_tpu.inference import Analyzer, Argument, compile_subgraph_engine
+
+
+def _build_program(tmp_path):
+    paddle.enable_static()
+    main, startup = static.Program(), static.Program()
+    with static.program_guard(main, startup):
+        x = static.data("x", [2, 4], "float32")
+        h = x * 2.0
+        h2 = h + 1.0
+        out = (h2 * h2).sum()
+    path = str(tmp_path / "prog.json")
+    main.save(path)
+    paddle.disable_static()
+    return main, out, path
+
+
+def test_engine_subgraph_preserves_outputs(tmp_path):
+    main, out, _ = _build_program(tmp_path)
+    paddle.enable_static()
+    try:
+        exe = static.Executor()
+        feed = {"x": np.arange(8, dtype="float32").reshape(2, 4)}
+        before, = exe.run(main, feed=feed, fetch_list=[out])
+
+        idx = compile_subgraph_engine(main, 0, len(main.ops),
+                                      fetch_slots=[out.slot])
+        eng = main.ops[idx]
+        assert eng.type == "xla_engine"
+        assert eng.attr("num_fused_ops") >= 3
+        assert "multiply" in eng.attr("fused_op_types")
+
+        exe2 = static.Executor()
+        after, = exe2.run(main, feed=feed, fetch_list=[out])
+        np.testing.assert_allclose(after, before, rtol=1e-6)
+    finally:
+        paddle.disable_static()
+
+
+def test_engine_partial_range(tmp_path):
+    main, out, _ = _build_program(tmp_path)
+    paddle.enable_static()
+    try:
+        exe = static.Executor()
+        feed = {"x": np.ones((2, 4), "float32")}
+        before, = exe.run(main, feed=feed, fetch_list=[out])
+        n = len(main.ops)
+        compile_subgraph_engine(main, 1, n - 1, engine_type="lite")
+        assert any(op.type == "lite_engine" for op in main.ops)
+        assert len(main.ops) < n + 1
+        after, = exe.run(main, feed=feed, fetch_list=[out])
+        np.testing.assert_allclose(after, before, rtol=1e-6)
+    finally:
+        paddle.disable_static()
+
+
+def test_analyzer_pipeline_from_file(tmp_path):
+    main, out, path = _build_program(tmp_path)
+    arg = Argument(model_path=path)
+    Analyzer().run(arg)
+    assert arg.program is not None
+    assert arg.engine_ops, "engine_subgraph_pass fused nothing"
+    eng = arg.program.ops[arg.engine_ops[0]]
+    assert eng.type == "xla_engine"
+
+    paddle.enable_static()
+    try:
+        exe = static.Executor()
+        feed = {"x": np.full((2, 4), 3.0, "float32")}
+        got, = exe.run(arg.program, feed=feed,
+                       fetch_list=[arg.program.vars[out.slot]])
+        ref = float((((np.full((2, 4), 3.0) * 2) + 1) ** 2).sum())
+        np.testing.assert_allclose(float(got), ref, rtol=1e-6)
+    finally:
+        paddle.disable_static()
+
+
+def test_analyzer_unknown_pass_rejected():
+    import pytest
+    from paddle_tpu.framework.errors import NotFoundError
+    with pytest.raises(NotFoundError):
+        Analyzer(["no_such_pass"]).run(Argument(program=static.Program()))

@@ -115,3 +115,80 @@ def test_custom_python_op():
     np.testing.assert_allclose(y.numpy(), [4, 9])
     y.sum().backward()
     np.testing.assert_allclose(x.grad.numpy(), [4, 6])
+
+
+def test_static_nn_fluid_wrappers():
+    """Round-5 static.nn widening (reference fluid/layers/nn.py surface)."""
+    import paddle_tpu.static as static
+    from paddle_tpu.static import nn as snn
+    paddle.enable_static()
+    try:
+        main, startup = static.Program(), static.Program()
+        with static.program_guard(main, startup):
+            x = static.data("x", [4, 1, 8, 8], "float32")
+            label = static.data("label", [4, 1], "int64")
+            h = snn.conv2d(x, 4, 3, padding=1, act="relu")
+            h = snn.pool2d(h, 2, "max", 2)
+            feat = snn.fc(h, 10)
+            prob = snn.softmax(feat)
+            # fluid contract: cross_entropy consumes POST-softmax probs
+            ce = snn.cross_entropy(prob, label)
+            loss = snn.mean(ce)
+            acc = snn.accuracy(prob, label)
+            ssum = snn.reduce_sum(prob, dim=-1)
+        exe = static.Executor()
+        exe.run(startup)
+        rng = np.random.RandomState(0)
+        lv, av, sv = exe.run(
+            main,
+            feed={"x": rng.rand(4, 1, 8, 8).astype("float32"),
+                  "label": rng.randint(0, 10, (4, 1)).astype("int64")},
+            fetch_list=[loss, acc, ssum])
+        assert np.isfinite(lv).all() and 0 <= float(av) <= 1
+        np.testing.assert_allclose(sv, np.ones(4), rtol=1e-5)
+    finally:
+        paddle.disable_static()
+
+
+def test_static_nn_cell_units():
+    from paddle_tpu.framework.param_attr import ParamAttr
+    from paddle_tpu.static import nn as snn
+    x = paddle.to_tensor(np.random.rand(2, 3).astype("float32"))
+    h0 = paddle.to_tensor(np.zeros((2, 4), "float32"))
+    c0 = paddle.to_tensor(np.ones((2, 4), "float32"))
+    # named attrs share the weights across calls (fluid LayerHelper
+    # contract) so the two calls differ ONLY in forget_bias
+    wa = ParamAttr(name="lstm_unit_test_w")
+    ba = ParamAttr(name="lstm_unit_test_b")
+    h, c = snn.lstm_unit(x, h0, c0, forget_bias=1.0, param_attr=wa,
+                         bias_attr=ba)
+    assert h.shape == [2, 4] and c.shape == [2, 4]
+    h2, c2 = snn.lstm_unit(x, h0, c0, forget_bias=1.0, param_attr=wa,
+                           bias_attr=ba)
+    np.testing.assert_allclose(c.numpy(), c2.numpy(), rtol=1e-6)
+    _, c_hi = snn.lstm_unit(x, h0, c0, forget_bias=1000.0, param_attr=wa,
+                            bias_attr=ba)
+    assert not np.allclose(c.numpy(), c_hi.numpy())
+    # forget_bias -> +inf forces f=1: cell ~= c_prev + i*tanh(g)
+    assert (c_hi.numpy() > c.numpy() - 1e-6).all()
+
+    # gru_unit: fluid contract — pre-projected [B, 3*D] input, 3 outputs
+    xp = paddle.to_tensor(np.random.rand(2, 12).astype("float32"))
+    h_new, rh, gate = snn.gru_unit(xp, h0, 12)
+    assert h_new.shape == [2, 4]
+    assert rh.shape == [2, 4]
+    assert gate.shape == [2, 12]
+
+
+def test_static_nn_sigmoid_ce_ignore_index():
+    from paddle_tpu.static import nn as snn
+    x = paddle.to_tensor(np.array([[0.5, -1.0, 2.0]], "float32"))
+    lab = paddle.to_tensor(np.array([[1.0, -100.0, 0.0]], "float32"))
+    out = snn.sigmoid_cross_entropy_with_logits(
+        x, lab, ignore_index=-100).numpy()
+    assert out[0, 1] == 0.0              # ignored entry contributes 0
+    ref = np.maximum(0.5, 0) - 0.5 * 1.0 + np.log1p(np.exp(-0.5))
+    np.testing.assert_allclose(out[0, 0], ref, rtol=1e-5)
+    norm = snn.sigmoid_cross_entropy_with_logits(
+        x, lab, ignore_index=-100, normalize=True).numpy()
+    np.testing.assert_allclose(norm[0, 0], ref / 2.0, rtol=1e-5)
