@@ -166,3 +166,6 @@ def quantize_weights(model: nn.Layer, bits: int = 8,
 
 
 __all__ += ["WeightOnlyLinear", "quantize_weights"]
+
+from .quant_pass import (QuantizationFreezePass,  # noqa: F401,E402
+                         QuantizationTransformPass)

@@ -1,0 +1,115 @@
+"""Static-Program quantization passes (reference
+`fluid/contrib/slim/quantization/quantization_pass.py`:
+QuantizationTransformPass inserts fake_quant/dequant ops for QAT;
+QuantizationFreezePass rewrites the trained program to int8 weights).
+
+TPU redesign over the op-level Program IR: the transform pass WRAPS each
+quantizable op's computation with fake-quant on its inputs (straight-
+through estimator — jax.grad differentiates the wrapped fn directly, no
+separate grad ops needed); the freeze pass bakes weights in as int8
+constants with per-output-channel scales and dequantizes in f32 after
+the int8 contraction.
+"""
+from __future__ import annotations
+
+from typing import Dict, Iterable, Optional, Sequence
+
+import numpy as np
+
+__all__ = ["QuantizationTransformPass", "QuantizationFreezePass"]
+
+_DEFAULT_TYPES = ("matmul", "mul", "linear", "conv2d")
+
+
+def _fake_quant(v, bits):
+    import jax
+    import jax.numpy as jnp
+    qmax = 2.0 ** (bits - 1) - 1
+    scale = jnp.maximum(jnp.max(jnp.abs(v)), 1e-8) / qmax
+    q = jnp.round(v / scale)
+    # straight-through estimator: identity gradient
+    return v + jax.lax.stop_gradient(jnp.clip(q, -qmax, qmax) * scale - v)
+
+
+class QuantizationTransformPass:
+    """Wrap quantizable ops with fake-quant on every floating input
+    (QAT; reference QuantizationTransformPass inserts
+    fake_quantize_abs_max + fake_dequantize ops around each)."""
+
+    def __init__(self, weight_bits: int = 8, activation_bits: int = 8,
+                 quantizable_op_types: Sequence[str] = _DEFAULT_TYPES):
+        self.weight_bits = weight_bits
+        self.activation_bits = activation_bits
+        self.types = tuple(quantizable_op_types)
+
+    def apply(self, program):
+        import jax.numpy as jnp
+        for op in program.ops:
+            if op.name not in self.types or op.attrs.get("quant"):
+                continue
+            inner = op.fn
+            bits = self.activation_bits
+
+            def wrapped(*args, _inner=inner, _bits=bits):
+                qargs = [
+                    _fake_quant(a, _bits)
+                    if hasattr(a, "dtype")
+                    and jnp.issubdtype(jnp.asarray(a).dtype, jnp.floating)
+                    else a for a in args]
+                return _inner(*qargs)
+            op.fn = wrapped
+            op.attrs["quant"] = "fake_abs_max"
+            op.attrs["activation_bits"] = self.activation_bits
+        return program
+
+
+class QuantizationFreezePass:
+    """Bake parameter inputs of quantizable ops in as int8 constants
+    (reference QuantizationFreezePass converts weights and rewires
+    dequantize after the op). Per-output-channel symmetric scales; the
+    int8 tensor rides the op as a constant, the fn dequantizes into the
+    f32 computation — serving artifacts then carry 1/4 the weight bytes.
+    """
+
+    def __init__(self, weight_bits: int = 8,
+                 quantizable_op_types: Sequence[str] = _DEFAULT_TYPES):
+        self.weight_bits = weight_bits
+        self.types = tuple(quantizable_op_types)
+
+    def apply(self, program, scope: Optional[Dict[str, np.ndarray]] = None):
+        import jax.numpy as jnp
+
+        from ..static.program import global_scope
+        scope = scope if scope is not None else global_scope()
+        qmax = 2.0 ** (self.weight_bits - 1) - 1
+        param_slots = {v.slot: n for n, v in program.param_vars.items()}
+
+        for op in program.ops:
+            if op.name not in self.types or op.attrs.get("frozen"):
+                continue
+            w_positions = [i for i, (tag, ref) in enumerate(op.in_refs)
+                           if tag == "s" and ref in param_slots]
+            if not w_positions:
+                continue
+            pos = w_positions[-1]          # weight is the trailing param
+            name = param_slots[op.in_refs[pos][1]]
+            w = np.asarray(scope[name], np.float32)
+            # per-output-channel scale over the last axis
+            axes = tuple(range(w.ndim - 1))
+            scale = np.maximum(np.abs(w).max(axis=axes, keepdims=True),
+                               1e-8) / qmax
+            wq = np.clip(np.round(w / scale), -qmax, qmax).astype(np.int8)
+
+            inner = op.fn
+
+            def frozen(*args, _inner=inner, _pos=pos,
+                       _scale=jnp.asarray(scale)):
+                args = list(args)
+                args[_pos] = args[_pos].astype(jnp.float32) * _scale
+                return _inner(*args)
+            op.fn = frozen
+            op.in_refs[pos] = ("c", jnp.asarray(wq))
+            op.attrs["frozen"] = "int8"
+            op.attrs["weight_bits"] = self.weight_bits
+            op.attrs["weight_scale_max"] = float(scale.max())
+        return program

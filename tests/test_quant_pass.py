@@ -1,0 +1,86 @@
+"""Program-level quantization passes (reference `fluid/contrib/slim/
+quantization/quantization_pass.py` QuantizationTransformPass /
+QuantizationFreezePass)."""
+import numpy as np
+
+import paddle_tpu as paddle
+from paddle_tpu import static
+from paddle_tpu.quantization import (QuantizationFreezePass,
+                                     QuantizationTransformPass)
+from paddle_tpu.static import nn as snn
+
+
+def _build(tmp_scope=False):
+    main, startup = static.Program(), static.Program()
+    with static.program_guard(main, startup):
+        x = static.data("x", [8, 4], "float32")
+        h = snn.fc(x, 16, activation="relu")
+        out = snn.fc(h, 2)
+    return main, startup, out
+
+
+def test_transform_pass_marks_and_preserves_function():
+    paddle.enable_static()
+    try:
+        main, startup, out = _build()
+        exe = static.Executor()
+        exe.run(startup)
+        feed = {"x": np.random.RandomState(0).rand(8, 4).astype("float32")}
+        before, = exe.run(main, feed=feed, fetch_list=[out])
+
+        QuantizationTransformPass().apply(main)
+        qops = [op for op in main.ops if op.attrs.get("quant")]
+        assert qops, "no op was marked for QAT"
+        after, = static.Executor().run(main, feed=feed, fetch_list=[out])
+        # 8-bit fake-quant: close to the float program but not identical
+        np.testing.assert_allclose(after, before, rtol=0.2, atol=0.1)
+        assert not np.array_equal(after, before)
+    finally:
+        paddle.disable_static()
+
+
+def test_freeze_pass_bakes_int8_weights():
+    paddle.enable_static()
+    try:
+        main, startup, out = _build()
+        exe = static.Executor()
+        exe.run(startup)
+        feed = {"x": np.random.RandomState(1).rand(8, 4).astype("float32")}
+        before, = exe.run(main, feed=feed, fetch_list=[out])
+
+        QuantizationFreezePass().apply(main)
+        frozen = [op for op in main.ops if op.attrs.get("frozen")]
+        assert frozen, "no op was frozen"
+        for op in frozen:
+            consts = [ref for tag, ref in op.in_refs if tag == "c"]
+            assert any(np.asarray(c).dtype == np.int8 for c in consts), \
+                "frozen op carries no int8 constant"
+        after, = static.Executor().run(main, feed=feed, fetch_list=[out])
+        np.testing.assert_allclose(after, before, rtol=0.05, atol=0.05)
+    finally:
+        paddle.disable_static()
+
+
+def test_frozen_program_serializes_and_reloads(tmp_path):
+    paddle.enable_static()
+    try:
+        main, startup, out = _build()
+        exe = static.Executor()
+        exe.run(startup)
+        feed = {"x": np.ones((8, 4), "float32")}
+        before, = exe.run(main, feed=feed, fetch_list=[out])
+        QuantizationFreezePass().apply(main)
+        path = str(tmp_path / "q.json")
+        main.save(path)
+        loaded, params = static.Program.load(path)
+        lop = [op for op in loaded.ops if op.attrs.get("frozen")]
+        assert lop and lop[0].attr("weight_bits") == 8
+        sc = dict(static.global_scope())
+        sc.update(params)
+        with static.scope_guard(sc):
+            got, = static.Executor().run(
+                loaded, feed=feed,
+                fetch_list=[loaded.vars[out.slot]])
+        np.testing.assert_allclose(got, before, rtol=0.05, atol=0.05)
+    finally:
+        paddle.disable_static()
