@@ -19,16 +19,12 @@ __all__ = ["fake_quantize_dequantize", "QuantizedLinear", "QuantizedConv2D",
 
 def fake_quantize_dequantize(x, bits=8, name=None):
     """abs-max symmetric fake quant with STE (reference
-    `fake_quantize_dequantize_moving_average_abs_max` op family)."""
-    qmax = float(2 ** (bits - 1) - 1)
-
+    `fake_quantize_dequantize_moving_average_abs_max` op family).
+    Raw-array math shared with the QAT Program pass
+    (quant_pass.fake_quant_array)."""
     def impl(v):
-        scale = jnp.maximum(jnp.max(jnp.abs(v)), 1e-8) / qmax
-        q = jnp.round(v / scale)
-        q = jnp.clip(q, -qmax, qmax)
-        dq = q * scale
-        # straight-through: grad flows as identity
-        return v + jax.lax.stop_gradient(dq - v)
+        from .quant_pass import fake_quant_array
+        return fake_quant_array(v, bits)
     return apply_op("fake_quant_dequant", impl, (x,), {})
 
 

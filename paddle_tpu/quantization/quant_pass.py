@@ -6,35 +6,59 @@ QuantizationFreezePass rewrites the trained program to int8 weights).
 TPU redesign over the op-level Program IR: the transform pass WRAPS each
 quantizable op's computation with fake-quant on its inputs (straight-
 through estimator — jax.grad differentiates the wrapped fn directly, no
-separate grad ops needed); the freeze pass bakes weights in as int8
-constants with per-output-channel scales and dequantizes in f32 after
-the int8 contraction.
+separate grad ops needed); the freeze pass bakes the WEIGHT (the ≥2-D
+parameter input) in as an int8 constant with per-output-channel scales,
+drops it from the program's parameter table, and dequantizes inside the
+op body.
+
+Scope note: only block-0 ops are rewritten — ops recorded inside
+cond/while sub-blocks execute through the parent op's fused closure,
+which a Program-level pass cannot reach (a warning is emitted).
 """
 from __future__ import annotations
 
-from typing import Dict, Iterable, Optional, Sequence
+import warnings
+from typing import Dict, Optional, Sequence
 
 import numpy as np
 
-__all__ = ["QuantizationTransformPass", "QuantizationFreezePass"]
+__all__ = ["QuantizationTransformPass", "QuantizationFreezePass",
+           "fake_quant_array"]
 
 _DEFAULT_TYPES = ("matmul", "mul", "linear", "conv2d")
 
 
-def _fake_quant(v, bits):
+def fake_quant_array(v, bits):
+    """abs-max symmetric fake-quant with straight-through gradient on a
+    raw array (shared by fake_quantize_dequantize and the QAT pass)."""
     import jax
     import jax.numpy as jnp
     qmax = 2.0 ** (bits - 1) - 1
     scale = jnp.maximum(jnp.max(jnp.abs(v)), 1e-8) / qmax
-    q = jnp.round(v / scale)
-    # straight-through estimator: identity gradient
-    return v + jax.lax.stop_gradient(jnp.clip(q, -qmax, qmax) * scale - v)
+    q = jnp.clip(jnp.round(v / scale), -qmax, qmax)
+    return v + jax.lax.stop_gradient(q * scale - v)
+
+
+def _bump(program):
+    """Invalidate Executor jit caches: their key includes the program
+    version (static/program.py), which every rewriting pass must bump."""
+    program._version = getattr(program, "_version", 0) + 1
+
+
+def _warn_sub_blocks(program, pass_name):
+    if getattr(program, "num_blocks", 1) > 1:
+        warnings.warn(
+            f"{pass_name}: ops inside cond/while sub-blocks execute "
+            "through their parent op's fused closure and are NOT "
+            "quantized")
 
 
 class QuantizationTransformPass:
     """Wrap quantizable ops with fake-quant on every floating input
     (QAT; reference QuantizationTransformPass inserts
-    fake_quantize_abs_max + fake_dequantize ops around each)."""
+    fake_quantize_abs_max + fake_dequantize ops around each). Parameter
+    inputs quantize at weight_bits, everything else at activation_bits.
+    """
 
     def __init__(self, weight_bits: int = 8, activation_bits: int = 8,
                  quantizable_op_types: Sequence[str] = _DEFAULT_TYPES):
@@ -44,32 +68,42 @@ class QuantizationTransformPass:
 
     def apply(self, program):
         import jax.numpy as jnp
+
+        _warn_sub_blocks(program, "QuantizationTransformPass")
+        param_slots = {v.slot for v in program.param_vars.values()}
         for op in program.ops:
             if op.name not in self.types or op.attrs.get("quant"):
                 continue
+            # args align 1:1 with in_refs (the lowering feeds them in
+            # order), so per-arg bit widths can be fixed at wrap time
+            arg_bits = [self.weight_bits if tag == "s" and ref in
+                        param_slots else self.activation_bits
+                        for tag, ref in op.in_refs]
             inner = op.fn
-            bits = self.activation_bits
 
-            def wrapped(*args, _inner=inner, _bits=bits):
+            def wrapped(*args, _inner=inner, _bits=tuple(arg_bits)):
                 qargs = [
-                    _fake_quant(a, _bits)
+                    fake_quant_array(a, b)
                     if hasattr(a, "dtype")
                     and jnp.issubdtype(jnp.asarray(a).dtype, jnp.floating)
-                    else a for a in args]
+                    else a
+                    for a, b in zip(args, _bits)]
                 return _inner(*qargs)
             op.fn = wrapped
             op.attrs["quant"] = "fake_abs_max"
+            op.attrs["weight_bits"] = self.weight_bits
             op.attrs["activation_bits"] = self.activation_bits
+        _bump(program)
         return program
 
 
 class QuantizationFreezePass:
-    """Bake parameter inputs of quantizable ops in as int8 constants
+    """Bake the weight input of quantizable ops in as an int8 constant
     (reference QuantizationFreezePass converts weights and rewires
-    dequantize after the op). Per-output-channel symmetric scales; the
-    int8 tensor rides the op as a constant, the fn dequantizes into the
-    f32 computation — serving artifacts then carry 1/4 the weight bytes.
-    """
+    dequantize after the op). The weight is the ≥2-D parameter input
+    (biases stay f32); per-output-channel symmetric scales; the frozen
+    parameter leaves program.param_vars so serialized artifacts carry
+    the int8 bytes instead of the f32 tensor."""
 
     def __init__(self, weight_bits: int = 8,
                  quantizable_op_types: Sequence[str] = _DEFAULT_TYPES):
@@ -81,18 +115,23 @@ class QuantizationFreezePass:
 
         from ..static.program import global_scope
         scope = scope if scope is not None else global_scope()
+        _warn_sub_blocks(program, "QuantizationFreezePass")
         qmax = 2.0 ** (self.weight_bits - 1) - 1
         param_slots = {v.slot: n for n, v in program.param_vars.items()}
 
+        frozen_slots = []
         for op in program.ops:
             if op.name not in self.types or op.attrs.get("frozen"):
                 continue
-            w_positions = [i for i, (tag, ref) in enumerate(op.in_refs)
-                           if tag == "s" and ref in param_slots]
+            w_positions = [
+                i for i, (tag, ref) in enumerate(op.in_refs)
+                if tag == "s" and ref in param_slots
+                and np.asarray(scope[param_slots[ref]]).ndim >= 2]
             if not w_positions:
                 continue
-            pos = w_positions[-1]          # weight is the trailing param
-            name = param_slots[op.in_refs[pos][1]]
+            pos = w_positions[0]
+            slot = op.in_refs[pos][1]
+            name = param_slots[slot]
             w = np.asarray(scope[name], np.float32)
             # per-output-channel scale over the last axis
             axes = tuple(range(w.ndim - 1))
@@ -112,4 +151,14 @@ class QuantizationFreezePass:
             op.attrs["frozen"] = "int8"
             op.attrs["weight_bits"] = self.weight_bits
             op.attrs["weight_scale_max"] = float(scale.max())
+            frozen_slots.append(slot)
+
+        # drop frozen weights from the parameter table unless another op
+        # still reads them — serde then omits the f32 tensor entirely
+        still_used = {ref for b in program.blocks for o in b.ops
+                      for tag, ref in o.in_refs if tag == "s"}
+        for slot in frozen_slots:
+            if slot not in still_used and slot in param_slots:
+                program.param_vars.pop(param_slots[slot], None)
+        _bump(program)
         return program

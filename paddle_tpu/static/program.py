@@ -145,6 +145,9 @@ class Program:
         self.param_vars: Dict[str, Variable] = {}
         self.random_ops = False
         self._opt_hooks: List[Callable] = []
+        # bumped by program-rewriting passes so Executor jit caches
+        # keyed on this program invalidate (quant_pass, etc.)
+        self._version = 0
 
     # ops/vars live on block 0 (the executed block); properties keep the
     # flat-program view every consumer (lowering, passes, serde) uses
@@ -480,7 +483,8 @@ class Executor:
         param_arrays = [jnp.asarray(scope[n]) for n in lowered.param_names]
 
         train = hasattr(program, "_loss_slot") and program._opt_hooks
-        key = (id(program), tuple(fetch_slots),
+        key = (id(program), getattr(program, "_version", 0),
+               tuple(fetch_slots),
                tuple((tuple(a.shape), str(a.dtype)) for a in feed_arrays),
                bool(train), len(program.ops))
         fn = self._cache.get(key)

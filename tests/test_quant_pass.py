@@ -31,7 +31,8 @@ def test_transform_pass_marks_and_preserves_function():
         QuantizationTransformPass().apply(main)
         qops = [op for op in main.ops if op.attrs.get("quant")]
         assert qops, "no op was marked for QAT"
-        after, = static.Executor().run(main, feed=feed, fetch_list=[out])
+        # SAME executor must not serve the stale pre-pass jit cache
+        after, = exe.run(main, feed=feed, fetch_list=[out])
         # 8-bit fake-quant: close to the float program but not identical
         np.testing.assert_allclose(after, before, rtol=0.2, atol=0.1)
         assert not np.array_equal(after, before)
@@ -48,15 +49,27 @@ def test_freeze_pass_bakes_int8_weights():
         feed = {"x": np.random.RandomState(1).rand(8, 4).astype("float32")}
         before, = exe.run(main, feed=feed, fetch_list=[out])
 
+        n_params_before = len(main.param_vars)
         QuantizationFreezePass().apply(main)
         frozen = [op for op in main.ops if op.attrs.get("frozen")]
         assert frozen, "no op was frozen"
         for op in frozen:
-            consts = [ref for tag, ref in op.in_refs if tag == "c"]
-            assert any(np.asarray(c).dtype == np.int8 for c in consts), \
-                "frozen op carries no int8 constant"
-        after, = static.Executor().run(main, feed=feed, fetch_list=[out])
+            consts = [np.asarray(ref) for tag, ref in op.in_refs
+                      if tag == "c"]
+            int8s = [c for c in consts if c.dtype == np.int8]
+            assert int8s, "frozen op carries no int8 constant"
+            # the WEIGHT (>=2-D) got frozen, not the bias
+            assert all(c.ndim >= 2 for c in int8s), \
+                [c.shape for c in int8s]
+            # int8 quantization is lossy: the baked constant must not
+            # dequantize exactly back (that would mean a no-op freeze)
+            assert int8s[0].std() > 0
+        # frozen weights left the parameter table (artifact shrinks)
+        assert len(main.param_vars) < n_params_before
+        after, = exe.run(main, feed=feed, fetch_list=[out])
         np.testing.assert_allclose(after, before, rtol=0.05, atol=0.05)
+        assert not np.array_equal(after, before), \
+            "freeze must introduce int8 rounding"
     finally:
         paddle.disable_static()
 
