@@ -27,6 +27,11 @@ __all__ = ["QuantizationTransformPass", "QuantizationFreezePass",
 
 _DEFAULT_TYPES = ("matmul", "mul", "linear", "conv2d")
 
+# pre-QAT fns stashed by the transform pass, keyed by id(op) (the _Op
+# slots classes can't carry extra attributes and fns must stay out of
+# the json-serializable attrs)
+_PRE_QUANT_FNS: Dict[int, object] = {}
+
 
 def fake_quant_array(v, bits):
     """abs-max symmetric fake-quant with straight-through gradient on a
@@ -80,6 +85,10 @@ class QuantizationTransformPass:
                         param_slots else self.activation_bits
                         for tag, ref in op.in_refs]
             inner = op.fn
+            # keep a handle so the freeze pass can replace (not stack on)
+            # the QAT wrapper — the reference freeze removes the
+            # fake-quant ops it supersedes
+            _PRE_QUANT_FNS[id(op)] = inner
 
             def wrapped(*args, _inner=inner, _bits=tuple(arg_bits)):
                 qargs = [
@@ -133,13 +142,23 @@ class QuantizationFreezePass:
             slot = op.in_refs[pos][1]
             name = param_slots[slot]
             w = np.asarray(scope[name], np.float32)
-            # per-output-channel scale over the last axis
-            axes = tuple(range(w.ndim - 1))
+            # per-output-channel scale: conv weights are OIHW (out
+            # channel = axis 0); matmul/linear weights put the output
+            # features last
+            if op.name == "conv2d" and w.ndim == 4:
+                axes = (1, 2, 3)
+            else:
+                axes = tuple(range(w.ndim - 1))
             scale = np.maximum(np.abs(w).max(axis=axes, keepdims=True),
                                1e-8) / qmax
             wq = np.clip(np.round(w / scale), -qmax, qmax).astype(np.int8)
 
-            inner = op.fn
+            # replace (don't stack on) any QAT wrapper: re-fake-quanting
+            # the dequantized weight on a different per-tensor grid would
+            # add rounding error on top of the baked int8 values
+            inner = _PRE_QUANT_FNS.pop(id(op), None) or op.fn
+            if op.attrs.pop("quant", None):
+                op.attrs["qat_trained"] = True
 
             def frozen(*args, _inner=inner, _pos=pos,
                        _scale=jnp.asarray(scale)):

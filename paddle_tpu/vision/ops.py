@@ -3,13 +3,16 @@
 deform_conv2d (API parity subset for the detection model families)."""
 from __future__ import annotations
 
+import functools
+
 import numpy as np
 import jax
 import jax.numpy as jnp
 
 from ..framework.tensor import Tensor, apply_op
 
-__all__ = ["nms", "roi_align", "roi_pool", "yolo_box", "box_coder",
+__all__ = ["nms", "roi_align", "roi_pool", "yolo_box", "yolov3_loss",
+           "box_coder",
            "box_iou", "distribute_fpn_proposals"]
 
 
@@ -212,3 +215,131 @@ def distribute_fpn_proposals(fpn_rois, min_level, max_level, refer_level,
         idxs.append(sel)
     restore = np.argsort(np.concatenate(idxs)) if idxs else np.array([])
     return outs, Tensor(jnp.asarray(restore.astype(np.int32)))
+
+
+def yolov3_loss(x, gt_box, gt_label, anchors, anchor_mask, class_num,
+                ignore_thresh, downsample_ratio, gt_score=None,
+                use_label_smooth=True, name=None):
+    """reference `operators/detection/yolov3_loss_op.cc`.
+
+    x: [N, mask_num*(5+class_num), H, W] raw head output; gt_box
+    [N, B, 4] (cx, cy, w, h normalized to the image); gt_label [N, B];
+    anchors: flat [w0,h0,w1,h1,...] in input pixels; anchor_mask: indices
+    of this scale's anchors. Per-sample scalar loss [N]: BCE on x/y
+    offsets and objectness/class logits, L1 on w/h, box-size weighting
+    (2 - w*h), noobj predictions with best-gt IoU > ignore_thresh
+    excluded. Decode conventions match yolo_box above.
+    """
+    all_anc = np.asarray(anchors, np.float32).reshape(-1, 2)
+    mask = list(anchor_mask)
+    na = len(mask)
+    manc = all_anc[mask]                       # [na, 2]
+
+    def bce(logit, target):
+        return jnp.maximum(logit, 0) - logit * target + \
+            jnp.log1p(jnp.exp(-jnp.abs(logit)))
+
+    def impl(feat, gbox, glabel, gscore=None):
+        N, C, H, W = feat.shape
+        feat = feat.reshape(N, na, 5 + class_num, H, W)
+        in_w = W * downsample_ratio
+        in_h = H * downsample_ratio
+        B = gbox.shape[1]
+        valid = (gbox[:, :, 2] > 0) & (gbox[:, :, 3] > 0)   # [N,B]
+
+        # --- gt -> (anchor, cell) assignment: best w/h IoU over ALL
+        # anchors (centered boxes), kept only if that anchor is masked
+        gw = gbox[:, :, 2] * in_w
+        gh = gbox[:, :, 3] * in_h
+        inter = jnp.minimum(gw[..., None], all_anc[None, None, :, 0]) * \
+            jnp.minimum(gh[..., None], all_anc[None, None, :, 1])
+        union = gw[..., None] * gh[..., None] + \
+            (all_anc[:, 0] * all_anc[:, 1])[None, None] - inter
+        best = jnp.argmax(inter / jnp.maximum(union, 1e-9), -1)  # [N,B]
+        mask_arr = jnp.asarray(mask)
+        an_idx = jnp.argmax(best[..., None] == mask_arr[None, None], -1)
+        assigned = valid & (best[..., None] == mask_arr[None, None]
+                            ).any(-1)                            # [N,B]
+
+        gi = jnp.clip((gbox[:, :, 0] * W).astype(jnp.int32), 0, W - 1)
+        gj = jnp.clip((gbox[:, :, 1] * H).astype(jnp.int32), 0, H - 1)
+        tx = gbox[:, :, 0] * W - gi
+        ty = gbox[:, :, 1] * H - gj
+        tw = jnp.log(jnp.maximum(gw, 1e-9) /
+                     jnp.maximum(manc[an_idx][..., 0], 1e-9))
+        th = jnp.log(jnp.maximum(gh, 1e-9) /
+                     jnp.maximum(manc[an_idx][..., 1], 1e-9))
+        box_w = 2.0 - gbox[:, :, 2] * gbox[:, :, 3]        # size weight
+
+        n_ix = jnp.arange(N)[:, None].repeat(B, 1)
+        sel = (n_ix, an_idx, gi, gj)                       # gather coords
+        px = feat[:, :, 0].transpose(0, 1, 3, 2)[sel]      # logit tx
+        py = feat[:, :, 1].transpose(0, 1, 3, 2)[sel]
+        pw = feat[:, :, 2].transpose(0, 1, 3, 2)[sel]
+        ph = feat[:, :, 3].transpose(0, 1, 3, 2)[sel]
+        pobj = feat[:, :, 4].transpose(0, 1, 3, 2)[sel]
+        pcls = feat[:, :, 5:].transpose(0, 1, 4, 3, 2)[sel]  # [N,B,cls]
+
+        w = (assigned * box_w)
+        sc = gscore if gscore is not None else jnp.ones_like(w)
+        loss_xy = (bce(px, tx) + bce(py, ty)) * w * sc
+        loss_wh = (jnp.abs(pw - tw) + jnp.abs(ph - th)) * w * sc
+        loss_obj_pos = bce(pobj, jnp.ones_like(pobj)) * assigned * sc
+
+        # reference: smooth_weight = min(1/class_num, 1/40); pos 1-s, neg s
+        smooth = min(1.0 / max(class_num, 1), 1.0 / 40) \
+            if use_label_smooth else 0.0
+        onehot = (glabel[..., None] == jnp.arange(class_num)).astype(
+            jnp.float32)
+        onehot = onehot * (1 - 2 * smooth) + smooth
+        loss_cls = (bce(pcls, onehot).sum(-1) * assigned * sc)
+
+        # --- noobj objectness: all predictions except assigned ones,
+        # with best-gt-IoU > ignore_thresh excluded
+        gx0 = jnp.arange(W, dtype=jnp.float32)
+        gy0 = jnp.arange(H, dtype=jnp.float32)
+        bx = (gx0[None, None, None] + jax.nn.sigmoid(feat[:, :, 0])) / W
+        by = (gy0[None, None, :, None] + jax.nn.sigmoid(feat[:, :, 1])) / H
+        bw = jnp.exp(jnp.clip(feat[:, :, 2], -10, 10)) * \
+            manc[None, :, 0, None, None] / in_w
+        bh = jnp.exp(jnp.clip(feat[:, :, 3], -10, 10)) * \
+            manc[None, :, 1, None, None] / in_h
+
+        def iou_with_gts(bx, by, bw, bh, gb, gvalid):
+            px1, px2 = bx - bw / 2, bx + bw / 2
+            py1, py2 = by - bh / 2, by + bh / 2
+            g = gb[:, :, None, None, None]        # [N,B,1,1,1,(4)]
+            gx1 = g[..., 0] - g[..., 2] / 2
+            gx2 = g[..., 0] + g[..., 2] / 2
+            gy1 = g[..., 1] - g[..., 3] / 2
+            gy2 = g[..., 1] + g[..., 3] / 2
+            iw = jnp.maximum(
+                jnp.minimum(px2[:, None], gx2) -
+                jnp.maximum(px1[:, None], gx1), 0)
+            ih = jnp.maximum(
+                jnp.minimum(py2[:, None], gy2) -
+                jnp.maximum(py1[:, None], gy1), 0)
+            inter = iw * ih
+            ua = bw[:, None] * bh[:, None] + g[..., 2] * g[..., 3] - inter
+            iou = inter / jnp.maximum(ua, 1e-9)
+            return jnp.where(gvalid[:, :, None, None, None], iou,
+                             0.0).max(1)
+        best_iou = iou_with_gts(bx, by, bw, bh, gbox, valid)  # [N,na,H,W]
+
+        # .max == logical OR: padded gts share index (n,0,0,0) with real
+        # assignments and a scatter-set could clobber True with False
+        is_assigned = jnp.zeros((N, na, W, H), bool).at[sel].max(
+            assigned, mode="drop").transpose(0, 1, 3, 2)      # [N,na,H,W]
+        noobj = (~is_assigned) & (best_iou <= ignore_thresh)
+        loss_noobj = (bce(feat[:, :, 4], jnp.zeros_like(feat[:, :, 4]))
+                      * noobj).sum((1, 2, 3))
+
+        per_gt = (loss_xy + loss_wh + loss_obj_pos + loss_cls)
+        return per_gt.sum(1) + loss_noobj
+
+    if gt_score is not None:
+        return apply_op("yolov3_loss", impl,
+                        (x, gt_box, gt_label, gt_score), {})
+    return apply_op("yolov3_loss",
+                    functools.partial(impl, gscore=None),
+                    (x, gt_box, gt_label), {})

@@ -97,3 +97,48 @@ def test_frozen_program_serializes_and_reloads(tmp_path):
         np.testing.assert_allclose(got, before, rtol=0.05, atol=0.05)
     finally:
         paddle.disable_static()
+
+
+def test_transform_then_freeze_unwraps_qat():
+    """freeze after QAT must replace the fake-quant wrapper, not stack a
+    second quantization grid on the dequantized weight."""
+    paddle.enable_static()
+    try:
+        main, startup, out = _build()
+        exe = static.Executor()
+        exe.run(startup)
+        feed = {"x": np.random.RandomState(2).rand(8, 4).astype("float32")}
+        ref, = exe.run(main, feed=feed, fetch_list=[out])
+        QuantizationTransformPass().apply(main)
+        QuantizationFreezePass().apply(main)
+        frozen = [op for op in main.ops if op.attrs.get("frozen")]
+        assert frozen
+        for op in frozen:
+            assert not op.attrs.get("quant")        # wrapper removed
+            assert op.attrs.get("qat_trained")
+        got, = exe.run(main, feed=feed, fetch_list=[out])
+        np.testing.assert_allclose(got, ref, rtol=0.05, atol=0.05)
+    finally:
+        paddle.disable_static()
+
+
+def test_freeze_conv_per_output_channel():
+    paddle.enable_static()
+    try:
+        main, startup = static.Program(), static.Program()
+        with static.program_guard(main, startup):
+            x = static.data("x", [2, 3, 8, 8], "float32")
+            h = snn.conv2d(x, 4, 3, padding=1)
+            out = h.sum()
+        exe = static.Executor()
+        exe.run(startup)
+        feed = {"x": np.random.RandomState(3).rand(
+            2, 3, 8, 8).astype("float32")}
+        ref, = exe.run(main, feed=feed, fetch_list=[out])
+        QuantizationFreezePass().apply(main)
+        frozen = [op for op in main.ops if op.attrs.get("frozen")]
+        assert frozen, [op.type for op in main.ops]
+        got, = exe.run(main, feed=feed, fetch_list=[out])
+        np.testing.assert_allclose(got, ref, rtol=0.05, atol=0.5)
+    finally:
+        paddle.disable_static()

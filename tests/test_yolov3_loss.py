@@ -1,0 +1,134 @@
+"""yolov3_loss (reference `operators/detection/yolov3_loss_op.cc`)."""
+import math
+
+import numpy as np
+
+import paddle_tpu as paddle
+from paddle_tpu.vision.ops import yolo_box, yolov3_loss
+
+ANCHORS = [10, 13, 16, 30, 33, 23]
+MASK = [0, 1, 2]
+CLS = 3
+DS = 32
+
+
+def _inputs(N=2, HW=4, seed=0):
+    rng = np.random.RandomState(seed)
+    C = len(MASK) * (5 + CLS)
+    x = rng.randn(N, C, HW, HW).astype("float32") * 0.1
+    gt = np.zeros((N, 2, 4), "float32")
+    gt[:, 0] = [0.4, 0.6, 0.15, 0.2]
+    lab = np.zeros((N, 2), "int64")
+    lab[:, 0] = 1
+    return x, gt, lab
+
+
+def test_shape_positivity_grad():
+    x, gt, lab = _inputs()
+    t = paddle.to_tensor(x)
+    t.stop_gradient = False
+    loss = yolov3_loss(t, paddle.to_tensor(gt), paddle.to_tensor(lab),
+                       ANCHORS, MASK, CLS, ignore_thresh=0.7,
+                       downsample_ratio=DS)
+    assert loss.shape == [2]
+    assert (loss.numpy() > 0).all()
+    loss.sum().backward()
+    g = t.grad.numpy()
+    assert np.isfinite(g).all() and np.abs(g).sum() > 0
+
+
+def test_perfect_prediction_loss_near_zero():
+    """Construct the head output whose decode equals the gt exactly
+    (verified through yolo_box) — every loss term then approaches 0."""
+    N, HW = 1, 4
+    gt = np.zeros((N, 1, 4), "float32")
+    cx, cy, w, h = 0.5625, 0.5625, 0.15, 0.2   # center INSIDE cell (2,2)
+    gt[:, 0] = [cx, cy, w, h]
+    lab = np.zeros((N, 1), "int64")
+    in_sz = HW * DS
+
+    # best anchor by w/h IoU
+    gw, gh = w * in_sz, h * in_sz
+    ious = []
+    for a in range(3):
+        aw, ah = ANCHORS[2 * a], ANCHORS[2 * a + 1]
+        inter = min(gw, aw) * min(gh, ah)
+        ious.append(inter / (gw * gh + aw * ah - inter))
+    best = int(np.argmax(ious))
+    gi, gj = int(cx * HW), int(cy * HW)
+
+    big = 20.0
+    xp = np.full((N, 3, 5 + CLS, HW, HW), -big, "float32")
+    xp[:, :, 2:4] = 0.0
+    tx, ty = cx * HW - gi, cy * HW - gj
+
+    def logit(p):
+        return math.log(p / (1 - p))
+    xp[:, best, 0, gj, gi] = logit(tx)
+    xp[:, best, 1, gj, gi] = logit(ty)
+    aw, ah = ANCHORS[2 * best], ANCHORS[2 * best + 1]
+    xp[:, best, 2, gj, gi] = math.log(gw / aw)
+    xp[:, best, 3, gj, gi] = math.log(gh / ah)
+    xp[:, best, 4, gj, gi] = big
+    xp[:, best, 5 + 0, gj, gi] = big
+
+    x = xp.reshape(N, -1, HW, HW)
+    # decode cross-check: yolo_box recovers the gt box
+    boxes, _ = yolo_box(paddle.to_tensor(x),
+                        paddle.to_tensor(np.array([[in_sz, in_sz]],
+                                                  "int32")),
+                        ANCHORS, CLS, conf_thresh=0.0,
+                        downsample_ratio=DS, clip_bbox=False)
+    bb = boxes.numpy().reshape(-1, 4)
+    flat = best * HW * HW + gj * HW + gi
+    x1, y1, x2, y2 = bb[flat]
+    np.testing.assert_allclose(
+        [(x1 + x2) / 2 / in_sz, (y1 + y2) / 2 / in_sz,
+         (x2 - x1) / in_sz, (y2 - y1) / in_sz],
+        [cx, cy, w, h], rtol=1e-4, atol=1e-4)
+
+    loss = yolov3_loss(paddle.to_tensor(x), paddle.to_tensor(gt),
+                       paddle.to_tensor(lab), ANCHORS, MASK, CLS,
+                       ignore_thresh=0.7, downsample_ratio=DS,
+                       use_label_smooth=False)
+    # BCE against the soft x/y offsets has an irreducible entropy floor
+    # H(t) (same as the reference's sigmoid-CE formulation); everything
+    # else (w/h L1, objectness, class, noobj) must be ~0
+    def H(t):
+        return -t * math.log(t) - (1 - t) * math.log(1 - t)
+    floor = (H(tx) + H(ty)) * (2.0 - w * h)
+    got = float(loss.numpy()[0])
+    np.testing.assert_allclose(got, floor, rtol=1e-3, atol=0.05)
+
+
+def test_ignore_thresh_suppresses_noobj():
+    """A confident prediction overlapping the gt above ignore_thresh at
+    a NON-assigned location must not be punished as noobj."""
+    x, gt, lab = _inputs(N=1)
+    base = yolov3_loss(paddle.to_tensor(x), paddle.to_tensor(gt),
+                       paddle.to_tensor(lab), ANCHORS, MASK, CLS,
+                       ignore_thresh=0.99, downsample_ratio=DS)
+    relaxed = yolov3_loss(paddle.to_tensor(x), paddle.to_tensor(gt),
+                          paddle.to_tensor(lab), ANCHORS, MASK, CLS,
+                          ignore_thresh=0.0, downsample_ratio=DS)
+    # thresh 0: every overlapping prediction is ignored -> less noobj
+    assert float(relaxed.numpy()[0]) <= float(base.numpy()[0])
+
+
+def test_training_reduces_loss():
+    x, gt, lab = _inputs(N=1, HW=4, seed=3)
+    t = paddle.to_tensor(x)
+    t.stop_gradient = False
+    gtt, labt = paddle.to_tensor(gt), paddle.to_tensor(lab)
+    first = None
+    cur = t
+    for i in range(30):
+        cur.stop_gradient = False
+        loss = yolov3_loss(cur, gtt, labt, ANCHORS, MASK, CLS,
+                           ignore_thresh=0.7, downsample_ratio=DS)
+        s = loss.sum()
+        if first is None:
+            first = float(s.numpy())
+        s.backward()
+        cur = paddle.to_tensor(cur.numpy() - 0.05 * cur.grad.numpy())
+    assert float(s.numpy()) < first * 0.7, (first, float(s.numpy()))
