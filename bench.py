@@ -383,6 +383,24 @@ def bench_host_embedding():
     return BATCH_IDS * iters / dt
 
 
+def _with_retries(fn, attempts=3, cooldown_s=20):
+    """Bounded retry for one bench config: transient tunnel/compile
+    errors (HTTP 500 remote_compile, closed response bodies) must not
+    zero a metric for the round."""
+    last = None
+    for i in range(attempts):
+        try:
+            return fn()
+        except Exception as e:  # noqa: BLE001
+            last = e
+            traceback.print_exc()
+            if i + 1 < attempts:
+                sys.stderr.write(f"config attempt {i + 1}/{attempts} "
+                                 f"failed; retrying in {cooldown_s}s\n")
+                time.sleep(cooldown_s)
+    raise last
+
+
 def main():
     try:
         devs = _init_backend()
@@ -395,7 +413,7 @@ def main():
 
     # secondary metrics first; the driver parses the LAST JSON line
     try:
-        ips, mfu = bench_resnet50()
+        ips, mfu = _with_retries(bench_resnet50)
         _emit("resnet50_train_images_per_sec_bs32_bf16", ips, "images/sec",
               mfu=mfu)
     except Exception as e:  # noqa: BLE001
@@ -404,7 +422,8 @@ def main():
               extra={"error": str(e)[:300]})
 
     try:
-        tps_on, mfu_on = bench_gpt_long_seq(use_flash=True)
+        tps_on, mfu_on = _with_retries(
+            lambda: bench_gpt_long_seq(use_flash=True))
     except Exception as e:  # noqa: BLE001
         traceback.print_exc()
         tps_on = None
@@ -413,7 +432,8 @@ def main():
     if tps_on is not None:
         extra = {}
         try:
-            tps_off, _ = bench_gpt_long_seq(use_flash=False)
+            tps_off, _ = _with_retries(
+                lambda: bench_gpt_long_seq(use_flash=False))
             extra = {"flash_off_tokens_per_sec": round(tps_off, 2),
                      "flash_speedup": round(tps_on / max(tps_off, 1e-9), 3)}
         except Exception as e:  # noqa: BLE001
@@ -423,7 +443,7 @@ def main():
               "tokens/sec", mfu=mfu_on, extra=extra)
 
     try:
-        rps = bench_host_embedding()
+        rps = _with_retries(bench_host_embedding)
         _emit("host_embedding_train_ids_per_sec_dim64", rps, "ids/sec")
     except Exception as e:  # noqa: BLE001
         traceback.print_exc()
@@ -431,7 +451,7 @@ def main():
               extra={"error": str(e)[:300]})
 
     try:
-        sps, mfu = bench_ernie()
+        sps, mfu = _with_retries(bench_ernie)
         rec = _emit(_HEADLINE, sps, "samples/sec", mfu=mfu)
         if rec["vs_baseline"] < 0.98:
             sys.stderr.write(

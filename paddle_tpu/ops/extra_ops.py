@@ -704,3 +704,65 @@ def deformable_conv(x, offset, weight, mask=None, stride=1, padding=0,
 
 __all__ += ["fsp", "cross_entropy2", "psroi_pool", "prroi_pool",
             "correlation", "nce", "deformable_conv"]
+
+
+def batch_fc(input, w, bias=None, name=None):
+    """reference `batch_fc_op.cc` (CTR per-slot FC): input
+    [slot_num, B, in_dim] x w [slot_num, in_dim, out_dim] (+ bias
+    [slot_num, out_dim]) -> [slot_num, B, out_dim]."""
+    def impl(x, wv, *bv):
+        out = jnp.einsum("sbi,sio->sbo", x, wv)
+        if bv:
+            out = out + bv[0][:, None, :]
+        return out
+    args = (input, w) + ((bias,) if bias is not None else ())
+    return apply_op("batch_fc", impl, args, {})
+
+
+def sample_logits(logits, label, num_samples, seed=None, name=None):
+    """reference `sample_logits_op.cc` (sampled-softmax prep): keep the
+    true-label logit and `num_samples` uniformly sampled negatives.
+    Returns (sampled_logits [B, 1+S], sampled_ids [B, 1+S]) — column 0
+    is the positive. Sampling uses the framework PRNG (build-time-key
+    convention, like F.dropout)."""
+    from ..framework import random as frandom
+    C = int(logits.shape[-1])
+    B = int(logits.shape[0])
+    key = frandom.get_rng_key() if seed is None \
+        else jax.random.PRNGKey(int(seed))
+    neg = jax.random.randint(key, (B, int(num_samples)), 0, C)
+
+    def impl(lg, yv):
+        y = yv.astype(jnp.int32).reshape(B, 1)
+        ids = jnp.concatenate([y, neg], axis=1)
+        samp = jnp.take_along_axis(lg, ids, axis=1)
+        return samp, ids
+    return apply_op("sample_logits", impl, (logits, label), {})
+
+
+def filter_by_instag(ins, ins_tag, filter_tag, is_lod=True, name=None):
+    """reference `filter_by_instag_op.cc` (CTR): keep the rows whose tag
+    set intersects `filter_tag`. ins: dense [N, D] (row i = instance i);
+    ins_tag: LoDTensor of per-instance tag lists; filter_tag: 1-D ints.
+    Returns (filtered rows, kept row indices, loss_weight)."""
+    from .legacy import LoDTensor, _seq_offsets
+
+    tags = np.asarray(ins_tag._value).reshape(-1).astype(int)
+    offs = _seq_offsets(ins_tag) if isinstance(ins_tag, LoDTensor) \
+        else list(range(len(tags) + 1))
+    want = set(np.asarray(
+        filter_tag._value if isinstance(filter_tag, Tensor)
+        else filter_tag).reshape(-1).astype(int).tolist())
+    keep = [i for i, (a, b) in enumerate(zip(offs[:-1], offs[1:]))
+            if want & set(tags[a:b].tolist())]
+    keep_idx = np.asarray(keep, np.int64)
+    rows = np.asarray(ins._value)[keep_idx] if len(keep) else \
+        np.zeros((1,) + np.asarray(ins._value).shape[1:],
+                 np.asarray(ins._value).dtype)
+    lw = np.ones((max(len(keep), 1), 1), np.float32) if len(keep) else \
+        np.zeros((1, 1), np.float32)
+    return (Tensor(jnp.asarray(rows)), Tensor(jnp.asarray(keep_idx)),
+            Tensor(jnp.asarray(lw)))
+
+
+__all__ += ["batch_fc", "sample_logits", "filter_by_instag"]

@@ -37,7 +37,7 @@ __all__ = [
     "gather_tree", "affine_grid", "temporal_shift", "fsp",
     "cross_entropy2", "psroi_pool", "prroi_pool", "correlation", "nce",
     "deformable_conv", "lod_reset", "sequence_reshape", "sequence_slice",
-    "sequence_scatter",
+    "sequence_scatter", "batch_fc", "sample_logits", "filter_by_instag",
 ]
 
 from .extra_ops import (affine_channel, affine_grid, bpr_loss,  # noqa: E402
@@ -48,6 +48,8 @@ from .extra_ops import (affine_channel, affine_grid, bpr_loss,  # noqa: E402
                         partial_concat, partial_sum, prroi_pool,
                         psroi_pool, rank_loss, row_conv, shuffle_batch,
                         space_to_depth, squared_l2_norm, temporal_shift)
+from .extra_ops import (batch_fc, filter_by_instag,  # noqa: E402
+                        sample_logits)
 
 
 # --------------------------------------------------------------------------

@@ -27,10 +27,16 @@ __all__ = ["QuantizationTransformPass", "QuantizationFreezePass",
 
 _DEFAULT_TYPES = ("matmul", "mul", "linear", "conv2d")
 
-# pre-QAT fns stashed by the transform pass, keyed by id(op) (the _Op
-# slots classes can't carry extra attributes and fns must stay out of
-# the json-serializable attrs)
-_PRE_QUANT_FNS: Dict[int, object] = {}
+def _pre_quant_store(program) -> Dict[int, object]:
+    """Per-program stash of pre-QAT fns keyed by id(op) (the _Op slots
+    class can't carry attributes and fns must stay out of the
+    json-serializable attrs). Living on the Program ties the lifetime to
+    it — a module-global would leak closures and risk id-reuse handing a
+    dead program's fn to a new op."""
+    store = getattr(program, "_pre_quant_fns", None)
+    if store is None:
+        store = program._pre_quant_fns = {}
+    return store
 
 
 def fake_quant_array(v, bits):
@@ -76,6 +82,7 @@ class QuantizationTransformPass:
 
         _warn_sub_blocks(program, "QuantizationTransformPass")
         param_slots = {v.slot for v in program.param_vars.values()}
+        store = _pre_quant_store(program)
         for op in program.ops:
             if op.name not in self.types or op.attrs.get("quant"):
                 continue
@@ -88,7 +95,7 @@ class QuantizationTransformPass:
             # keep a handle so the freeze pass can replace (not stack on)
             # the QAT wrapper — the reference freeze removes the
             # fake-quant ops it supersedes
-            _PRE_QUANT_FNS[id(op)] = inner
+            store[id(op)] = inner
 
             def wrapped(*args, _inner=inner, _bits=tuple(arg_bits)):
                 qargs = [
@@ -153,10 +160,28 @@ class QuantizationFreezePass:
                                1e-8) / qmax
             wq = np.clip(np.round(w / scale), -qmax, qmax).astype(np.int8)
 
-            # replace (don't stack on) any QAT wrapper: re-fake-quanting
-            # the dequantized weight on a different per-tensor grid would
-            # add rounding error on top of the baked int8 values
-            inner = _PRE_QUANT_FNS.pop(id(op), None) or op.fn
+            # replace (don't stack on) the QAT wrapper for the WEIGHT
+            # only: re-fake-quanting the dequantized weight on a
+            # different per-tensor grid would add rounding error on top
+            # of the baked int8 values — but activation fake-quant must
+            # survive the freeze (the reference removes only the weight
+            # fake_quant ops), else the deployed model computes different
+            # activations than the QAT-simulated one the user validated
+            pre_qat = _pre_quant_store(program).pop(id(op), None)
+            if pre_qat is not None:
+                act_bits = int(op.attrs.get("activation_bits", 8))
+
+                def inner(*args, _raw=pre_qat, _wpos=pos,
+                          _abits=act_bits):
+                    qargs = [
+                        a if i == _wpos or not (
+                            hasattr(a, "dtype") and jnp.issubdtype(
+                                jnp.asarray(a).dtype, jnp.floating))
+                        else fake_quant_array(a, _abits)
+                        for i, a in enumerate(args)]
+                    return _raw(*qargs)
+            else:
+                inner = op.fn
             if op.attrs.pop("quant", None):
                 op.attrs["qat_trained"] = True
 

@@ -444,3 +444,38 @@ class TestSequenceLoD:
         upd = self._lt(np.array([1., 1.], "float32"), [0, 2])
         out = paddle.sequence_scatter(base, idx, upd).numpy()
         assert out[0, 0] == 2.0          # both updates land
+
+
+class TestCtrOps:
+    def test_batch_fc(self):
+        x = RNG.rand(3, 4, 5).astype("float32")
+        w = RNG.rand(3, 5, 2).astype("float32")
+        b = RNG.rand(3, 2).astype("float32")
+        out = paddle.batch_fc(paddle.to_tensor(x), paddle.to_tensor(w),
+                              paddle.to_tensor(b)).numpy()
+        ref = np.einsum("sbi,sio->sbo", x, w) + b[:, None]
+        np.testing.assert_allclose(out, ref, rtol=1e-5)
+
+    def test_sample_logits(self):
+        lg = RNG.rand(4, 10).astype("float32")
+        y = np.array([1, 3, 5, 7])
+        samp, ids = paddle.sample_logits(paddle.to_tensor(lg),
+                                         paddle.to_tensor(y), 5, seed=2)
+        assert samp.shape == [4, 6] and ids.shape == [4, 6]
+        np.testing.assert_array_equal(ids.numpy()[:, 0], y)
+        np.testing.assert_allclose(samp.numpy()[:, 0],
+                                   lg[np.arange(4), y], rtol=1e-6)
+        taken = np.take_along_axis(lg, ids.numpy().astype(int), axis=1)
+        np.testing.assert_allclose(samp.numpy(), taken, rtol=1e-6)
+
+    def test_filter_by_instag(self):
+        import jax.numpy as jnp
+        from paddle_tpu.ops.legacy import LoDTensor
+        ins = paddle.to_tensor(np.arange(8, dtype="float32").reshape(4, 2))
+        tags = LoDTensor(jnp.asarray(np.array([1, 2, 3, 2, 9, 4])),
+                         [[0, 2, 3, 5, 6]])
+        out, idx, lw = paddle.filter_by_instag(
+            ins, tags, paddle.to_tensor(np.array([2])))
+        np.testing.assert_array_equal(idx.numpy(), [0, 2])
+        np.testing.assert_allclose(out.numpy(), ins.numpy()[[0, 2]])
+        assert lw.shape == [2, 1]
