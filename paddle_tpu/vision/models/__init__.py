@@ -4,3 +4,4 @@ from .mobilenet import (MobileNetV1, MobileNetV2, mobilenet_v1,
 from .resnet import (ResNet, resnet18, resnet34, resnet50, resnet101,
                      resnet152, wide_resnet50_2, wide_resnet101_2)
 from .vgg import VGG, vgg11, vgg13, vgg16, vgg19
+from .yolov3 import YOLOv3, yolov3

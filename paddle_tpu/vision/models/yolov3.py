@@ -1,0 +1,117 @@
+"""YOLOv3 detection model (reference: PaddleDetection's YOLOv3 built on
+`operators/detection/{yolov3_loss,yolo_box,multiclass_nms}`; the base
+framework ships the ops — this model family wires them the way the
+reference ecosystem does).
+
+TPU-first: a compact DarkNet-style backbone of strided convs (static
+shapes, bf16-friendly), an upsample+concat neck, and one detection head
+per scale. `forward` returns raw head outputs; `loss` sums yolov3_loss
+over scales; `predict` decodes via yolo_box and merges scales.
+"""
+from __future__ import annotations
+
+
+from ... import nn
+from ...nn import functional as F
+from ...ops.manipulation import concat
+from ..ops import yolo_box, yolov3_loss
+
+__all__ = ["YOLOv3", "yolov3"]
+
+# anchors per scale (COCO defaults), flat [w, h] pairs
+_ANCHORS = [10, 13, 16, 30, 33, 23, 30, 61, 62, 45, 59, 119,
+            116, 90, 156, 198, 373, 326]
+# large anchors pair with the coarse stride-32 head (reference
+# PaddleDetection convention for downsamples 32/16/8)
+_MASKS = [[6, 7, 8], [3, 4, 5], [0, 1, 2]]
+
+
+def _conv_bn(cin, cout, k, stride=1):
+    return nn.Sequential(
+        nn.Conv2D(cin, cout, k, stride=stride, padding=k // 2,
+                  bias_attr=False),
+        nn.BatchNorm2D(cout),
+        nn.LeakyReLU(0.1))
+
+
+class _Stage(nn.Layer):
+    def __init__(self, cin, cout):
+        super().__init__()
+        self.down = _conv_bn(cin, cout, 3, stride=2)
+        self.conv1 = _conv_bn(cout, cout // 2, 1)
+        self.conv2 = _conv_bn(cout // 2, cout, 3)
+
+    def forward(self, x):
+        x = self.down(x)
+        return x + self.conv2(self.conv1(x))
+
+
+class YOLOv3(nn.Layer):
+    """Three-scale YOLOv3. `width` scales the channel counts (the full
+    DarkNet53 uses width=64; the default keeps the model compile-fast
+    while structurally identical)."""
+
+    def __init__(self, num_classes=80, width=16):
+        super().__init__()
+        self.num_classes = num_classes
+        w = width
+        self.stem = _conv_bn(3, w, 3)
+        self.c2 = _Stage(w, w * 2)        # /2
+        self.c3 = _Stage(w * 2, w * 4)    # /4
+        self.c4 = _Stage(w * 4, w * 8)    # /8   -> P3 source
+        self.c5 = _Stage(w * 8, w * 16)   # /16  -> P4 source
+        self.c6 = _Stage(w * 16, w * 32)  # /32  -> P5 source
+
+        co = 3 * (5 + num_classes)
+        self.head5 = nn.Conv2D(w * 32, co, 1)
+        self.lat5 = _conv_bn(w * 32, w * 8, 1)
+        self.head4 = nn.Conv2D(w * 16 + w * 8, co, 1)
+        self.lat4 = _conv_bn(w * 16 + w * 8, w * 4, 1)
+        self.head3 = nn.Conv2D(w * 8 + w * 4, co, 1)
+
+    def forward(self, x):
+        x = self.stem(x)
+        x = self.c3(self.c2(x))
+        p3 = self.c4(x)
+        p4 = self.c5(p3)
+        p5 = self.c6(p4)
+
+        out5 = self.head5(p5)
+        up5 = F.interpolate(self.lat5(p5), scale_factor=2, mode="nearest")
+        m4 = concat([p4, up5], axis=1)
+        out4 = self.head4(m4)
+        up4 = F.interpolate(self.lat4(m4), scale_factor=2, mode="nearest")
+        m3 = concat([p3, up4], axis=1)
+        out3 = self.head3(m3)
+        # large->small stride order matches the anchor masks
+        return [out5, out4, out3]
+
+    def loss(self, outputs, gt_box, gt_label, gt_score=None,
+             ignore_thresh=0.7):
+        total = None
+        for out, mask, ds in zip(outputs, _MASKS, (32, 16, 8)):
+            l = yolov3_loss(out, gt_box, gt_label, _ANCHORS, mask,
+                            self.num_classes, ignore_thresh,
+                            downsample_ratio=ds, gt_score=gt_score)
+            total = l if total is None else total + l
+        return total
+
+    def predict(self, outputs, img_size, conf_thresh=0.01):
+        boxes, scores = [], []
+        for out, mask, ds in zip(outputs, _MASKS, (32, 16, 8)):
+            anc = []
+            for m in mask:
+                anc += _ANCHORS[2 * m:2 * m + 2]
+            b, s = yolo_box(out, img_size, anc, self.num_classes,
+                            conf_thresh=conf_thresh, downsample_ratio=ds)
+            boxes.append(b)
+            scores.append(s)
+        return concat(boxes, axis=1), concat(scores, axis=1)
+
+
+def yolov3(pretrained=False, num_classes=80, **kwargs):
+    if pretrained:
+        import warnings
+        warnings.warn("pretrained YOLOv3 weights unavailable offline; "
+                      "returning a randomly initialized model")
+    return YOLOv3(num_classes=num_classes, **kwargs)

@@ -1,0 +1,41 @@
+"""YOLOv3 model family (reference: PaddleDetection YOLOv3 over the
+framework's detection ops)."""
+import numpy as np
+
+import paddle_tpu as paddle
+from paddle_tpu.vision.models import YOLOv3
+
+
+def test_forward_scales_and_predict():
+    m = YOLOv3(num_classes=4, width=8)
+    m.eval()
+    x = paddle.to_tensor(np.random.rand(2, 3, 64, 64).astype("float32"))
+    outs = m(x)
+    co = 3 * (5 + 4)
+    assert [tuple(o.shape) for o in outs] == [
+        (2, co, 2, 2), (2, co, 4, 4), (2, co, 8, 8)]
+    boxes, scores = m.predict(outs, paddle.to_tensor(
+        np.array([[64, 64], [64, 64]], "int32")))
+    n = 3 * (2 * 2 + 4 * 4 + 8 * 8)
+    assert tuple(boxes.shape) == (2, n, 4)
+    assert tuple(scores.shape) == (2, n, 4)
+
+
+def test_loss_trains():
+    paddle.seed(0)
+    m = YOLOv3(num_classes=3, width=8)
+    opt = paddle.optimizer.Adam(5e-3, parameters=m.parameters())
+    x = paddle.to_tensor(np.random.rand(1, 3, 64, 64).astype("float32"))
+    gt = paddle.to_tensor(np.array([[[0.5, 0.5, 0.3, 0.4]]], "float32"))
+    lab = paddle.to_tensor(np.zeros((1, 1), "int64"))
+    first = None
+    for i in range(8):
+        outs = m(x)
+        loss = m.loss(outs, gt, lab).sum()
+        if first is None:
+            first = float(loss.numpy())
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+    assert np.isfinite(float(loss.numpy()))
+    assert float(loss.numpy()) < first, (first, float(loss.numpy()))
