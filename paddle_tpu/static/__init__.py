@@ -123,3 +123,4 @@ def load(program, model_path, executor=None, var_list=None):
     with open(model_path + ".pdparams", "rb") as f:
         state = pickle.load(f)
     global_scope().update(state)
+from . import amp  # noqa: F401,E402  (paddle.static.amp.decorate)

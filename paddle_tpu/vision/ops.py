@@ -12,7 +12,8 @@ import jax.numpy as jnp
 from ..framework.tensor import Tensor, apply_op
 
 __all__ = ["nms", "roi_align", "roi_pool", "yolo_box", "yolov3_loss",
-           "box_coder",
+           "anchor_generator", "prior_box", "generate_proposals",
+           "multiclass_nms", "box_coder",
            "box_iou", "distribute_fpn_proposals"]
 
 
@@ -343,3 +344,174 @@ def yolov3_loss(x, gt_box, gt_label, anchors, anchor_mask, class_num,
     return apply_op("yolov3_loss",
                     functools.partial(impl, gscore=None),
                     (x, gt_box, gt_label), {})
+
+
+def anchor_generator(input, anchor_sizes, aspect_ratios, variances=None,
+                     stride=None, offset=0.5, name=None):
+    """reference `operators/detection/anchor_generator_op.cc` (RPN
+    anchors): per feature-map cell, one anchor per (size, ratio) pair,
+    centered with `offset`, in input-image coordinates.
+    Returns (anchors [H, W, A, 4] xyxy, variances [H, W, A, 4])."""
+    H, W = int(input.shape[2]), int(input.shape[3])
+    stride = stride or [16.0, 16.0]
+    variances = variances or [0.1, 0.1, 0.2, 0.2]
+    combos = [(s, r) for r in aspect_ratios for s in anchor_sizes]
+    A = len(combos)
+    anc = np.zeros((H, W, A, 4), np.float32)
+    cx = (np.arange(W) + offset) * stride[0]
+    cy = (np.arange(H) + offset) * stride[1]
+    for a, (s, r) in enumerate(combos):
+        # reference convention: aspect_ratio = h/w (anchor_generator_op)
+        aw = s / float(np.sqrt(r))
+        ah = s * float(np.sqrt(r))
+        anc[:, :, a, 0] = cx[None, :] - aw / 2
+        anc[:, :, a, 1] = cy[:, None] - ah / 2
+        anc[:, :, a, 2] = cx[None, :] + aw / 2
+        anc[:, :, a, 3] = cy[:, None] + ah / 2
+    var = np.broadcast_to(np.asarray(variances, np.float32),
+                          (H, W, A, 4)).copy()
+    return Tensor(jnp.asarray(anc)), Tensor(jnp.asarray(var))
+
+
+def prior_box(input, image, min_sizes, max_sizes=None, aspect_ratios=None,
+              variance=None, flip=True, clip=False, steps=None,
+              offset=0.5, name=None):
+    """reference `operators/detection/prior_box_op.cc` (SSD priors):
+    normalized [0,1] boxes per cell from min/max sizes and ratios.
+    Returns (boxes [H, W, P, 4], variances [H, W, P, 4])."""
+    H, W = int(input.shape[2]), int(input.shape[3])
+    imH, imW = int(image.shape[2]), int(image.shape[3])
+    aspect_ratios = list(aspect_ratios or [1.0])
+    ratios = [1.0]
+    for r in aspect_ratios:
+        if all(abs(r - e) > 1e-6 for e in ratios):
+            ratios.append(r)
+            if flip:
+                ratios.append(1.0 / r)
+    variance = variance or [0.1, 0.1, 0.2, 0.2]
+    steps = steps or [imW / W, imH / H]
+    boxes = []
+    for ms_i, ms in enumerate(min_sizes):
+        for r in ratios:
+            boxes.append((ms * np.sqrt(r), ms / np.sqrt(r)))
+        if max_sizes:
+            big = np.sqrt(ms * max_sizes[ms_i])
+            boxes.append((big, big))
+    P = len(boxes)
+    out = np.zeros((H, W, P, 4), np.float32)
+    cx = (np.arange(W) + offset) * steps[0] / imW
+    cy = (np.arange(H) + offset) * steps[1] / imH
+    for p, (bw, bh) in enumerate(boxes):
+        out[:, :, p, 0] = cx[None, :] - bw / imW / 2
+        out[:, :, p, 1] = cy[:, None] - bh / imH / 2
+        out[:, :, p, 2] = cx[None, :] + bw / imW / 2
+        out[:, :, p, 3] = cy[:, None] + bh / imH / 2
+    if clip:
+        out = np.clip(out, 0.0, 1.0)
+    var = np.broadcast_to(np.asarray(variance, np.float32),
+                          (H, W, P, 4)).copy()
+    return Tensor(jnp.asarray(out)), Tensor(jnp.asarray(var))
+
+
+def generate_proposals(scores, bbox_deltas, img_size, anchors, variances,
+                       pre_nms_top_n=6000, post_nms_top_n=1000,
+                       nms_thresh=0.5, min_size=0.1, eta=1.0,
+                       return_rois_num=True, name=None):
+    """reference `operators/detection/generate_proposals_op.cc` (RPN):
+    decode deltas on anchors, clip to image, drop tiny boxes, NMS, keep
+    post_nms_top_n. Dynamic output ⇒ eager host math like nms() above.
+    scores [N, A, H, W]; bbox_deltas [N, 4*A, H, W]; anchors/variances
+    [H, W, A, 4]. Returns (rois [R,4], roi_scores [R,1], rois_num [N])."""
+    sc = np.asarray(scores.numpy() if isinstance(scores, Tensor)
+                    else scores)
+    bd = np.asarray(bbox_deltas.numpy()
+                    if isinstance(bbox_deltas, Tensor) else bbox_deltas)
+    anc = np.asarray(anchors.numpy() if isinstance(anchors, Tensor)
+                     else anchors).reshape(-1, 4)
+    var = np.asarray(variances.numpy() if isinstance(variances, Tensor)
+                     else variances).reshape(-1, 4)
+    im = np.asarray(img_size.numpy() if isinstance(img_size, Tensor)
+                    else img_size)
+    N, A, H, W = sc.shape
+    all_rois, all_scores, nums = [], [], []
+    for n in range(N):
+        s = sc[n].transpose(1, 2, 0).reshape(-1)           # H*W*A
+        d = bd[n].reshape(A, 4, H, W).transpose(2, 3, 0, 1
+                                                ).reshape(-1, 4)
+        order = np.argsort(-s)[:pre_nms_top_n]
+        s, d, a, v = s[order], d[order], anc[order], var[order]
+        aw = a[:, 2] - a[:, 0]
+        ah = a[:, 3] - a[:, 1]
+        acx = a[:, 0] + aw / 2
+        acy = a[:, 1] + ah / 2
+        cx = v[:, 0] * d[:, 0] * aw + acx
+        cy = v[:, 1] * d[:, 1] * ah + acy
+        w = np.exp(np.minimum(v[:, 2] * d[:, 2], 10.0)) * aw
+        h = np.exp(np.minimum(v[:, 3] * d[:, 3], 10.0)) * ah
+        imh, imw = float(im[n, 0]), float(im[n, 1])
+        x1 = np.clip(cx - w / 2, 0, imw - 1)
+        y1 = np.clip(cy - h / 2, 0, imh - 1)
+        x2 = np.clip(cx + w / 2, 0, imw - 1)
+        y2 = np.clip(cy + h / 2, 0, imh - 1)
+        keep = ((x2 - x1 + 1) >= min_size) & ((y2 - y1 + 1) >= min_size)
+        boxes = np.stack([x1, y1, x2, y2], 1)[keep]
+        s = s[keep]
+        kept = nms(boxes, iou_threshold=nms_thresh, scores=s,
+                   top_k=post_nms_top_n)
+        ki = np.asarray(kept.numpy(), int)
+        all_rois.append(boxes[ki])
+        all_scores.append(s[ki, None])
+        nums.append(len(ki))
+    rois = np.concatenate(all_rois, 0) if all_rois else \
+        np.zeros((0, 4), np.float32)
+    rs = np.concatenate(all_scores, 0) if all_scores else \
+        np.zeros((0, 1), np.float32)
+    out = (Tensor(jnp.asarray(rois.astype(np.float32))),
+           Tensor(jnp.asarray(rs.astype(np.float32))))
+    if return_rois_num:
+        return out + (Tensor(jnp.asarray(np.asarray(nums, np.int32))),)
+    return out
+
+
+def multiclass_nms(bboxes, scores, score_threshold=0.05, nms_top_k=1000,
+                   keep_top_k=100, nms_threshold=0.3, normalized=True,
+                   background_label=0, name=None):
+    """reference `operators/detection/multiclass_nms_op.cc`: per-class
+    NMS (one nms() call with category_idxs) then global keep_top_k.
+    bboxes [N, M, 4]; scores [N, C, M]; class `background_label` is
+    skipped (reference default 0). Returns (out [R, 6] =
+    (label, score, x1, y1, x2, y2), rois_num [N])."""
+    b = np.asarray(bboxes.numpy() if isinstance(bboxes, Tensor)
+                   else bboxes)
+    s = np.asarray(scores.numpy() if isinstance(scores, Tensor)
+                   else scores)
+    N, C, M = s.shape
+    outs, nums = [], []
+    for n in range(N):
+        cand_b, cand_s, cand_c = [], [], []
+        for c in range(C):
+            if c == background_label:
+                continue
+            m = s[n, c] > score_threshold
+            if not m.any():
+                continue
+            cb, cs = b[n][m], s[n, c][m]
+            order = np.argsort(-cs)[:nms_top_k]
+            cand_b.append(cb[order])
+            cand_s.append(cs[order])
+            cand_c.append(np.full(len(order), c, np.int64))
+        if not cand_b:
+            nums.append(0)
+            continue
+        cb = np.concatenate(cand_b, 0)
+        cs = np.concatenate(cand_s, 0)
+        cc = np.concatenate(cand_c, 0)
+        kept = np.asarray(nms(cb, iou_threshold=nms_threshold, scores=cs,
+                              category_idxs=cc,
+                              top_k=keep_top_k).numpy(), int)
+        outs.extend((cc[k], cs[k], *cb[k]) for k in kept)
+        nums.append(len(kept))
+    arr = np.asarray(outs, np.float32) if outs else \
+        np.zeros((0, 6), np.float32)
+    return (Tensor(jnp.asarray(arr)),
+            Tensor(jnp.asarray(np.asarray(nums, np.int32))))

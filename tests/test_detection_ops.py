@@ -1,0 +1,84 @@
+"""Two-stage/SSD detection ops (reference `operators/detection/`:
+anchor_generator, prior_box, generate_proposals, multiclass_nms)."""
+import numpy as np
+
+import paddle_tpu as paddle
+from paddle_tpu.vision.ops import (anchor_generator, generate_proposals,
+                                   multiclass_nms, prior_box)
+
+
+def test_anchor_generator():
+    feat = paddle.to_tensor(np.zeros((1, 8, 4, 6), "float32"))
+    anc, var = anchor_generator(feat, anchor_sizes=[64, 128],
+                                aspect_ratios=[1.0, 2.0],
+                                stride=[16, 16])
+    assert anc.shape == [4, 6, 4, 4] and var.shape == [4, 6, 4, 4]
+    a = anc.numpy()
+    # first anchor at cell (0,0): size 64 ratio 1 centered at (8, 8)
+    np.testing.assert_allclose(a[0, 0, 0], [8 - 32, 8 - 32, 8 + 32,
+                                            8 + 32])
+    # centers step by the stride
+    np.testing.assert_allclose(a[0, 1, 0] - a[0, 0, 0], [16, 0, 16, 0])
+    # reference convention ratio = h/w: ratio-2 anchor is taller
+    w = a[0, 0, 2, 2] - a[0, 0, 2, 0]
+    h = a[0, 0, 2, 3] - a[0, 0, 2, 1]
+    np.testing.assert_allclose(h / w, 2.0, rtol=1e-5)
+
+
+def test_prior_box_normalized():
+    feat = paddle.to_tensor(np.zeros((1, 8, 2, 2), "float32"))
+    img = paddle.to_tensor(np.zeros((1, 3, 64, 64), "float32"))
+    boxes, var = prior_box(feat, img, min_sizes=[16.0], max_sizes=[32.0],
+                           aspect_ratios=[2.0], clip=True)
+    # ratios [1, 2, 1/2] from min + 1 from sqrt(min*max) = 4 priors
+    assert boxes.shape == [2, 2, 4, 4]
+    b = boxes.numpy()
+    assert (b >= 0).all() and (b <= 1).all()
+    # square prior at cell (0,0): center 16/64=0.25, half 8/64=0.125
+    np.testing.assert_allclose(b[0, 0, 0],
+                               [0.125, 0.125, 0.375, 0.375], atol=1e-6)
+
+
+def test_generate_proposals_decodes_and_keeps_best():
+    H = W = 4
+    A = 2
+    anc = np.zeros((H, W, A, 4), np.float32)
+    for i in range(H):
+        for j in range(W):
+            for a in range(A):
+                cx, cy = j * 16 + 8, i * 16 + 8
+                sz = 16 * (a + 1)
+                anc[i, j, a] = [cx - sz / 2, cy - sz / 2,
+                                cx + sz / 2, cy + sz / 2]
+    var = np.full((H, W, A, 4), 1.0, np.float32)
+    scores = np.random.RandomState(0).rand(1, A, H, W).astype("float32")
+    scores[0, 0, 2, 2] = 5.0                       # clear winner
+    deltas = np.zeros((1, 4 * A, H, W), "float32")  # identity decode
+    rois, rs, num = generate_proposals(
+        paddle.to_tensor(scores), paddle.to_tensor(deltas),
+        paddle.to_tensor(np.array([[64, 64]], "float32")),
+        paddle.to_tensor(anc), paddle.to_tensor(var),
+        post_nms_top_n=5, nms_thresh=0.5)
+    assert int(num.numpy()[0]) == rois.shape[0] <= 5
+    # the top-scored anchor (cell (2,2), a=0) survives at rank 0
+    np.testing.assert_allclose(
+        rs.numpy()[0, 0], 5.0, rtol=1e-6)
+    np.testing.assert_allclose(
+        rois.numpy()[0], [32, 32, 48, 48], atol=1.0)
+
+
+def test_multiclass_nms():
+    boxes = np.array([[[0, 0, 10, 10], [0, 0, 10.5, 10.5],
+                       [20, 20, 30, 30]]], "float32")
+    scores = np.zeros((1, 3, 3), "float32")
+    scores[0, 0] = [0.99, 0.99, 0.99]     # background: must be skipped
+    scores[0, 1] = [0.9, 0.85, 0.1]       # class 1: two overlapping
+    scores[0, 2] = [0.05, 0.02, 0.8]      # class 2: the far box
+    out, num = multiclass_nms(paddle.to_tensor(boxes),
+                              paddle.to_tensor(scores),
+                              score_threshold=0.5, nms_threshold=0.3)
+    o = out.numpy()
+    assert int(num.numpy()[0]) == 2       # overlap suppressed per class
+    labels = sorted(o[:, 0].tolist())
+    assert labels == [1.0, 2.0]           # background label 0 skipped
+    assert o[0, 1] >= o[1, 1]             # sorted by score

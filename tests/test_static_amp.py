@@ -1,0 +1,67 @@
+"""paddle.static.amp (reference `fluid/contrib/mixed_precision/`:
+decorate + rewrite_program + fp16 lists)."""
+import numpy as np
+
+import paddle_tpu as paddle
+from paddle_tpu import static
+from paddle_tpu.static import nn as snn
+
+
+def test_decorate_rewrites_and_trains():
+    paddle.enable_static()
+    try:
+        main, startup = static.Program(), static.Program()
+        with static.program_guard(main, startup):
+            x = static.data("x", [16, 8], "float32")
+            y = static.data("y", [16, 1], "float32")
+            h = snn.fc(x, 32, activation="relu")
+            pred = snn.fc(h, 1)
+            loss = ((pred - y) * (pred - y)).mean()
+            opt = paddle.optimizer.SGD(0.05)
+            opt = static.amp.decorate(opt)
+            opt.minimize(loss)
+
+        # white-listed matmuls got the bf16 wrap, black-listed stayed f32
+        amp_ops = {op.type: op.attrs.get("amp_dtype")
+                   for op in main.ops if op.attrs.get("amp_dtype")}
+        assert any(v == "bfloat16" for v in amp_ops.values()), amp_ops
+
+        exe = static.Executor()
+        exe.run(startup)
+        rng = np.random.RandomState(0)
+        xv = rng.rand(16, 8).astype("float32")
+        yv = (xv.sum(1, keepdims=True) / 4).astype("float32")
+        losses = []
+        for _ in range(40):
+            lv, = exe.run(main, feed={"x": xv, "y": yv},
+                          fetch_list=[loss])
+            losses.append(float(lv))
+        assert losses[-1] < losses[0] * 0.5, losses[::10]
+    finally:
+        paddle.disable_static()
+
+
+def test_rewrite_program_standalone_matches_f32_within_bf16():
+    paddle.enable_static()
+    try:
+        main, startup = static.Program(), static.Program()
+        with static.program_guard(main, startup):
+            x = static.data("x", [4, 8], "float32")
+            h = snn.fc(x, 8, activation="relu")
+            out = snn.softmax(h)
+        exe = static.Executor()
+        exe.run(startup)
+        feed = {"x": np.random.RandomState(1).rand(4, 8).astype("float32")}
+        ref, = exe.run(main, feed=feed, fetch_list=[out])
+        static.amp.rewrite_program(main)
+        got, = exe.run(main, feed=feed, fetch_list=[out])
+        np.testing.assert_allclose(got, ref, rtol=2e-2, atol=2e-2)
+        assert not np.array_equal(got, ref)   # bf16 rounding visible
+    finally:
+        paddle.disable_static()
+
+
+def test_custom_lists():
+    lists = static.amp.CustomOpLists(custom_black_list=["matmul"])
+    assert "matmul" in lists.black_list
+    assert "matmul" not in lists.white_list
