@@ -103,10 +103,9 @@ def decorate(optimizer, amp_lists=None, init_loss_scaling=2.0 ** 15,
              use_dynamic_loss_scaling=True, dest_dtype="bfloat16",
              **kwargs):
     """reference `decorator.py` decorate()."""
-    if dest_dtype in ("bfloat16", "bf16") and use_dynamic_loss_scaling:
-        # bf16 has float32's exponent range; scaling is a no-op here
-        pass
-    elif dest_dtype == "float16":
+    # bf16 has float32's exponent range, so the loss-scaling knobs are
+    # intentionally unused for the default dest dtype
+    if dest_dtype == "float16":
         warnings.warn("float16 static AMP uses the bf16 path's cast "
                       "rewrite; GradScaler-based loss scaling is the "
                       "dygraph API (paddle.amp.GradScaler)")

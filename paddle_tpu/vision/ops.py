@@ -30,10 +30,13 @@ def box_iou(boxes1, boxes2):
 
 
 def nms(boxes, iou_threshold=0.3, scores=None, category_idxs=None,
-        categories=None, top_k=None):
+        categories=None, top_k=None, offset=0.0, eta=1.0):
     """Greedy NMS (reference `operators/detection/nms_op` /
     multiclass_nms). Dynamic output ⇒ eager (numpy) like the reference's
-    CPU path; scoring models run the box head on TPU, NMS on host."""
+    CPU path; scoring models run the box head on TPU, NMS on host.
+    offset: 1.0 for the un-normalized pixel convention (w = x2-x1+1);
+    eta < 1 decays the threshold after each kept box while it exceeds
+    0.5 (the reference's adaptive NMS)."""
     b = np.asarray(boxes.numpy() if isinstance(boxes, Tensor) else boxes)
     s = (np.asarray(scores.numpy() if isinstance(scores, Tensor) else scores)
          if scores is not None else np.arange(len(b))[::-1].astype("float32"))
@@ -46,6 +49,7 @@ def nms(boxes, iou_threshold=0.3, scores=None, category_idxs=None,
         idx = np.where(cat == c)[0]
         order = idx[np.argsort(-s[idx])]
         keep = []
+        thr = float(iou_threshold)
         while order.size:
             i = order[0]
             keep.append(i)
@@ -56,13 +60,17 @@ def nms(boxes, iou_threshold=0.3, scores=None, category_idxs=None,
             yy1 = np.maximum(b[i, 1], b[rest, 1])
             xx2 = np.minimum(b[i, 2], b[rest, 2])
             yy2 = np.minimum(b[i, 3], b[rest, 3])
-            w = np.clip(xx2 - xx1, 0, None)
-            h = np.clip(yy2 - yy1, 0, None)
+            w = np.clip(xx2 - xx1 + offset, 0, None)
+            h = np.clip(yy2 - yy1 + offset, 0, None)
             inter = w * h
-            a1 = (b[i, 2] - b[i, 0]) * (b[i, 3] - b[i, 1])
-            a2 = (b[rest, 2] - b[rest, 0]) * (b[rest, 3] - b[rest, 1])
+            a1 = (b[i, 2] - b[i, 0] + offset) * \
+                (b[i, 3] - b[i, 1] + offset)
+            a2 = (b[rest, 2] - b[rest, 0] + offset) * \
+                (b[rest, 3] - b[rest, 1] + offset)
             iou = inter / (a1 + a2 - inter + 1e-10)
-            order = rest[iou <= iou_threshold]
+            order = rest[iou <= thr]
+            if eta < 1.0 and thr > 0.5:
+                thr *= eta
         keep_all.extend(keep)
     keep_all = sorted(keep_all, key=lambda i: -s[i])
     if top_k is not None:
@@ -346,7 +354,7 @@ def yolov3_loss(x, gt_box, gt_label, anchors, anchor_mask, class_num,
                     (x, gt_box, gt_label), {})
 
 
-def anchor_generator(input, anchor_sizes, aspect_ratios, variances=None,
+def anchor_generator(input, anchor_sizes, aspect_ratios, variance=None,
                      stride=None, offset=0.5, name=None):
     """reference `operators/detection/anchor_generator_op.cc` (RPN
     anchors): per feature-map cell, one anchor per (size, ratio) pair,
@@ -354,7 +362,7 @@ def anchor_generator(input, anchor_sizes, aspect_ratios, variances=None,
     Returns (anchors [H, W, A, 4] xyxy, variances [H, W, A, 4])."""
     H, W = int(input.shape[2]), int(input.shape[3])
     stride = stride or [16.0, 16.0]
-    variances = variances or [0.1, 0.1, 0.2, 0.2]
+    variance = variance or [0.1, 0.1, 0.2, 0.2]
     combos = [(s, r) for r in aspect_ratios for s in anchor_sizes]
     A = len(combos)
     anc = np.zeros((H, W, A, 4), np.float32)
@@ -368,7 +376,7 @@ def anchor_generator(input, anchor_sizes, aspect_ratios, variances=None,
         anc[:, :, a, 1] = cy[:, None] - ah / 2
         anc[:, :, a, 2] = cx[None, :] + aw / 2
         anc[:, :, a, 3] = cy[:, None] + ah / 2
-    var = np.broadcast_to(np.asarray(variances, np.float32),
+    var = np.broadcast_to(np.asarray(variance, np.float32),
                           (H, W, A, 4)).copy()
     return Tensor(jnp.asarray(anc)), Tensor(jnp.asarray(var))
 
@@ -389,7 +397,9 @@ def prior_box(input, image, min_sizes, max_sizes=None, aspect_ratios=None,
             if flip:
                 ratios.append(1.0 / r)
     variance = variance or [0.1, 0.1, 0.2, 0.2]
-    steps = steps or [imW / W, imH / H]
+    # reference sentinel: step 0 means "derive from image/feature ratio"
+    if not steps or steps[0] == 0 or steps[1] == 0:
+        steps = [imW / W, imH / H]
     boxes = []
     for ms_i, ms in enumerate(min_sizes):
         for r in ratios:
@@ -457,7 +467,7 @@ def generate_proposals(scores, bbox_deltas, img_size, anchors, variances,
         boxes = np.stack([x1, y1, x2, y2], 1)[keep]
         s = s[keep]
         kept = nms(boxes, iou_threshold=nms_thresh, scores=s,
-                   top_k=post_nms_top_n)
+                   top_k=post_nms_top_n, eta=eta)
         ki = np.asarray(kept.numpy(), int)
         all_rois.append(boxes[ki])
         all_scores.append(s[ki, None])
@@ -507,8 +517,9 @@ def multiclass_nms(bboxes, scores, score_threshold=0.05, nms_top_k=1000,
         cs = np.concatenate(cand_s, 0)
         cc = np.concatenate(cand_c, 0)
         kept = np.asarray(nms(cb, iou_threshold=nms_threshold, scores=cs,
-                              category_idxs=cc,
-                              top_k=keep_top_k).numpy(), int)
+                              category_idxs=cc, top_k=keep_top_k,
+                              offset=0.0 if normalized else 1.0
+                              ).numpy(), int)
         outs.extend((cc[k], cs[k], *cb[k]) for k in kept)
         nums.append(len(kept))
     arr = np.asarray(outs, np.float32) if outs else \
