@@ -766,3 +766,78 @@ def filter_by_instag(ins, ins_tag, filter_tag, is_lod=True, name=None):
 
 
 __all__ += ["batch_fc", "sample_logits", "filter_by_instag"]
+
+
+def var_conv_2d(input, row, col, input_channel, output_channel,
+                filter_size, stride=1, w=None, name=None):
+    """reference `operators/var_conv_2d_op.cc` (variable-size image conv
+    over a LoD batch): each sequence i is an image flattened to
+    [C_in * row_i * col_i] rows; conv2d applies per image. Host-side
+    loop like the other LoD ops (XLA needs static shapes per call, and
+    each image gets its own shape).
+
+    input: LoDTensor whose level-0 offsets delimit images; row/col:
+    per-image heights/widths; w: [C_out, C_in, k, k] filter (created if
+    None). Returns a LoDTensor of flattened conv outputs."""
+    from ..nn import functional as F
+    from .legacy import LoDTensor, _seq_offsets, create_parameter
+
+    k = filter_size if isinstance(filter_size, int) else filter_size[0]
+    s = stride if isinstance(stride, int) else stride[0]
+    if w is None:
+        w = create_parameter([output_channel, input_channel, k, k],
+                             "float32")
+    offs = _seq_offsets(input)
+    v = np.asarray(input._value).reshape(-1)
+    rows = np.asarray(row.numpy() if isinstance(row, Tensor)
+                      else row).reshape(-1).astype(int)
+    cols = np.asarray(col.numpy() if isinstance(col, Tensor)
+                      else col).reshape(-1).astype(int)
+    outs, new_offs = [], [0]
+    for i, (a, b) in enumerate(zip(offs[:-1], offs[1:])):
+        img = v[a:b].reshape(1, input_channel, rows[i], cols[i])
+        o = F.conv2d(Tensor(jnp.asarray(img)), w, stride=s,
+                     padding=k // 2)
+        flat = np.asarray(o.numpy()).reshape(-1)
+        outs.append(flat)
+        new_offs.append(new_offs[-1] + flat.size)
+    return LoDTensor(jnp.asarray(np.concatenate(outs)), [new_offs])
+
+
+def tree_conv(nodes_vector, edge_set, filter, max_depth=1, name=None):
+    """reference `operators/tree_conv_op.cc` (TBCNN continuous binary
+    tree convolution): for each node, aggregate its (<= max_depth)-hop
+    subtree with position-interpolated filters W_t (top), W_l, W_r.
+
+    nodes_vector [B, N, D]; edge_set [B, E, 2] (parent, child) int pairs
+    (0-padded); filter [D, H, 3] holding (W_t, W_l, W_r). Returns
+    [B, N, H]. The per-node receptive field is its direct children (the
+    depth-1 TBCNN window, the common configuration)."""
+    def impl(x, edges, f):
+        B, N, D = x.shape
+        wt, wl, wr = f[..., 0], f[..., 1], f[..., 2]   # [D, H]
+        par = edges[..., 0].astype(jnp.int32)          # [B, E]
+        chi = edges[..., 1].astype(jnp.int32)
+        valid = (par != chi)                           # padding: (0,0)
+
+        # children per parent: counts + left-to-right position
+        onehot = (jnp.arange(N)[None, :, None] == par[:, None, :]) \
+            & valid[:, None, :]                        # [B, N, E]
+        n_child = onehot.sum(-1)                       # [B, N]
+        order = jnp.cumsum(onehot, axis=-1) * onehot   # 1-based position
+        # eta_l/eta_r per TBCNN: position interpolation in [0, 1]
+        denom = jnp.maximum(n_child[:, :, None] - 1, 1)
+        eta_r = (order - 1) / denom * onehot
+        eta_l = (1 - (order - 1) / denom) * onehot
+
+        child_vec = jnp.take_along_axis(
+            x, chi[:, :, None].repeat(D, -1), axis=1)  # [B, E, D]
+        top = jnp.einsum("bnd,dh->bnh", x, wt)
+        left = jnp.einsum("bne,bed,dh->bnh", eta_l, child_vec, wl)
+        right = jnp.einsum("bne,bed,dh->bnh", eta_r, child_vec, wr)
+        return jnp.tanh(top + left + right)
+    return apply_op("tree_conv", impl,
+                    (nodes_vector, edge_set, filter), {})
+
+
+__all__ += ["var_conv_2d", "tree_conv"]

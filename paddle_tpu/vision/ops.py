@@ -467,7 +467,7 @@ def generate_proposals(scores, bbox_deltas, img_size, anchors, variances,
         boxes = np.stack([x1, y1, x2, y2], 1)[keep]
         s = s[keep]
         kept = nms(boxes, iou_threshold=nms_thresh, scores=s,
-                   top_k=post_nms_top_n, eta=eta)
+                   top_k=post_nms_top_n, eta=eta, offset=1.0)
         ki = np.asarray(kept.numpy(), int)
         all_rois.append(boxes[ki])
         all_scores.append(s[ki, None])
@@ -485,7 +485,7 @@ def generate_proposals(scores, bbox_deltas, img_size, anchors, variances,
 
 def multiclass_nms(bboxes, scores, score_threshold=0.05, nms_top_k=1000,
                    keep_top_k=100, nms_threshold=0.3, normalized=True,
-                   background_label=0, name=None):
+                   nms_eta=1.0, background_label=0, name=None):
     """reference `operators/detection/multiclass_nms_op.cc`: per-class
     NMS (one nms() call with category_idxs) then global keep_top_k.
     bboxes [N, M, 4]; scores [N, C, M]; class `background_label` is
@@ -518,6 +518,7 @@ def multiclass_nms(bboxes, scores, score_threshold=0.05, nms_top_k=1000,
         cc = np.concatenate(cand_c, 0)
         kept = np.asarray(nms(cb, iou_threshold=nms_threshold, scores=cs,
                               category_idxs=cc, top_k=keep_top_k,
+                              eta=nms_eta,
                               offset=0.0 if normalized else 1.0
                               ).numpy(), int)
         outs.extend((cc[k], cs[k], *cb[k]) for k in kept)

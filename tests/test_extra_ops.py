@@ -479,3 +479,36 @@ class TestCtrOps:
         np.testing.assert_array_equal(idx.numpy(), [0, 2])
         np.testing.assert_allclose(out.numpy(), ins.numpy()[[0, 2]])
         assert lw.shape == [2, 1]
+
+
+class TestTreeAndVarConv:
+    def test_var_conv_2d_shapes(self):
+        import jax.numpy as jnp
+        from paddle_tpu.ops.legacy import LoDTensor
+        r = np.array([4, 6])
+        c = np.array([4, 2])
+        total = 1 * 4 * 4 + 1 * 6 * 2
+        lt = LoDTensor(jnp.asarray(RNG.rand(total).astype("float32")),
+                       [[0, 16, 28]])
+        w = paddle.to_tensor(RNG.rand(2, 1, 3, 3).astype("float32"))
+        out = paddle.var_conv_2d(lt, paddle.to_tensor(r),
+                                 paddle.to_tensor(c), 1, 2, 3, w=w)
+        offs = out.lod()[0]
+        assert offs == [0, 2 * 4 * 4, 2 * 4 * 4 + 2 * 6 * 2]
+
+    def test_tree_conv_root_with_children(self):
+        # 1 tree: node 0 with children 1, 2
+        x = RNG.rand(1, 3, 4).astype("float32")
+        edges = np.array([[[0, 1], [0, 2], [0, 0]]], "int64")  # pad (0,0)
+        f = RNG.rand(4, 5, 3).astype("float32")
+        out = paddle.tree_conv(paddle.to_tensor(x),
+                               paddle.to_tensor(edges),
+                               paddle.to_tensor(f))
+        assert out.shape == [1, 3, 5]
+        wt, wl, wr = f[..., 0], f[..., 1], f[..., 2]
+        # node 0: top + child1 fully left + child2 fully right
+        ref0 = np.tanh(x[0, 0] @ wt + x[0, 1] @ wl + x[0, 2] @ wr)
+        np.testing.assert_allclose(out.numpy()[0, 0], ref0, rtol=1e-4)
+        # leaf nodes: only the top term
+        ref1 = np.tanh(x[0, 1] @ wt)
+        np.testing.assert_allclose(out.numpy()[0, 1], ref1, rtol=1e-4)
