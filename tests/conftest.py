@@ -7,15 +7,24 @@ go through jax.config before the first backend initialization instead.
 """
 import os
 
+# PADDLE_TPU_TEST_ON_CHIP=1 leaves the real TPU backend in place so the
+# chip-only tests actually run. Use it with a -k selection of chip tests
+# (e.g. `PADDLE_TPU_TEST_ON_CHIP=1 pytest -k bf16_parity_on_tpu`): the
+# rest of the suite assumes the 8-virtual-device CPU mesh and will error
+# on a 1-chip host.
+_ON_CHIP = os.environ.get("PADDLE_TPU_TEST_ON_CHIP") == "1"
+
 _flags = os.environ.get("XLA_FLAGS", "")
-if "host_platform_device_count" not in _flags:
+if not _ON_CHIP and "host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (_flags +
                                " --xla_force_host_platform_device_count=8")
-os.environ["JAX_PLATFORMS"] = "cpu"
+if not _ON_CHIP:
+    os.environ["JAX_PLATFORMS"] = "cpu"
 
 import jax  # noqa: E402  (already imported by sitecustomize; config still open)
 
-try:
-    jax.config.update("jax_platforms", "cpu")
-except Exception:
-    pass
+if not _ON_CHIP:
+    try:
+        jax.config.update("jax_platforms", "cpu")
+    except Exception:
+        pass
