@@ -588,11 +588,69 @@ add("index_fill", P.index_fill,
 add("diagonal_scatter", P.diagonal_scatter,
     [x_gen((4, 4), seed=39), x_gen((4,), seed=40)], diff=(0, 1))
 
+
+# ---- round-5 op-gap closers (ops/extra_ops.py) ---------------------------
+add("affine_channel", P.affine_channel,
+    [x_gen((2, 3, 2, 2), seed=101), x_gen((3,), seed=102),
+     x_gen((3,), seed=103)], diff=(0, 1, 2))
+add("row_conv", P.row_conv,
+    [x_gen((2, 5, 3), seed=104), x_gen((3, 3), seed=105)], diff=(0, 1))
+add("conv_shift", P.conv_shift,
+    [x_gen((2, 6), seed=106), x_gen((2, 3), seed=107)], diff=(0, 1))
+add("pad_constant_like", P.pad_constant_like,
+    [x_gen((3, 4), seed=108), x_gen((2, 3), seed=109)], diff=(1,))
+add("l1_norm", P.l1_norm, [x_gen((3, 4), seed=110) + 0.7], diff=(0,))
+add("squared_l2_norm", P.squared_l2_norm,
+    [x_gen((3, 4), seed=111)], diff=(0,))
+add("rank_loss", P.rank_loss,
+    [np.array([[1.0], [0.0]], "float32"), x_gen((2, 1), seed=112),
+     x_gen((2, 1), seed=113)], diff=(1, 2))
+add("hinge_loss", P.hinge_loss,
+    [x_gen((3, 1), seed=114) + 0.3,
+     np.array([[1.], [0.], [1.]], "float32")], diff=(0,))
+add("bpr_loss", P.bpr_loss,
+    [x_gen((3, 5), seed=115), np.array([0, 2, 4], "int64")],
+    diff=(0,), int_inputs=(1,))
+add("fsp", P.fsp,
+    [x_gen((2, 3, 4, 4), seed=116), x_gen((2, 2, 4, 4), seed=117)],
+    diff=(0, 1))
+add("cvm", P.cvm,
+    [x_gen((3, 6), seed=118), np.abs(x_gen((3, 2), seed=119)) + 0.5],
+    diff=(0,))
+add("temporal_shift", P.temporal_shift,
+    [x_gen((4, 8, 2, 2), seed=120)], diff=(0,),
+    kwargs={"seg_num": 2})
+add("pixel_unshuffle", F.pixel_unshuffle,
+    [x_gen((2, 2, 4, 4), seed=121)], diff=(0,), kwargs={
+        "downscale_factor": 2})
+add("channel_shuffle", F.channel_shuffle,
+    [x_gen((2, 4, 3, 3), seed=122)], diff=(0,), kwargs={"groups": 2})
+add("partial_sum", lambda a, b, **kw: P.partial_sum([a, b], **kw),
+    [x_gen((2, 5), seed=123), x_gen((2, 5), seed=124)],
+    diff=(0, 1), kwargs={"start_index": 1, "length": 2})
+add("im2sequence", P.im2sequence,
+    [x_gen((2, 3, 4, 4), seed=125)], diff=(0,),
+    kwargs={"filter_size": 2, "stride": 2})
+add("linear_chain_crf", P.linear_chain_crf,
+    [x_gen((2, 4, 3), seed=126), x_gen((5, 3), seed=127),
+     idx((2, 4), 3, seed=128), np.array([4, 3], "int64")],
+    diff=(0, 1), int_inputs=(2, 3))
+add("batch_fc", P.batch_fc,
+    [x_gen((2, 3, 4), seed=129), x_gen((2, 4, 2), seed=130),
+     x_gen((2, 2), seed=131)], diff=(0, 1, 2))
+add("affine_grid", F.affine_grid,
+    [x_gen((2, 2, 3), seed=132)], diff=(0,),
+    kwargs={"out_shape": [2, 1, 3, 3]})
+add("tree_conv", P.tree_conv,
+    [x_gen((1, 3, 4), seed=133),
+     np.array([[[0, 1], [0, 2], [0, 0]]], "int64"),
+     x_gen((4, 5, 3), seed=134)], diff=(0, 2), int_inputs=(1,))
+
 _IDS = [c.name for c in CASES]
 
 
 def test_case_count():
-    assert len(CASES) >= 150, f"only {len(CASES)} grad-check cases"
+    assert len(CASES) >= 245, f"only {len(CASES)} grad-check cases"
 
 
 @pytest.mark.parametrize("case", CASES, ids=_IDS)
