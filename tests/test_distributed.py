@@ -164,6 +164,27 @@ def test_ring_attention_matches_dense(causal):
     np.testing.assert_allclose(np.asarray(out), ref, rtol=2e-4, atol=2e-4)
 
 
+@pytest.mark.parametrize("impl", ["ring", "ulysses"])
+def test_sp_attention_bf16_dtype_and_parity(impl):
+    """bf16 shards: the sequence-parallel paths keep bf16 MXU dots with
+    f32 stats and return bf16 — parity within bf16 tolerance."""
+    mesh = create_mesh({"sp": 8})
+    rng = np.random.RandomState(7)
+    B, H, S, D = 2, 8, 32, 8
+    q = rng.randn(B, H, S, D).astype("float32")
+    k = rng.randn(B, H, S, D).astype("float32")
+    v = rng.randn(B, H, S, D).astype("float32")
+    qb = jnp.asarray(q, jnp.bfloat16)
+    kb = jnp.asarray(k, jnp.bfloat16)
+    vb = jnp.asarray(v, jnp.bfloat16)
+    out = shard_map_ring_attention(qb, kb, vb, mesh, causal=True,
+                                   impl=impl)
+    assert out.dtype == jnp.bfloat16
+    ref = _dense_attention(q, k, v, True)
+    np.testing.assert_allclose(np.asarray(out, dtype="float32"), ref,
+                               rtol=5e-2, atol=5e-2)
+
+
 @pytest.mark.parametrize("causal", [False, True])
 def test_ulysses_attention_matches_dense(causal):
     mesh = create_mesh({"sp": 8})
