@@ -841,3 +841,58 @@ def tree_conv(nodes_vector, edge_set, filter, max_depth=1, name=None):
 
 
 __all__ += ["var_conv_2d", "tree_conv"]
+
+
+def bilateral_slice(x, guide, grid, has_offset=True, name=None):
+    """reference `operators/bilateral_slice_op.cc` (HDRNet): slice a
+    bilateral grid of affine coefficients at (x, y, guide) with
+    trilinear interpolation and apply the per-pixel affine transform.
+
+    x [N, Ci, H, W]; guide [N, H, W] in [0,1]; grid
+    [N, Co*(Ci+1), Gd, Gh, Gw] when has_offset (affine + bias), else
+    [N, Co*Ci, ...]. Returns [N, Co, H, W]."""
+    def impl(xv, gv, grid_v):
+        N, Ci, H, W = xv.shape
+        _, CC, Gd, Gh, Gw = grid_v.shape
+        cols = Ci + 1 if has_offset else Ci
+        if CC % cols != 0:
+            raise ValueError(
+                f"bilateral_slice: grid channels {CC} not divisible by "
+                f"{cols} (= input channels{' + offset' if has_offset else ''})"
+                " — check has_offset / grid layout")
+        Co = CC // cols
+
+        gx = (jnp.arange(W, dtype=jnp.float32) + 0.5) * Gw / W - 0.5
+        gy = (jnp.arange(H, dtype=jnp.float32) + 0.5) * Gh / H - 0.5
+        gxb = jnp.broadcast_to(gx[None, :], (H, W))
+        gyb = jnp.broadcast_to(gy[:, None], (H, W))
+
+        def one(img, guide1, g1):
+            gz = jnp.clip(guide1, 0.0, 1.0) * Gd - 0.5       # [H,W]
+            x0 = jnp.floor(gxb)
+            y0 = jnp.floor(gyb)
+            z0 = jnp.floor(gz)
+            wx = gxb - x0
+            wy = gyb - y0
+            wz = gz - z0
+            coef = jnp.zeros((CC, H, W), jnp.float32)
+            for dz, wz_ in ((0, 1 - wz), (1, wz)):
+                for dy, wy_ in ((0, 1 - wy), (1, wy)):
+                    for dx, wx_ in ((0, 1 - wx), (1, wx)):
+                        zi = jnp.clip(z0 + dz, 0, Gd - 1).astype(jnp.int32)
+                        yi = jnp.clip(y0 + dy, 0, Gh - 1).astype(jnp.int32)
+                        xi = jnp.clip(x0 + dx, 0, Gw - 1).astype(jnp.int32)
+                        corner = g1[:, zi, yi, xi]           # [CC,H,W]
+                        coef = coef + corner * (wz_ * wy_ * wx_)[None]
+            coef = coef.reshape(Co, cols, H, W)
+            out = jnp.einsum("ochw,chw->ohw", coef[:, :Ci],
+                             img.astype(jnp.float32))
+            if has_offset:
+                out = out + coef[:, Ci]
+            return out
+        return jax.vmap(one)(xv, gv,
+                             grid_v.astype(jnp.float32)).astype(xv.dtype)
+    return apply_op("bilateral_slice", impl, (x, guide, grid), {})
+
+
+__all__ += ["bilateral_slice"]

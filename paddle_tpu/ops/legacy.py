@@ -38,7 +38,7 @@ __all__ = [
     "cross_entropy2", "psroi_pool", "prroi_pool", "correlation", "nce",
     "deformable_conv", "lod_reset", "sequence_reshape", "sequence_slice",
     "sequence_scatter", "batch_fc", "sample_logits", "filter_by_instag",
-    "var_conv_2d", "tree_conv",
+    "var_conv_2d", "tree_conv", "bilateral_slice",
 ]
 
 from .extra_ops import (affine_channel, affine_grid, bpr_loss,  # noqa: E402
@@ -49,8 +49,9 @@ from .extra_ops import (affine_channel, affine_grid, bpr_loss,  # noqa: E402
                         partial_concat, partial_sum, prroi_pool,
                         psroi_pool, rank_loss, row_conv, shuffle_batch,
                         space_to_depth, squared_l2_norm, temporal_shift)
-from .extra_ops import (batch_fc, filter_by_instag,  # noqa: E402
-                        sample_logits, tree_conv, var_conv_2d)
+from .extra_ops import (batch_fc, bilateral_slice,  # noqa: E402
+                        filter_by_instag, sample_logits, tree_conv,
+                        var_conv_2d)
 
 
 # --------------------------------------------------------------------------

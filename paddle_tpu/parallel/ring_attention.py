@@ -26,19 +26,28 @@ from jax import lax
 __all__ = ["ring_attention", "ulysses_attention", "shard_map_ring_attention"]
 
 
+def _dot_precision(dtype):
+    """bf16/f16 inputs take the fast single-pass MXU path; f32 inputs
+    keep full precision. Must be explicit either way: the framework pins
+    jax_default_matmul_precision="highest" globally
+    (framework/__init__.py), which would upcast bf16 dots, while a bare
+    DEFAULT would silently degrade f32 accuracy on TPU."""
+    if dtype in (jnp.bfloat16, jnp.float16):
+        return jax.lax.Precision.DEFAULT
+    return jax.lax.Precision.HIGHEST
+
+
 def _block_attend(q, k, v, scale, mask_val=None):
     """Partial (un-normalized) attention stats for one K/V block.
     q: [B,H,Sq,D]; k,v: [B,H,Sk,D] → (max, sumexp, acc).
 
     MXU dots run on the INPUT dtype (bf16 in production — 4x the f32
     path on v5e, same recipe as the Pallas flash kernel); the softmax
-    statistics and accumulator stay f32. precision=DEFAULT must stay
-    explicit: the framework pins jax_default_matmul_precision="highest"
-    globally (framework/__init__.py), which would otherwise upcast these
-    dots back to f32."""
+    statistics and accumulator stay f32."""
+    prec = _dot_precision(q.dtype)
     s = jnp.einsum("bhqd,bhkd->bhqk", q, k,
                    preferred_element_type=jnp.float32,
-                   precision=jax.lax.Precision.DEFAULT) * scale
+                   precision=prec) * scale
     if mask_val is not None:
         s = jnp.where(mask_val, s, -1e30)
     m = jnp.max(s, axis=-1, keepdims=True)
@@ -46,7 +55,7 @@ def _block_attend(q, k, v, scale, mask_val=None):
     l = jnp.sum(p, axis=-1, keepdims=True)
     acc = jnp.einsum("bhqk,bhkd->bhqd", p.astype(v.dtype), v,
                      preferred_element_type=jnp.float32,
-                     precision=jax.lax.Precision.DEFAULT)
+                     precision=prec)
     return m, l, acc
 
 
@@ -116,9 +125,10 @@ def ulysses_attention(q, k, v, axis_name: str = "sp", causal: bool = False,
                               tiled=True)
 
     qs, ks, vs = to_seq(q), to_seq(k), to_seq(v)
+    prec = _dot_precision(q.dtype)
     s = jnp.einsum("bhqd,bhkd->bhqk", qs, ks,
                    preferred_element_type=jnp.float32,
-                   precision=jax.lax.Precision.DEFAULT) * scale
+                   precision=prec) * scale
     if causal:
         S = s.shape[-1]
         mask = jnp.tril(jnp.ones((S, S), bool))
@@ -126,7 +136,7 @@ def ulysses_attention(q, k, v, axis_name: str = "sp", causal: bool = False,
     p = jax.nn.softmax(s, axis=-1)
     out = jnp.einsum("bhqk,bhkd->bhqd", p.astype(vs.dtype), vs,
                      preferred_element_type=jnp.float32,
-                     precision=jax.lax.Precision.DEFAULT)
+                     precision=prec)
     # cast BEFORE the all_to_all so the ICI transfer rides bf16
     return to_heads(out.astype(q.dtype))
 

@@ -512,3 +512,53 @@ class TestTreeAndVarConv:
         # leaf nodes: only the top term
         ref1 = np.tanh(x[0, 1] @ wt)
         np.testing.assert_allclose(out.numpy()[0, 1], ref1, rtol=1e-4)
+
+
+class TestBilateralSlice:
+    def test_constant_grid_is_affine(self):
+        """A grid whose coefficients are constant everywhere reduces to
+        one global affine transform — exact regardless of guide."""
+        N, Ci, H, W = 1, 3, 6, 6
+        Co = 3
+        A = RNG.rand(Co, Ci + 1).astype("float32")
+        grid = np.tile(A.reshape(1, Co * (Ci + 1), 1, 1, 1),
+                       (N, 1, 2, 3, 3)).astype("float32")
+        x = RNG.rand(N, Ci, H, W).astype("float32")
+        guide = RNG.rand(N, H, W).astype("float32")
+        out = paddle.bilateral_slice(
+            paddle.to_tensor(x), paddle.to_tensor(guide),
+            paddle.to_tensor(grid)).numpy()
+        ref = np.einsum("oc,nchw->nohw", A[:, :Ci], x) + \
+            A[:, Ci].reshape(1, Co, 1, 1)
+        np.testing.assert_allclose(out, ref, rtol=1e-4, atol=1e-5)
+
+    def test_guide_selects_depth(self):
+        """Two depth slabs with different biases: guide 0 picks slab 0,
+        guide 1 picks slab 1 (input zeros, pure offset)."""
+        N, Ci, H, W = 1, 1, 4, 4
+        Co, Gd = 1, 2
+        grid = np.zeros((N, Co * 2, Gd, 2, 2), "float32")
+        grid[:, 1, 0] = 10.0           # offset channel, slab 0
+        grid[:, 1, 1] = 20.0           # slab 1
+        x = np.zeros((N, Ci, H, W), "float32")
+        lo = paddle.bilateral_slice(
+            paddle.to_tensor(x),
+            paddle.to_tensor(np.zeros((N, H, W), "float32")),
+            paddle.to_tensor(grid)).numpy()
+        hi = paddle.bilateral_slice(
+            paddle.to_tensor(x),
+            paddle.to_tensor(np.ones((N, H, W), "float32")),
+            paddle.to_tensor(grid)).numpy()
+        assert abs(lo.mean() - 10.0) < 1e-4
+        assert abs(hi.mean() - 20.0) < 1e-4
+
+    def test_grad_flows(self):
+        x = paddle.to_tensor(RNG.rand(1, 3, 4, 4).astype("float32"))
+        g = paddle.to_tensor(RNG.rand(1, 3 * 4, 2, 2, 2).astype("float32"))
+        x.stop_gradient = False
+        g.stop_gradient = False
+        out = paddle.bilateral_slice(
+            x, paddle.to_tensor(RNG.rand(1, 4, 4).astype("float32")), g)
+        out.sum().backward()
+        assert np.isfinite(x.grad.numpy()).all()
+        assert np.abs(g.grad.numpy()).sum() > 0
