@@ -38,7 +38,7 @@ __all__ = [
     "cross_entropy2", "psroi_pool", "prroi_pool", "correlation", "nce",
     "deformable_conv", "lod_reset", "sequence_reshape", "sequence_slice",
     "sequence_scatter", "batch_fc", "sample_logits", "filter_by_instag",
-    "var_conv_2d", "tree_conv", "bilateral_slice",
+    "var_conv_2d", "tree_conv", "bilateral_slice", "Print",
 ]
 
 from .extra_ops import (affine_channel, affine_grid, bpr_loss,  # noqa: E402
@@ -508,3 +508,50 @@ def sequence_scatter(input, index, updates):
         np.add.at(out[i], iv[a:b],
                   uv[a:b] if uv.ndim == 1 else uv[a:b, 0])
     return Tensor(jnp.asarray(out))
+
+
+def Print(input, first_n=-1, message=None, summarize=20,
+          print_tensor_name=True, print_tensor_type=True,
+          print_tensor_shape=True, print_tensor_lod=False,
+          print_phase="both", name=None):
+    """reference `operators/print_op.cc` / fluid.layers.Print: log the
+    tensor value as a side effect and pass it through, honoring first_n
+    (max print count) and summarize (max elements shown).
+
+    Eager values print directly. Traced values (inside jit / a lowered
+    static Program) print shape/dtype once at trace time WITHOUT runtime
+    values: the axon TPU runtime rejects host callbacks
+    (io_callback/debug.callback UNIMPLEMENTED), so a callback-based
+    runtime print would crash compiled programs on the chip."""
+    import jax
+
+    msg = str(message or getattr(input, "name", None) or "var")
+    state = {"n": 0}
+
+    def fmt(arr_like, values=None):
+        parts = [msg]
+        if print_tensor_shape:
+            parts.append(f"shape={tuple(arr_like.shape)}")
+        if print_tensor_type:
+            parts.append(f"dtype={arr_like.dtype}")
+        head = " ".join(parts)
+        return head if values is None else f"{head} value={values}"
+
+    def impl(v):
+        from ..static import program as _prog
+        if not isinstance(v, jax.core.Tracer) and _prog.in_static_mode():
+            # Program-build placeholder pass: stay silent, don't count
+            return v
+        if 0 <= first_n <= state["n"]:
+            return v
+        state["n"] += 1
+        if isinstance(v, jax.core.Tracer):
+            print(fmt(v) + " (traced: values print is unavailable — the "
+                  "axon runtime has no host callbacks)", flush=True)
+        else:
+            arr = np.asarray(v)
+            flat = arr.ravel()[:summarize] if summarize > 0 \
+                else arr.ravel()
+            print(fmt(arr, flat), flush=True)
+        return v
+    return apply_op("print", impl, (input,), {})
