@@ -896,3 +896,59 @@ def bilateral_slice(x, guide, grid, has_offset=True, name=None):
 
 
 __all__ += ["bilateral_slice"]
+
+
+def rank_attention(input, rank_offset, rank_param, max_rank=3,
+                   param_attr=None, name=None):
+    """reference `operators/rank_attention_op.cc` +
+    `rank_attention.cu.h` (CTR rank-feature attention):
+
+    X [ins, D]; RankOffset [ins, 2*max_rank+1] ints — col 0 is this
+    instance's 1-based rank (0 = absent), then (rank_k, row_index_k)
+    pairs for up to max_rank related instances. RankParam holds a
+    [D, para_col] block per (my_rank, other_rank) combination, laid out
+    as [max_rank^2 * D, para_col]. Out[i] = concat_k X[index_k] @
+    block[(my_rank-1)*max_rank + (rank_k-1)], with absent entries
+    contributing zero — exactly the expand_input/expand_param kernels'
+    gather semantics. rank_param may be a Tensor or created lazily
+    ([max_rank^2*D, para_col] via param_attr when given a shape tuple).
+    """
+    if isinstance(rank_param, (tuple, list)):
+        from ..static.nn import shared_parameter
+        rank_param = shared_parameter(list(rank_param), "float32",
+                                      attr=param_attr)
+    # reference InferShape PADDLE_ENFORCEs these; the clip below would
+    # otherwise silently read the wrong parameter block
+    off_cols = int(rank_offset.shape[1])
+    if off_cols != 2 * max_rank + 1:
+        raise ValueError(
+            f"rank_attention: RankOffset has {off_cols} columns, "
+            f"expected 2*max_rank+1 = {2 * max_rank + 1}")
+    D_in = int(input.shape[1])
+    p_rows = int(rank_param.shape[0])
+    if p_rows != max_rank * max_rank * D_in:
+        raise ValueError(
+            f"rank_attention: RankParam has {p_rows} rows, expected "
+            f"max_rank^2 * input_dim = {max_rank * max_rank * D_in}")
+
+    def impl(x, off, p):
+        ins, D = x.shape
+        para_col = p.shape[1]
+        off = off.astype(jnp.int32)
+        my = off[:, 0] - 1                       # [ins]
+        ranks = off[:, 1::2] - 1                 # [ins, K]
+        idxs = off[:, 2::2]                      # [ins, K]
+        valid = (my[:, None] >= 0) & (ranks >= 0)
+        gathered = jnp.take(x, jnp.clip(idxs, 0, ins - 1), axis=0)
+        input_help = jnp.where(valid[..., None], gathered, 0.0)
+        start = jnp.clip(my[:, None] * max_rank + ranks, 0,
+                         max_rank * max_rank - 1)
+        pb = p.reshape(max_rank * max_rank, D, para_col)
+        param_help = jnp.where(valid[..., None, None],
+                               jnp.take(pb, start, axis=0), 0.0)
+        return jnp.einsum("ikd,ikdp->ip", input_help, param_help)
+    return apply_op("rank_attention", impl,
+                    (input, rank_offset, rank_param), {})
+
+
+__all__ += ["rank_attention"]

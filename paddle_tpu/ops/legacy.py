@@ -39,6 +39,7 @@ __all__ = [
     "deformable_conv", "lod_reset", "sequence_reshape", "sequence_slice",
     "sequence_scatter", "batch_fc", "sample_logits", "filter_by_instag",
     "var_conv_2d", "tree_conv", "bilateral_slice", "Print",
+    "rank_attention",
 ]
 
 from .extra_ops import (affine_channel, affine_grid, bpr_loss,  # noqa: E402
@@ -50,8 +51,8 @@ from .extra_ops import (affine_channel, affine_grid, bpr_loss,  # noqa: E402
                         psroi_pool, rank_loss, row_conv, shuffle_batch,
                         space_to_depth, squared_l2_norm, temporal_shift)
 from .extra_ops import (batch_fc, bilateral_slice,  # noqa: E402
-                        filter_by_instag, sample_logits, tree_conv,
-                        var_conv_2d)
+                        filter_by_instag, rank_attention, sample_logits,
+                        tree_conv, var_conv_2d)
 
 
 # --------------------------------------------------------------------------
@@ -529,7 +530,7 @@ def Print(input, first_n=-1, message=None, summarize=20,
     state = {"n": 0}
 
     def fmt(arr_like, values=None):
-        parts = [msg]
+        parts = [msg] if print_tensor_name else []
         if print_tensor_shape:
             parts.append(f"shape={tuple(arr_like.shape)}")
         if print_tensor_type:
@@ -550,8 +551,9 @@ def Print(input, first_n=-1, message=None, summarize=20,
                   "axon runtime has no host callbacks)", flush=True)
         else:
             arr = np.asarray(v)
-            flat = arr.ravel()[:summarize] if summarize > 0 \
-                else arr.ravel()
+            # reference contract: negative summarize means "print all"
+            flat = arr.ravel() if summarize < 0 \
+                else arr.ravel()[:summarize]
             print(fmt(arr, flat), flush=True)
         return v
     return apply_op("print", impl, (input,), {})

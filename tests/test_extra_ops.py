@@ -562,3 +562,58 @@ class TestBilateralSlice:
         out.sum().backward()
         assert np.isfinite(x.grad.numpy()).all()
         assert np.abs(g.grad.numpy()).sum() > 0
+
+
+class TestRankAttention:
+    def test_matches_kernel_semantics(self):
+        """Brute-force replay of the reference expand_input/expand_param
+        CUDA kernels (rank_attention.cu.h)."""
+        ins, D, pc, K = 4, 3, 2, 2
+        x = RNG.rand(ins, D).astype("float32")
+        p = RNG.rand(K * K * D, pc).astype("float32")
+        off = np.zeros((ins, 2 * K + 1), "int64")
+        off[0] = [1, 2, 1, 1, 2]     # rank 1; related (rank2,row1),(rank1,row2)
+        off[1] = [2, 1, 0, 0, 0]     # rank 2; one related (rank1,row0)
+        off[2] = [0, 1, 3, 0, 0]     # absent rank -> zero row
+        off[3] = [1, 0, 0, 2, 3]     # k=0 absent, k=1 (rank2,row3)
+        out = paddle.rank_attention(paddle.to_tensor(x),
+                                    paddle.to_tensor(off),
+                                    paddle.to_tensor(p),
+                                    max_rank=K).numpy()
+
+        ref = np.zeros((ins, pc), "float32")
+        pb = p.reshape(K * K, D, pc)
+        for i in range(ins):
+            my = off[i, 0] - 1
+            for k in range(K):
+                rk = off[i, 2 * k + 1] - 1
+                idx = off[i, 2 * k + 2]
+                if my < 0 or rk < 0:
+                    continue
+                ref[i] += x[idx] @ pb[my * K + rk]
+        np.testing.assert_allclose(out, ref, rtol=1e-5)
+
+    def test_grad_flows_to_param(self):
+        ins, D, pc, K = 3, 2, 2, 2
+        x = paddle.to_tensor(RNG.rand(ins, D).astype("float32"))
+        p = paddle.to_tensor(RNG.rand(K * K * D, pc).astype("float32"))
+        off = paddle.to_tensor(np.array(
+            [[1, 1, 0, 2, 1], [2, 1, 2, 0, 0], [1, 2, 1, 1, 0]], "int64"))
+        x.stop_gradient = False
+        p.stop_gradient = False
+        out = paddle.rank_attention(x, off, p, max_rank=K)
+        out.sum().backward()
+        assert np.isfinite(x.grad.numpy()).all()
+        assert np.abs(p.grad.numpy()).sum() > 0
+
+    def test_shape_validation(self):
+        x = paddle.to_tensor(RNG.rand(2, 3).astype("float32"))
+        off = paddle.to_tensor(np.zeros((2, 7), "int64"))  # max_rank 3
+        p = paddle.to_tensor(RNG.rand(2 * 2 * 3, 2).astype("float32"))
+        with pytest.raises(ValueError):
+            paddle.rank_attention(x, off, p, max_rank=2)
+        with pytest.raises(ValueError):
+            paddle.rank_attention(
+                x, paddle.to_tensor(np.zeros((2, 5), "int64")),
+                paddle.to_tensor(RNG.rand(7, 2).astype("float32")),
+                max_rank=2)
