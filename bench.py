@@ -383,10 +383,34 @@ def bench_host_embedding():
     return BATCH_IDS * iters / dt
 
 
+def _backend_alive(timeout_s=60):
+    """Threaded liveness probe: a dead tunnel can HANG jax calls rather
+    than fail them, so the probe must carry its own hard timeout."""
+    import jax
+    ok = [False]
+    done = threading.Event()
+
+    def probe():
+        try:
+            _ = (jax.numpy.zeros((4, 4)) @ jax.numpy.zeros((4, 4)))
+            _.block_until_ready()
+            ok[0] = True
+        except Exception:  # noqa: BLE001
+            pass
+        finally:
+            done.set()
+
+    threading.Thread(target=probe, daemon=True).start()
+    done.wait(timeout_s)
+    return ok[0]
+
+
 def _with_retries(fn, attempts=3, cooldown_s=20):
     """Bounded retry for one bench config: transient tunnel/compile
     errors (HTTP 500 remote_compile, closed response bodies) must not
-    zero a metric for the round."""
+    zero a metric for the round. Before each retry the backend is
+    re-probed under a hard timeout — if the tunnel is dead, fail fast
+    with a diagnosable error instead of hanging inside the retry."""
     last = None
     for i in range(attempts):
         try:
@@ -398,6 +422,10 @@ def _with_retries(fn, attempts=3, cooldown_s=20):
                 sys.stderr.write(f"config attempt {i + 1}/{attempts} "
                                  f"failed; retrying in {cooldown_s}s\n")
                 time.sleep(cooldown_s)
+                if not _backend_alive():
+                    raise RuntimeError(
+                        "TPU backend unreachable after config failure "
+                        f"({e!r}); aborting retries") from e
     raise last
 
 
