@@ -39,7 +39,7 @@ __all__ = [
     "deformable_conv", "lod_reset", "sequence_reshape", "sequence_slice",
     "sequence_scatter", "batch_fc", "sample_logits", "filter_by_instag",
     "var_conv_2d", "tree_conv", "bilateral_slice", "Print",
-    "rank_attention",
+    "rank_attention", "search_pyramid_hash", "pyramid_hash",
 ]
 
 from .extra_ops import (affine_channel, affine_grid, bpr_loss,  # noqa: E402
@@ -557,3 +557,139 @@ def Print(input, first_n=-1, message=None, summarize=20,
             print(fmt(arr, flat), flush=True)
         return v
     return apply_op("print", impl, (input,), {})
+
+
+def _xxh32(data: bytes, seed: int = 0) -> int:
+    """XXH32 (public spec) — the hash pyramid_hash_op.h uses via
+    <xxhash.h>; pure-Python so the op works with zero native deps."""
+    P1, P2, P3, P4, P5 = (2654435761, 2246822519, 3266489917,
+                          668265263, 374761393)
+    M = 0xFFFFFFFF
+
+    def rotl(x, r):
+        return ((x << r) | (x >> (32 - r))) & M
+
+    n = len(data)
+    i = 0
+    if n >= 16:
+        v1 = (seed + P1 + P2) & M
+        v2 = (seed + P2) & M
+        v3 = seed & M
+        v4 = (seed - P1) & M
+        while i <= n - 16:
+            for j, v in enumerate((v1, v2, v3, v4)):
+                lane = int.from_bytes(data[i + 4 * j:i + 4 * j + 4],
+                                      "little")
+                v = (v + lane * P2) & M
+                v = (rotl(v, 13) * P1) & M
+                if j == 0:
+                    v1 = v
+                elif j == 1:
+                    v2 = v
+                elif j == 2:
+                    v3 = v
+                else:
+                    v4 = v
+            i += 16
+        h = (rotl(v1, 1) + rotl(v2, 7) + rotl(v3, 12) + rotl(v4, 18)) & M
+    else:
+        h = (seed + P5) & M
+    h = (h + n) & M
+    while i <= n - 4:
+        h = (h + int.from_bytes(data[i:i + 4], "little") * P3) & M
+        h = (rotl(h, 17) * P4) & M
+        i += 4
+    while i < n:
+        h = (h + data[i] * P5) & M
+        h = (rotl(h, 11) * P1) & M
+        i += 1
+    h ^= h >> 15
+    h = (h * P2) & M
+    h ^= h >> 13
+    h = (h * P3) & M
+    h ^= h >> 16
+    return h
+
+
+_PYRAMID_RNGS = {}
+
+
+def search_pyramid_hash(input, num_emb, space_len, pyramid_layer, rand_len,
+                        drop_out_percent=0.0, is_training=False,
+                        use_filter=False, white_list=None, black_list=None,
+                        seed=0, weights=None, name=None):
+    """reference `operators/pyramid_hash_op.cc`
+    (fluid.contrib.layers.search_pyramid_hash): hash every n-gram window
+    (lengths 2..pyramid_layer) of an int-id LoD sequence with XXH32 and
+    assemble a num_emb embedding from rand_len-wide chunks of the flat
+    weight table at the chained hash offsets — the massive-vocabulary
+    embedding trick of the text-matching models.
+
+    Returns a LoDTensor with one embedding row per surviving n-gram;
+    gradients flow to `weights` (the hash positions are host-computed,
+    the gather is a recorded differentiable op). Deviation from the
+    reference: white/black lists filter by EXACT membership of the
+    n-gram hash instead of a bloom filter (no false positives;
+    documented simplification)."""
+    assert num_emb % rand_len == 0, "num_emb must be divisible by rand_len"
+    w_t = weights if isinstance(weights, Tensor) else \
+        Tensor(jnp.asarray(np.asarray(weights, np.float32).reshape(-1)))
+    W_len = int(np.prod(w_t.shape))
+    assert W_len >= space_len + rand_len, \
+        "weights must hold space_len + rand_len floats"
+    offs = _seq_offsets(input)
+    ids = np.asarray(input._value).reshape(-1).astype(np.int32)
+    white = set(int(x) for x in np.asarray(white_list).ravel()) \
+        if (use_filter and white_list is not None) else None
+    black = set(int(x) for x in np.asarray(black_list).ravel()) \
+        if (use_filter and black_list is not None) else None
+    # persistent per-seed RNG (the reference advances a member seed with
+    # rand_r across calls — a fresh RandomState per call would drop the
+    # SAME grams every training step)
+    rng = _PYRAMID_RNGS.setdefault(int(seed),
+                                   np.random.RandomState(int(seed) or 1))
+
+    gather_rows, new_offs = [], [0]
+    for a, b in zip(offs[:-1], offs[1:]):
+        seq = ids[a:b]
+        count = 0
+        for win in range(2, int(pyramid_layer) + 1):
+            for st in range(0, len(seq) - win + 1):
+                gram = seq[st:st + win].astype(np.float32).tobytes()
+                key = _xxh32(gram, 0)
+                if white is not None and key not in white:
+                    continue
+                if black is not None and key in black:
+                    continue
+                # reference scale: drop_out_percent is 0-100
+                # (rand % 100 > percent keeps the gram)
+                if is_training and drop_out_percent > 0 and \
+                        not rng.randint(0, 100) > drop_out_percent:
+                    continue
+                idx = np.empty(num_emb, np.int64)
+                pos1 = key % space_len
+                pos2 = _xxh32(gram, rand_len) % space_len
+                for j in range(0, num_emb, rand_len):
+                    pos3 = _xxh32(gram, j + 2 * rand_len) % space_len
+                    idx[j:j + rand_len] = np.arange(pos1, pos1 + rand_len)
+                    pos1, pos2 = pos2, pos3
+                gather_rows.append(idx)
+                count += 1
+        new_offs.append(new_offs[-1] + count)
+
+    if gather_rows:
+        idx_mat = jnp.asarray(np.stack(gather_rows))
+
+        def impl(w):
+            return jnp.take(w.reshape(-1), idx_mat, axis=0)
+        out = apply_op("pyramid_hash", impl, (w_t,), {})
+    else:
+        out = Tensor(jnp.zeros((0, num_emb), jnp.float32))
+    # keep the autograd tape: re-class the op output instead of
+    # constructing a fresh LoDTensor from raw values
+    out.__class__ = LoDTensor
+    out._lod = [new_offs]
+    return out
+
+
+pyramid_hash = search_pyramid_hash

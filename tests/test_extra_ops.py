@@ -617,3 +617,66 @@ class TestRankAttention:
                 x, paddle.to_tensor(np.zeros((2, 5), "int64")),
                 paddle.to_tensor(RNG.rand(7, 2).astype("float32")),
                 max_rank=2)
+
+
+class TestPyramidHash:
+    def _lod(self, arr, offs):
+        import jax.numpy as jnp
+        from paddle_tpu.ops.legacy import LoDTensor
+        return LoDTensor(jnp.asarray(np.asarray(arr, "int32")), [offs])
+
+    def test_xxh32_spec_vectors(self):
+        from paddle_tpu.ops.legacy import _xxh32
+        assert _xxh32(b"", 0) == 0x02CC5D05
+        assert _xxh32(b"a", 0) == 0x550D7456
+        assert _xxh32(b"abc", 0) == 0x32D153FF
+
+    def test_row_counts_and_chunks(self):
+        W = RNG.rand(100 + 4).astype("float32")
+        seq = self._lod([3, 7, 9, 2, 5], [0, 5])
+        out = paddle.search_pyramid_hash(
+            seq, num_emb=8, space_len=100, pyramid_layer=3, rand_len=4,
+            weights=W)
+        # windows: len-2 -> 4 grams, len-3 -> 3 grams = 7 rows
+        assert out.lod()[0] == [0, 7]
+        o = np.asarray(out._value)
+        assert o.shape == (7, 8)
+        # every rand_len chunk is a contiguous slice of W
+        flat = W
+        for row in o:
+            for j in range(0, 8, 4):
+                chunk = row[j:j + 4]
+                found = any(np.allclose(chunk, flat[s:s + 4])
+                            for s in range(100))
+                assert found
+
+    def test_filters_and_dropout(self):
+        from paddle_tpu.ops.legacy import _xxh32
+        W = RNG.rand(50 + 2).astype("float32")
+        seq = self._lod([1, 2, 3], [0, 3])
+        # compute the hash key of the first bigram to whitelist only it
+        gram = np.asarray([1, 2], np.float32).tobytes()
+        key = _xxh32(gram, 0)
+        out = paddle.search_pyramid_hash(
+            seq, num_emb=4, space_len=50, pyramid_layer=2, rand_len=2,
+            use_filter=True, white_list=[key], weights=W)
+        assert out.lod()[0] == [0, 1]        # only the whitelisted gram
+        out2 = paddle.search_pyramid_hash(
+            seq, num_emb=4, space_len=50, pyramid_layer=2, rand_len=2,
+            use_filter=True, black_list=[key], weights=W)
+        assert out2.lod()[0] == [0, 1]       # the OTHER bigram survives
+        out3 = paddle.search_pyramid_hash(
+            seq, num_emb=4, space_len=50, pyramid_layer=2, rand_len=2,
+            is_training=True, drop_out_percent=100, weights=W)
+        assert out3.lod()[0] == [0, 0]       # percent scale: 100 = drop all
+
+    def test_weights_are_trainable(self):
+        W = paddle.to_tensor(RNG.rand(50 + 2).astype("float32"))
+        W.stop_gradient = False
+        seq = self._lod([4, 5, 6], [0, 3])
+        out = paddle.search_pyramid_hash(
+            seq, num_emb=4, space_len=50, pyramid_layer=2, rand_len=2,
+            weights=W)
+        out.sum().backward()
+        g = W.grad.numpy()
+        assert np.isfinite(g).all() and np.abs(g).sum() > 0
