@@ -1,11 +1,121 @@
-"""paddle.device namespace (reference `python/paddle/device.py`)."""
+"""paddle.device namespace (reference `python/paddle/device.py`).
+
+Also owns the persistent XLA compilation-cache wiring: paddle_tpu points
+`jax_compilation_cache_dir` at `FLAGS_xla_compilation_cache_dir`
+(default `~/.cache/paddle_tpu/xla`) so a repeat run of the same model
+skips XLA recompiles entirely — the first-step compile latency
+`bench.py` reports drops to cache-read time. The wiring happens at
+import when JAX_PLATFORMS names the backend, otherwise lazily at the
+first framework compile (`maybe_enable_compilation_cache`) so importing
+paddle_tpu never forces a JAX backend init. Opt out with the
+`FLAGS_xla_compilation_cache=0` environment variable (always works); a
+post-import `set_flags({"FLAGS_xla_compilation_cache": False})` is only
+honored on the deferred first-compile branch — when JAX_PLATFORMS is
+set, the flag is read during import itself.
+
+The cache is NOT enabled on the CPU backend: XLA:CPU's serialized
+executables drop input/output buffer aliasing, so a cache *hit* on a
+donated train step reads freed buffers and silently corrupts numerics
+(reproduced on jax 0.4.37 with the dp-sharded step — second process
+reading the cache diverges to ~1e18). TPU executables round-trip
+aliasing correctly; CPU callers who accept the risk can pass
+`enable_compilation_cache(force=True)`.
+"""
+import os as _os
+
 from ..framework.place import (CPUPlace, CUDAPlace, TPUPlace, device_count,
                                get_device, is_compiled_with_cuda,
                                is_compiled_with_tpu, set_device)
 
 __all__ = ["set_device", "get_device", "CPUPlace", "CUDAPlace", "TPUPlace",
            "device_count", "is_compiled_with_cuda", "is_compiled_with_tpu",
-           "cuda"]
+           "cuda", "enable_compilation_cache", "maybe_enable_compilation_cache",
+           "compilation_cache_dir"]
+
+_compile_cache_dir = None  # active dir once enable_compilation_cache ran
+_cache_decision_pending = False  # JAX_PLATFORMS unset: decide at 1st compile
+
+
+def _cpu_backend() -> bool:
+    """True when jax will (or did) resolve to the CPU backend. Prefers the
+    JAX_PLATFORMS env var (no backend init needed); falls back to asking
+    jax, which initializes the default backend — only reached from the
+    lazy first-compile path, never at import."""
+    env = _os.environ.get("JAX_PLATFORMS", "").strip().lower()
+    if env:
+        return env.split(",")[0].strip() == "cpu"
+    try:
+        import jax
+        return jax.default_backend() == "cpu"
+    except Exception:
+        return True  # no backend at all — nothing to cache
+
+
+def enable_compilation_cache(path=None, force=False):
+    """Point JAX's persistent compilation cache at `path` (defaults to
+    FLAGS_xla_compilation_cache_dir). Returns the active directory, or
+    None when the cache config is unsupported — or when the backend is
+    CPU, where deserialized executables lose donation aliasing and give
+    wrong results (see module docstring); `force=True` overrides."""
+    global _compile_cache_dir
+    from ..framework.flags import flag
+    if not force and _cpu_backend():
+        return None
+    d = _os.path.expanduser(path or flag("FLAGS_xla_compilation_cache_dir"))
+    try:
+        import jax
+        _os.makedirs(d, exist_ok=True)
+        jax.config.update("jax_compilation_cache_dir", d)
+    except Exception:
+        return None
+    _compile_cache_dir = d
+    return d
+
+
+def compilation_cache_dir():
+    """Directory of the active persistent compile cache (None if off)."""
+    return _compile_cache_dir
+
+
+def maybe_enable_compilation_cache():
+    """Resolve a deferred cache decision (JAX_PLATFORMS unset at import).
+
+    Idempotent and cheap after the first call. Invoked from the
+    framework's compile entry points (Model train/eval/predict compile
+    misses, bench.py) — at that moment a backend is about to be
+    initialized anyway, so the CPU-soundness check in `_cpu_backend()`
+    costs nothing extra, whereas running it at import would force
+    backend init (TPU runtime grab / GPU preallocation) on every
+    `import paddle_tpu`."""
+    global _cache_decision_pending
+    if not _cache_decision_pending:
+        return
+    _cache_decision_pending = False
+    try:
+        from ..framework.flags import flag
+        # in this deferred branch the decision happens after import, so a
+        # set_flags() opt-out CAN be honored — re-read the flag here
+        if flag("FLAGS_xla_compilation_cache"):
+            enable_compilation_cache()
+    except Exception:
+        pass
+
+
+def _init_compilation_cache():
+    global _cache_decision_pending
+    from ..framework.flags import flag
+    try:
+        if not flag("FLAGS_xla_compilation_cache"):
+            return
+        if _os.environ.get("JAX_PLATFORMS", "").strip():
+            enable_compilation_cache()  # env decides; no backend init
+        else:
+            _cache_decision_pending = True  # decide lazily at 1st compile
+    except Exception:
+        pass
+
+
+_init_compilation_cache()
 
 
 class cuda:

@@ -86,7 +86,7 @@ def make_host_embedding_step(dense_layer, optimizer, loss_fn: Callable,
     from ...framework.tensor import Tensor
 
     apply_fn, pv, bv = functionalize(dense_layer)
-    opt_state = {n: optimizer._init_state(v) for n, v in pv.items()}
+    opt_state = optimizer.init_state_pytree(pv)
 
     def loss_of(pv_, bv_, rng, rows, inverse, data):
         emb_batch = jnp.take(rows, inverse, axis=0)   # un-dedup on device

@@ -47,6 +47,18 @@ register_flag("FLAGS_flash_attention_min_seq", 512,
               "shortest query length dispatched to the Pallas flash kernel; "
               "below this XLA's fused dense attention wins (measured "
               "crossover on v5e; see tools/perf_attr.py)")
+register_flag("FLAGS_train_step_donate", True,
+              "donate the (params, buffers, opt_state) carry into the jitted "
+              "train step so XLA updates parameters in place instead of "
+              "allocating a second copy of the model state every step; "
+              "disable for A/B numerics checks (hapi/model.py)")
+register_flag("FLAGS_xla_compilation_cache", True,
+              "persist compiled XLA executables across processes so repeat "
+              "runs skip recompiles (device/__init__.py wires this into "
+              "jax_compilation_cache_dir at import)")
+register_flag("FLAGS_xla_compilation_cache_dir",
+              os.path.join("~", ".cache", "paddle_tpu", "xla"),
+              "directory backing the persistent XLA compilation cache")
 
 
 def set_flags(flags: Dict[str, Any]) -> None:

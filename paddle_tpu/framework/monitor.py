@@ -9,11 +9,13 @@ executor runs) bumps these; they cost one dict lookup + int add.
 """
 from __future__ import annotations
 
+import contextlib
 import threading
+import time
 from typing import Dict
 
 __all__ = ["StatValue", "stat_add", "stat_sub", "stat_reset", "stat_get",
-           "all_stats", "STAT_ADD", "STAT_SUB", "STAT_RESET"]
+           "all_stats", "stat_time", "STAT_ADD", "STAT_SUB", "STAT_RESET"]
 
 
 class StatValue:
@@ -82,6 +84,21 @@ def all_stats() -> Dict[str, int]:
     """Snapshot of every registered counter (reference
     StatRegistry::publish)."""
     return _registry.snapshot()
+
+
+@contextlib.contextmanager
+def stat_time(name: str):
+    """Accumulate the wall time (ns) of the enclosed block into `name`.
+
+    Used by the training hot loop (`STAT_train_step_ns`) — note that with
+    async dispatch this measures Python dispatch latency, not device
+    compute; pair with an explicit sync when device time is wanted.
+    """
+    t0 = time.perf_counter_ns()
+    try:
+        yield
+    finally:
+        stat_add(name, time.perf_counter_ns() - t0)
 
 
 # macro-style aliases matching the reference spelling

@@ -1,4 +1,11 @@
-"""Callbacks (reference `python/paddle/hapi/callbacks.py`)."""
+"""Callbacks (reference `python/paddle/hapi/callbacks.py`).
+
+Loss values in `logs` may be LAZY (framework.deferred.DeferredScalar
+device handles): the fit loop only materializes host floats on the
+`log_freq` cadence so the hot loop never blocks on a device->host sync.
+Callbacks that need a number coerce via `_as_float` / `float(v)` — which
+IS a sync point, so only do it on paths that already print/persist.
+"""
 from __future__ import annotations
 
 import os
@@ -9,6 +16,17 @@ import numpy as np
 
 __all__ = ["Callback", "CallbackList", "ProgBarLogger", "ModelCheckpoint",
            "LRScheduler", "EarlyStopping", "VisualDL", "config_callbacks"]
+
+
+def _as_float(v):
+    """Host float from int/float/0-d array/DeferredScalar; None if `v`
+    isn't scalar-like. Forces a device sync for lazy values."""
+    if isinstance(v, bool):
+        return None
+    try:
+        return float(v)
+    except (TypeError, ValueError):
+        return None
 
 
 class Callback:
@@ -111,22 +129,27 @@ class ProgBarLogger(Callback):
         self.steps = self.params.get("steps")
         self._epoch_t0 = time.time()
 
+    @staticmethod
+    def _items(logs):
+        out = []
+        for k, v in (logs or {}).items():
+            if k in ("step", "batch_size"):
+                continue
+            f = _as_float(v)  # sync point for lazy losses; we're printing
+            out.append(f"{k}: {f:.4f}" if f is not None else f"{k}: {v}")
+        return out
+
     def on_train_batch_end(self, step, logs=None):
         if self.verbose >= 2 and step % self.log_freq == 0:
-            items = [f"{k}: {v:.4f}" if isinstance(v, float) else f"{k}: {v}"
-                     for k, v in (logs or {}).items()
-                     if k not in ("step", "batch_size")]
             print(f"Epoch {self.epoch + 1}/{self.epochs} "
-                  f"step {step}/{self.steps} - " + " - ".join(items))
+                  f"step {step}/{self.steps} - " + " - ".join(
+                      self._items(logs)))
 
     def on_epoch_end(self, epoch, logs=None):
         if self.verbose >= 1:
             dt = time.time() - self._epoch_t0
-            items = [f"{k}: {v:.4f}" if isinstance(v, float) else f"{k}: {v}"
-                     for k, v in (logs or {}).items()
-                     if k not in ("step", "batch_size")]
             print(f"Epoch {epoch + 1}/{self.epochs} done ({dt:.1f}s) - "
-                  + " - ".join(items))
+                  + " - ".join(self._items(logs)))
 
 
 class ModelCheckpoint(Callback):
@@ -217,24 +240,45 @@ class VisualDL(Callback):
         self.log_dir = log_dir
         self._writer = None
         self._step = 0
+        self._pending = []  # (step, key, lazy value) — flushed per epoch
 
     def on_train_begin(self, logs=None):
         from ..utils.log_writer import LogWriter
         self._writer = LogWriter(self.log_dir)
 
+    _FLUSH_EVERY = 1024  # bounds pinned device scalars between flushes
+
     def on_train_batch_end(self, step, logs=None):
         if self._writer:
             self._step += 1
+            # keep lazy losses lazy: buffer the handle and materialize in
+            # bulk so scalar logging never blocks the hot loop per step
             for k, v in (logs or {}).items():
-                if isinstance(v, (int, float)):
-                    self._writer.add_scalar(f"train/{k}", v, self._step)
+                self._pending.append((self._step, k, v))
+            if len(self._pending) >= self._FLUSH_EVERY:
+                self._flush()
+
+    def _flush(self):
+        pending, self._pending = self._pending, []
+        if not pending:
+            return
+        from ..framework.deferred import materialize_many
+        # all lazy handles ride ONE device->host transfer (shared helper
+        # with Model.evaluate) — not one sync per entry; non-scalar
+        # entries come back as None and are skipped
+        for (step, k, _), f in zip(pending, materialize_many(
+                v for _, _, v in pending)):
+            if f is not None:
+                self._writer.add_scalar(f"train/{k}", f, step)
 
     def on_epoch_end(self, epoch, logs=None):
         if self._writer:
+            self._flush()
             self._writer.dump_stats(step=epoch)
 
     def on_train_end(self, logs=None):
         if self._writer:
+            self._flush()
             self._writer.close()
 
 

@@ -4,11 +4,41 @@ adapters :224/:609).
 
 TPU-native: ONE adapter — the functional train step. prepare() captures
 the network functionally; fit() drives a jax.jit-compiled
-(params, opt_state, batch) -> (loss, outputs, new_params, new_opt_state)
-step — forward, backward and the optimizer update fused into a single XLA
-program per input signature (what the reference needs CompiledProgram +
-ParallelExecutor for). When fleet is initialized the same step is pjit'ed
-over the device mesh (see distributed/fleet).
+carry -> carry step — forward, backward and the optimizer update fused
+into a single XLA program per input signature (what the reference needs
+CompiledProgram + ParallelExecutor for). When fleet is initialized the
+same step is pjit'ed over the device mesh (see distributed/fleet).
+
+Training hot-loop contract (the zero-copy / async-dispatch design):
+
+* The whole model state — (params, buffers, opt_state) — travels as ONE
+  donated carry pytree: `jax.jit(step, donate_argnums=(0,))`. XLA updates
+  parameters in place; no second copy of the model state is allocated per
+  step (mirrors parallel/spmd.py and parallel/pipeline.py donation).
+  `FLAGS_train_step_donate=0` turns donation off for A/B checks.
+* While a fit() epoch is running, `Tensor._value` on the network is STALE
+  (the donated buffers are consumed). The carry is written back by
+  `_sync_carry()` on epoch boundaries, save(), load(), parameters(),
+  summary() — eval/predict read the live carry directly without a flush.
+  Standalone train_batch calls (custom loops, outside fit) write back
+  every call, preserving the public contract that direct Layer reads —
+  net(x), state_dict() — stay fresh.
+* `train_batch` returns a device-resident DeferredScalar loss; fit() only
+  forces host floats every `log_freq` steps, so the Python loop runs ahead
+  of the accelerator (async dispatch) instead of blocking every batch.
+  CAVEAT: prepared Metrics update on host (`_update_metrics` pulls the
+  step outputs with np.asarray), so a model with metrics still syncs once
+  per batch — the deferred-sync win currently applies to metric-less
+  training; moving metric accumulation into the jitted step is the
+  follow-up that lifts this.
+* Input batches are staged onto the device one step ahead by
+  io.DeviceFeeder (double buffer) when the DataLoader has
+  `use_buffer_reader=True` (the default).
+
+Monitor counters (framework/monitor.py): STAT_train_steps,
+STAT_train_step_compiles (one per input-shape key), STAT_train_step_ns
+(dispatch wall time), STAT_train_host_syncs (DeferredScalar
+materializations).
 """
 from __future__ import annotations
 
@@ -22,9 +52,13 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..framework import random as frandom
+from ..framework.deferred import DeferredScalar, materialize_many
+from ..framework.flags import flag
 from ..framework.functional import functionalize, get_buffers, get_params
+from ..framework.monitor import STAT_ADD, stat_time
 from ..framework.tensor import Tensor
 from ..io import DataLoader, Dataset
+from ..io.device_loader import DeviceFeeder
 from ..metric import Metric
 from . import callbacks as cbks_mod
 
@@ -50,6 +84,8 @@ class Model:
         self._amp_level = None
         self._apply_fn = None
         self._opt_state = None
+        self._train_carry = None  # donated {params,buffers,opt_state} pytree
+        self._in_fit = False  # fit() defers carry write-back to epoch ends
         self._train_step_cache = {}
         self._eval_step_cache = {}
         self._pred_step_cache = {}
@@ -125,51 +161,137 @@ class Model:
             lv_raw = lv._value if isinstance(lv, Tensor) else lv
             return jnp.mean(lv_raw.astype("float32")), aux
 
-        def step(pv, bv, opt_state, rng, step_no, lr, inputs, labels):
+        def step(carry, rng, step_no, lr, inputs, labels):
+            pv, bv, opt_state = (carry["params"], carry["buffers"],
+                                 carry["opt_state"])
             (lv, (out, new_bufs)), grads = jax.value_and_grad(
                 loss_fn, has_aux=True)(pv, bv, rng, inputs, labels)
             new_pv, new_state = opt.apply_gradients_pytree(
                 grads, pv, opt_state, lr, step_no)
-            return lv, out, new_bufs, new_pv, new_state
+            return {"params": new_pv, "buffers": new_bufs,
+                    "opt_state": new_state}, lv, out
         return step
+
+    # -- carry management ----------------------------------------------------
+    def _ensure_carry(self):
+        """Device-resident {params, buffers, opt_state} pytree that the
+        donated train step consumes and reproduces each step."""
+        if self._train_carry is None:
+            pv = {n: t._value
+                  for n, t in get_params(self.network).items()}
+            bv = {n: t._value
+                  for n, t in get_buffers(self.network).items()}
+            if self._opt_state is None:
+                self._opt_state = self._optimizer.init_state_pytree(pv)
+            self._train_carry = {"params": pv, "buffers": bv,
+                                 "opt_state": self._opt_state}
+        return self._train_carry
+
+    def _sync_carry(self, validate=False):
+        """Write the training carry back into the network's Tensors.
+
+        Called on epoch boundaries, save(), load() and parameters() —
+        NOT per step. After the first donated step of an epoch the
+        Tensors' old buffers are consumed; anything that reads
+        `Tensor._value` directly mid-epoch must flush through here first.
+
+        `validate=True` (epoch boundaries and fit's error path) blocks
+        until the carry is ready and DROPS it if the device computation
+        failed: with async dispatch a step's XLA error surfaces at a
+        later host sync, after the poisoned output carry was already
+        installed — writing it back would leave the network's Tensors
+        re-raising the XLA error on every read. NOTE: with donation
+        active the Tensors' pre-epoch buffers were consumed by the first
+        step, so after a drop the model state is NOT recoverable from the
+        live network (reads raise "Array has been deleted") — recovery is
+        via ModelCheckpoint epoch saves, which flush to host files. With
+        FLAGS_train_step_donate=0 the Tensors keep valid pre-carry values.
+        """
+        carry = self._train_carry
+        if carry is None:
+            return
+        if validate:
+            try:
+                jax.block_until_ready(jax.tree_util.tree_leaves(carry))
+            except Exception:
+                # device-side failure only (XLA runtime errors are
+                # Exception subclasses): drop the poisoned carry.
+                # KeyboardInterrupt/SystemExit propagate with the carry
+                # kept installed — it is healthy, and a later
+                # _sync_carry() still writes it back.
+                self._train_carry = None
+                self._opt_state = None  # rode the same poisoned step
+                return
+        for n, t in get_params(self.network).items():
+            t._value = carry["params"][n]
+        for n, t in get_buffers(self.network).items():
+            t._value = carry["buffers"][n]
+        self._opt_state = carry["opt_state"]
+        self._train_carry = None
+
+    def _current_values(self):
+        """(params, buffers) value dicts for eval/predict: the live carry
+        when training is in flight (no flush — eval doesn't donate), else
+        the network's Tensors."""
+        carry = self._train_carry
+        if carry is not None:
+            return carry["params"], carry["buffers"]
+        return ({n: t._value for n, t in get_params(self.network).items()},
+                {n: t._value for n, t in get_buffers(self.network).items()})
 
     def train_batch(self, inputs, labels=None, update=True):
         if self._dist_ctx is not None:
             return self._train_batch_sharded(inputs, labels)
-        params = get_params(self.network)
-        buffers = get_buffers(self.network)
-        pv = {n: t._value for n, t in params.items()}
-        bv = {n: t._value for n, t in buffers.items()}
         inputs = [t._value if isinstance(t, Tensor) else jnp.asarray(t)
                   for t in _flatten_batch(inputs)]
         labels = [t._value if isinstance(t, Tensor) else jnp.asarray(t)
                   for t in _flatten_batch(labels or [])]
-        if self._opt_state is None:
-            self._opt_state = {n: self._optimizer._init_state(v)
-                               for n, v in pv.items()}
-        key = (tuple((tuple(a.shape), str(a.dtype)) for a in inputs),
+        carry = self._ensure_carry()
+        donate = bool(flag("FLAGS_train_step_donate"))
+        key = (donate,
+               tuple((tuple(a.shape), str(a.dtype)) for a in inputs),
                tuple((tuple(a.shape), str(a.dtype)) for a in labels))
         fn = self._train_step_cache.get(key)
         if fn is None:
-            fn = jax.jit(self._make_train_step())
+            fn = jax.jit(self._make_train_step(),
+                         donate_argnums=(0,) if donate else ())
             self._train_step_cache[key] = fn
+            STAT_ADD("STAT_train_step_compiles")
         rng = frandom.get_rng_key()
         step_no = getattr(self, "_global_step", 0) + 1
         self._global_step = step_no
-        lv, out, new_bufs, new_pv, new_state = fn(
-            pv, bv, self._opt_state, rng,
-            jnp.asarray(step_no, "int32"),
-            jnp.asarray(self._optimizer.get_lr(), "float32"),
-            tuple(inputs), tuple(labels))
-        for n, t in params.items():
-            t._value = new_pv[n]
-        for n, t in buffers.items():
-            t._value = new_bufs[n]
-        self._opt_state = new_state
+        try:
+            with stat_time("STAT_train_step_ns"):
+                new_carry, lv, out = fn(
+                    carry, rng, jnp.asarray(step_no, "int32"),
+                    jnp.asarray(self._optimizer.get_lr(), "float32"),
+                    tuple(inputs), tuple(labels))
+        except BaseException:
+            # a step that died mid-call may have consumed the donated
+            # carry (XLA error after dispatch). Keep the carry when its
+            # buffers are intact (trace-time error, Ctrl-C before
+            # dispatch, donation inactive) — that preserves the last
+            # completed step — but drop it once consumed so the
+            # epoch-boundary _sync_carry never writes deleted buffers
+            # back into the network's Tensors.
+            if any(getattr(leaf, "is_deleted", lambda: False)()
+                   for leaf in jax.tree_util.tree_leaves(carry)):
+                self._train_carry = None
+                self._opt_state = None  # its arrays rode the same donation
+            raise
+        self._train_carry = new_carry
+        STAT_ADD("STAT_train_steps")
+        if not self._in_fit:
+            # public custom-loop contract: a standalone train_batch call
+            # writes updated params back to the network's Tensors (cheap
+            # reference stores), so direct Layer reads — net(x),
+            # state_dict() — stay valid. Only fit() keeps the carry live
+            # across steps.
+            self._sync_carry()
         outs = jax.tree_util.tree_leaves(out)
         metrics = self._update_metrics(outs, labels)
-        return (float(lv), metrics) if self._metrics else ([float(lv)],
-                                                           metrics)
+        loss = DeferredScalar(lv)
+        return (loss, metrics) if self._metrics else ([loss], metrics)
 
     def _train_batch_sharded(self, inputs, labels):
         """fleet path: one pjit'ed step over the mesh (dp/tp/zero per
@@ -190,14 +312,11 @@ class Model:
         self._sharded_state, lv = self._sharded_step(
             self._sharded_state, tuple(ins), tuple(lbs))
         write_back(self.network, self._sharded_state)
-        outs = []  # sharded step doesn't return outputs; metrics use eval
-        return float(lv), []
+        loss = DeferredScalar(lv)
+        return (loss, []) if self._metrics else ([loss], [])
 
     def eval_batch(self, inputs, labels=None):
-        params = get_params(self.network)
-        buffers = get_buffers(self.network)
-        pv = {n: t._value for n, t in params.items()}
-        bv = {n: t._value for n, t in buffers.items()}
+        pv, bv = self._current_values()
         inputs = [t._value if isinstance(t, Tensor) else jnp.asarray(t)
                   for t in _flatten_batch(inputs)]
         labels = [t._value if isinstance(t, Tensor) else jnp.asarray(t)
@@ -224,13 +343,10 @@ class Model:
         lv, out = fn(pv, bv, rng, tuple(inputs), tuple(labels))
         outs = jax.tree_util.tree_leaves(out)
         metrics = self._update_metrics(outs, labels)
-        return float(lv), metrics
+        return DeferredScalar(lv), metrics
 
     def predict_batch(self, inputs):
-        params = get_params(self.network)
-        buffers = get_buffers(self.network)
-        pv = {n: t._value for n, t in params.items()}
-        bv = {n: t._value for n, t in buffers.items()}
+        pv, bv = self._current_values()
         inputs = [t._value if isinstance(t, Tensor) else jnp.asarray(t)
                   for t in _flatten_batch(inputs)]
         key = tuple((tuple(a.shape), str(a.dtype)) for a in inputs)
@@ -261,6 +377,15 @@ class Model:
                               num_workers=num_workers, drop_last=drop_last)
         return data
 
+    def _buffered(self, loader):
+        """Wrap a DataLoader with the async DeviceFeeder double buffer
+        (host->device transfer of batch N+1 overlaps batch N's compute)
+        when the loader opted into buffering (`use_buffer_reader`)."""
+        if isinstance(loader, DataLoader) and \
+                getattr(loader, "use_buffer_reader", False):
+            return DeviceFeeder(loader)
+        return loader
+
     def _split_batch(self, batch):
         data = _flatten_batch(batch)
         n_in = len(self._inputs) if self._inputs else 1
@@ -289,52 +414,83 @@ class Model:
         cbks.on_begin("train")
         self.stop_training = False
         step_count = 0
-        for epoch in range(epochs):
-            if hasattr(loader, "batch_sampler") and hasattr(
-                    loader.batch_sampler, "set_epoch"):
-                loader.batch_sampler.set_epoch(epoch)
-            cbks.on_epoch_begin(epoch)
-            for m in self._metrics:
-                m.reset()
-            logs = {}
-            for step, batch in enumerate(loader):
-                cbks.on_batch_begin("train", step, logs)
-                ins, lbs = self._split_batch(batch)
-                loss, metrics = self.train_batch(ins, lbs)
-                logs = {"loss": loss if np.isscalar(loss) else loss[0],
-                        "step": step, "batch_size":
-                        ins[0].shape[0] if hasattr(ins[0], "shape") else
-                        batch_size}
-                for m, r in zip(self._metrics, metrics):
+        logs = {}  # stays bound for on_end even with epochs=0
+        feed = self._buffered(loader)
+        self._in_fit = True  # keep the carry live; write back at epoch ends
+        try:
+            for epoch in range(epochs):
+                if hasattr(loader, "batch_sampler") and hasattr(
+                        loader.batch_sampler, "set_epoch"):
+                    loader.batch_sampler.set_epoch(epoch)
+                cbks.on_epoch_begin(epoch)
+                for m in self._metrics:
+                    m.reset()
+                logs = {}
+                for step, batch in enumerate(feed):
+                    cbks.on_batch_begin("train", step, logs)
+                    ins, lbs = self._split_batch(batch)
+                    loss, metrics = self.train_batch(ins, lbs)
+                    lv = loss[0] if isinstance(loss, (list, tuple)) else loss
+                    # deferred host sync: the loss stays a device handle
+                    # except on the log cadence (one sync per log_freq)
+                    if log_freq and step % log_freq == 0 and \
+                            isinstance(lv, DeferredScalar):
+                        lv = float(lv)
+                    logs = {"loss": lv, "step": step, "batch_size":
+                            ins[0].shape[0] if hasattr(ins[0], "shape") else
+                            batch_size}
+                    for m, r in zip(self._metrics, metrics):
+                        names = m.name() if isinstance(m.name(), list) else \
+                            [m.name()]
+                        vals = r if isinstance(r, list) else [r]
+                        for n, v in zip(names, vals):
+                            logs[n] = v
+                    cbks.on_batch_end("train", step, logs)
+                    step_count += 1
+                    if num_iters is not None and step_count >= num_iters:
+                        self.stop_training = True
+                        break
+                # epoch boundary: params/opt state back into Tensors, loss
+                # to a host float (callbacks may checkpoint / early-stop).
+                # validate: an async step failure from the un-synced tail
+                # of the epoch must not be written back as poisoned arrays
+                self._sync_carry(validate=True)
+                if isinstance(logs.get("loss"), DeferredScalar):
+                    logs["loss"] = float(logs["loss"])
+                # epoch-level metric accumulation
+                for m in self._metrics:
                     names = m.name() if isinstance(m.name(), list) else \
                         [m.name()]
-                    vals = r if isinstance(r, list) else [r]
+                    vals = m.accumulate()
+                    vals = vals if isinstance(vals, list) else [vals]
                     for n, v in zip(names, vals):
                         logs[n] = v
-                cbks.on_batch_end("train", step, logs)
-                step_count += 1
-                if num_iters is not None and step_count >= num_iters:
-                    self.stop_training = True
+                cbks.on_epoch_end(epoch, logs)
+                if eval_loader is not None and (epoch + 1) % eval_freq == 0:
+                    self.evaluate(eval_loader, batch_size=batch_size,
+                                  verbose=0, num_workers=num_workers,
+                                  callbacks=None)
+                if self.stop_training:
                     break
-            # epoch-level metric accumulation
-            for m in self._metrics:
-                names = m.name() if isinstance(m.name(), list) else \
-                    [m.name()]
-                vals = m.accumulate()
-                vals = vals if isinstance(vals, list) else [vals]
-                for n, v in zip(names, vals):
-                    logs[n] = v
-            cbks.on_epoch_end(epoch, logs)
-            if eval_loader is not None and (epoch + 1) % eval_freq == 0:
-                self.evaluate(eval_loader, batch_size=batch_size,
-                              verbose=0, num_workers=num_workers,
-                              callbacks=None)
-            if isinstance(self._optimizer._lr, object) and hasattr(
-                    self._optimizer._lr, "step") and not np.isscalar(
-                    self._optimizer._lr):
-                pass
-            if self.stop_training:
-                break
+        except BaseException:
+            # an async device failure surfaces at a deferred float() sync
+            # or in a callback, AFTER train_batch installed the (possibly
+            # poisoned) output carry — validate before write-back so the
+            # network keeps its last synced values instead of arrays that
+            # re-raise the XLA error on every read
+            self._in_fit = False
+            self._sync_carry(validate=True)
+            try:
+                # on_end still fires: VisualDL flushes its buffered
+                # scalars; ModelCheckpoint's "final" save succeeds when
+                # the carry survived (or donation is off) and fails
+                # loudly-but-contained when donated state was consumed
+                cbks.on_end("train", logs)
+            except Exception:
+                pass  # never mask the original error
+            raise
+        self._in_fit = False
+        self._sync_carry()
         cbks.on_end("train", logs)
         return self
 
@@ -345,11 +501,14 @@ class Model:
         for m in self._metrics:
             m.reset()
         losses = []
-        for batch in loader:
+        for batch in self._buffered(loader):
             ins, lbs = self._split_batch(batch)
             lv, _ = self.eval_batch(ins, lbs)
             losses.append(lv)
-        logs = {"loss": float(np.mean(losses)) if losses else 0.0}
+        # one device->host sync for the whole pass: every per-batch handle
+        # rides a single stacked transfer (framework.deferred)
+        vals = materialize_many(losses)
+        logs = {"loss": float(np.mean(vals)) if vals else 0.0}
         for m in self._metrics:
             names = m.name() if isinstance(m.name(), list) else [m.name()]
             vals = m.accumulate()
@@ -363,7 +522,7 @@ class Model:
         loader = self._as_loader(test_data, batch_size, False, num_workers,
                                  False)
         outputs = []
-        for batch in loader:
+        for batch in self._buffered(loader):
             ins, _ = self._split_batch(batch)
             outputs.append(self.predict_batch(ins))
         if stack_outputs and outputs:
@@ -377,6 +536,7 @@ class Model:
     # -- persistence --------------------------------------------------------
     def save(self, path, training=True):
         from ..framework.io_state import save as psave
+        self._sync_carry()
         if training:
             psave(self.network.state_dict(), path + ".pdparams")
             if self._optimizer is not None:
@@ -392,20 +552,30 @@ class Model:
 
     def load(self, path, skip_mismatch=False, reset_optimizer=False):
         from ..framework.io_state import load as pload
+        self._train_carry = None  # loaded values supersede any live carry
         state = pload(path + ".pdparams")
         self.network.set_state_dict(state)
         opt_path = path + ".pdopt"
         if not reset_optimizer and os.path.exists(opt_path):
             opt_state = pload(opt_path)
             self._global_step = opt_state.get("global_step", 0)
-            if "state" in opt_state:
-                self._opt_state = jax.tree_util.tree_map(
-                    lambda x: jnp.asarray(x), opt_state["state"])
+            # no "state" key (checkpoint saved before any step) must still
+            # drop the previous run's moments, not keep them
+            self._opt_state = (jax.tree_util.tree_map(
+                lambda x: jnp.asarray(x), opt_state["state"])
+                if "state" in opt_state else None)
+        else:
+            # actually reset: otherwise _ensure_carry would resume with the
+            # previous run's optimizer moments against the loaded weights
+            self._opt_state = None
+            self._global_step = 0
         return self
 
     def parameters(self, *args, **kwargs):
+        self._sync_carry()  # expose fresh values, not donated buffers
         return self.network.parameters()
 
     def summary(self, input_size=None, dtype=None):
         from .model_summary import summary
+        self._sync_carry()  # summary forwards through Tensor._value
         return summary(self.network, input_size, dtype)

@@ -14,11 +14,12 @@ from .dataset import (ChainDataset, ComposeDataset, ConcatDataset, Dataset,
 from .sampler import (BatchSampler, DistributedBatchSampler, RandomSampler,
                       Sampler, SequenceSampler, WeightedRandomSampler)
 from .dataloader import DataLoader, default_collate_fn, get_worker_info
+from .device_loader import DeviceFeeder
 
 __all__ = [
     "Dataset", "IterableDataset", "TensorDataset", "ComposeDataset",
     "ChainDataset", "ConcatDataset", "Subset", "random_split", "Sampler",
     "SequenceSampler", "RandomSampler", "WeightedRandomSampler",
-    "BatchSampler", "DistributedBatchSampler", "DataLoader",
+    "BatchSampler", "DistributedBatchSampler", "DataLoader", "DeviceFeeder",
     "default_collate_fn", "get_worker_info",
 ]

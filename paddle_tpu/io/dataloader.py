@@ -218,6 +218,7 @@ class DataLoader:
         self.collate_fn = collate_fn or default_collate_fn
         self.num_workers = max(0, int(num_workers))
         self.prefetch_factor = prefetch_factor
+        self.use_buffer_reader = use_buffer_reader
         self.use_shared_memory = use_shared_memory
         self.use_thread_workers = use_thread_workers
         self.timeout = timeout

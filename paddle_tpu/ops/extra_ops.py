@@ -116,11 +116,13 @@ def temporal_shift(x, seg_num, shift_ratio=0.25, data_format="NCHW",
         c1 = int(C * shift_ratio)
         c2 = int(C * 2 * shift_ratio)
         v5 = v.reshape(N, T, C, H, W)
-        fwd = jnp.concatenate([v5[:, 1:, :c1], jnp.zeros_like(
-            v5[:, :1, :c1])], axis=1)
-        back = jnp.concatenate([jnp.zeros_like(v5[:, :1, c1:c2]),
-                                v5[:, :-1, c1:c2]], axis=1)
-        out = jnp.concatenate([fwd, back, v5[:, :, c2:]], axis=2)
+        # reference temporal_shift_op.h: channels [0, c1) read frame t-1
+        # (shift forward in time), channels [c1, c2) read frame t+1
+        prev = jnp.concatenate([jnp.zeros_like(v5[:, :1, :c1]),
+                                v5[:, :-1, :c1]], axis=1)
+        nxt = jnp.concatenate([v5[:, 1:, c1:c2], jnp.zeros_like(
+            v5[:, :1, c1:c2])], axis=1)
+        out = jnp.concatenate([prev, nxt, v5[:, :, c2:]], axis=2)
         out = out.reshape(NT, C, H, W)
         if data_format == "NHWC":
             out = out.transpose(0, 2, 3, 1)
@@ -310,11 +312,17 @@ def conv_shift(x, y, name=None):
 
 
 def cvm(x, cvm_input, use_cvm=True, name=None):
-    """reference `cvm_op.cc` (CTR show/click feature): keep or strip the
-    leading 2 show/click slots; gradients mirror the slice."""
+    """reference `cvm_op.h` (CTR show/click feature): with use_cvm the
+    first two slots of X itself become log(show+1) and
+    log(click+1)-log(show+1); without, they are stripped. `cvm_input`
+    only matters for the reference's gradient path (the backward writes
+    the CVM values into dX's leading columns) — here autodiff mirrors the
+    forward, and cvm_input is kept in the signature for parity."""
     def impl(v, c):
         if use_cvm:
-            return jnp.concatenate([jnp.log(c + 1.0), v[:, 2:]], axis=1)
+            col0 = jnp.log(v[:, :1] + 1.0)
+            col1 = jnp.log(v[:, 1:2] + 1.0) - col0
+            return jnp.concatenate([col0, col1, v[:, 2:]], axis=1)
         return v[:, 2:]
     return apply_op("cvm", impl, (x, cvm_input), {})
 

@@ -39,6 +39,12 @@ _NEG_INF = -1e30
 
 def _pick_blocks(Sq, Sk):
     """Largest preferred tile that divides the sequence lengths."""
+    for s in (Sq, Sk):
+        if s % _BLOCK_MIN != 0:
+            raise ValueError(
+                f"flash: sequence length {s} must be a multiple of "
+                f"{_BLOCK_MIN} (pad the sequence or route through dense "
+                f"attention via flash_supported)")
     bq = max(b for b in (128, 256, _BLOCK_Q) if Sq % b == 0 and b <= Sq)
     bk = max(b for b in (128, 256, _BLOCK_K) if Sk % b == 0 and b <= Sk)
     return bq, bk

@@ -51,7 +51,7 @@ def make_local_train_step(layer, optimizer, loss_fn: Callable, mesh=None,
     mesh = mesh or get_mesh()
     dp = int(mesh.shape[dp_axis])
     apply_fn, pv, bv = functionalize(layer)
-    opt_state = {n: optimizer._init_state(v) for n, v in pv.items()}
+    opt_state = optimizer.init_state_pytree(pv)
 
     def stack(v):
         return jnp.broadcast_to(v[None], (dp,) + v.shape)

@@ -274,7 +274,7 @@ def make_pipeline_train_step(model, optimizer, loss_fn, *, n_micro,
 
     pv_all = {**opv, **{f"pp::{n}": v for n, v in bpv.items()}}
     pv_shard = {**o_shard, **{f"pp::{n}": bp_shard[n] for n in bpv}}
-    opt_state = {n: optimizer._init_state(v) for n, v in pv_all.items()}
+    opt_state = optimizer.init_state_pytree(pv_all)
     os_shard = {
         n: jax.tree_util.tree_map(
             lambda leaf: (pv_shard[n]

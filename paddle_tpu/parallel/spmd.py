@@ -135,7 +135,7 @@ def make_sharded_train_step(layer, optimizer, loss_fn: Callable,
     pv = {n: jax.device_put(v, p_shard[n]) for n, v in pv.items()}
     repl = NamedSharding(mesh, PartitionSpec())
     bv = {n: jax.device_put(v, repl) for n, v in bv.items()}
-    opt_state = {n: optimizer._init_state(v) for n, v in pv.items()}
+    opt_state = optimizer.init_state_pytree(pv)
     o_shard = zero_sharding(layer, opt_state, mesh, zero_stage, dp_axis)
     opt_state = jax.tree_util.tree_map(
         lambda v, s: jax.device_put(v, s), opt_state, o_shard,

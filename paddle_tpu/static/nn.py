@@ -5,8 +5,12 @@ record into the current Program."""
 from __future__ import annotations
 
 import contextlib
+import weakref
+
+import jax.numpy as jnp
 
 from .. import nn as _nn
+from ..framework.dtype import to_jax_dtype
 from ..nn import functional as F
 
 __all__ = ["fc", "conv2d", "conv3d", "batch_norm", "embedding", "dropout",
@@ -273,29 +277,52 @@ def switch_case(branch_index, branch_fns, default=None, name=None):
 # records into the current Program in static mode)
 # ---------------------------------------------------------------------------
 
-_shared_params = {}
+# name -> parameter caches, scoped PER default program (WeakKeyDictionary:
+# dropping a Program drops its share cache) so unrelated models/tests
+# never silently share weights through a colliding param_attr name.
+_shared_params = weakref.WeakKeyDictionary()
+
+
+def _validate_shared(p, shape, dtype, name):
+    p_shape = tuple(int(s) for s in p.shape)
+    if p_shape != tuple(int(s) for s in shape):
+        raise ValueError(
+            f"shared_parameter {name!r}: existing parameter has shape "
+            f"{list(p_shape)}, requested {list(shape)} — a param_attr name "
+            f"shares storage, so call sites must agree on the geometry")
+    want = to_jax_dtype(dtype)
+    have = getattr(getattr(p, "_value", None), "dtype", None)
+    if have is not None and jnp.dtype(have) != jnp.dtype(want):
+        raise ValueError(
+            f"shared_parameter {name!r}: existing parameter has dtype "
+            f"{have}, requested {want}")
+    return p
 
 
 def shared_parameter(shape, dtype, attr=None, is_bias=False,
                      default_name=None):
     """fluid LayerHelper contract: a param_attr WITH a name shares the
     parameter across call sites (reference `fluid/layer_helper_base.py`
-    create_parameter); unnamed attrs create fresh parameters per call."""
+    create_parameter); unnamed attrs create fresh parameters per call.
+    Sharing is scoped to the current default program and a shape/dtype
+    mismatch under the same name raises instead of silently aliasing."""
     from ..ops.legacy import create_parameter
     name = getattr(attr, "name", attr if isinstance(attr, str) else None)
     if name is None:
         return create_parameter(list(shape), dtype, attr=attr,
                                 is_bias=is_bias, name=default_name)
     from .program import default_main_program, in_static_mode
+    prog = default_main_program()
     if in_static_mode():
-        reg = default_main_program().param_vars
+        reg = prog.param_vars
         if name in reg:
-            return reg[name]
-    if name in _shared_params:
-        return _shared_params[name]
+            return _validate_shared(reg[name], shape, dtype, name)
+    cache = _shared_params.setdefault(prog, {})
+    if name in cache:
+        return _validate_shared(cache[name], shape, dtype, name)
     p = create_parameter(list(shape), dtype, attr=attr, is_bias=is_bias,
                          name=name)
-    _shared_params[name] = p
+    cache[name] = p
     return p
 
 def pool2d(input, pool_size=-1, pool_type="max", pool_stride=1,

@@ -48,10 +48,11 @@ class TestLayoutOps:
                                     shift_ratio=0.25).numpy()
         v = x.reshape(2, 2, 8, 2, 2)
         o = out.reshape(2, 2, 8, 2, 2)
-        np.testing.assert_allclose(o[:, 0, :2], v[:, 1, :2])   # fwd shift
-        np.testing.assert_allclose(o[:, 1, :2], 0.0)
-        np.testing.assert_allclose(o[:, 1, 2:4], v[:, 0, 2:4])  # back
-        np.testing.assert_allclose(o[:, 0, 2:4], 0.0)
+        # reference temporal_shift_op.h: [0,c1) reads t-1, [c1,c2) reads t+1
+        np.testing.assert_allclose(o[:, 1, :2], v[:, 0, :2])    # from t-1
+        np.testing.assert_allclose(o[:, 0, :2], 0.0)            # t-1 of t=0
+        np.testing.assert_allclose(o[:, 0, 2:4], v[:, 1, 2:4])  # from t+1
+        np.testing.assert_allclose(o[:, 1, 2:4], 0.0)           # t+1 of t=T-1
         np.testing.assert_allclose(o[:, :, 4:], v[:, :, 4:])    # rest
 
     def test_affine_grid_identity_matches_grid_sample(self):
@@ -180,7 +181,12 @@ class TestFluidOps:
         keep = paddle.cvm(paddle.to_tensor(x), paddle.to_tensor(c),
                           use_cvm=True).numpy()
         np.testing.assert_allclose(keep[:, 2:], x[:, 2:], rtol=1e-6)
-        np.testing.assert_allclose(keep[:, :2], np.log(c + 1), rtol=1e-5)
+        # reference cvm_op.h: log-transform X's OWN show/click columns
+        np.testing.assert_allclose(keep[:, 0], np.log(x[:, 0] + 1),
+                                   rtol=1e-5)
+        np.testing.assert_allclose(
+            keep[:, 1], np.log(x[:, 1] + 1) - np.log(x[:, 0] + 1),
+            rtol=1e-5, atol=1e-6)
         strip = paddle.cvm(paddle.to_tensor(x), paddle.to_tensor(c),
                            use_cvm=False).numpy()
         assert strip.shape == (3, 4)

@@ -615,7 +615,9 @@ add("fsp", P.fsp,
     [x_gen((2, 3, 4, 4), seed=116), x_gen((2, 2, 4, 4), seed=117)],
     diff=(0, 1))
 add("cvm", P.cvm,
-    [x_gen((3, 6), seed=118), np.abs(x_gen((3, 2), seed=119)) + 0.5],
+    # first two columns feed log(x+1): keep them positive
+    [np.abs(x_gen((3, 6), seed=118)) + 0.5,
+     np.abs(x_gen((3, 2), seed=119)) + 0.5],
     diff=(0,))
 add("temporal_shift", P.temporal_shift,
     [x_gen((4, 8, 2, 2), seed=120)], diff=(0,),
