@@ -37,6 +37,7 @@ from . import inference  # noqa: E402
 from . import onnx  # noqa: E402
 from . import profiler  # noqa: E402
 from . import quantization  # noqa: E402
+from . import serving  # noqa: E402
 from . import text  # noqa: E402
 from . import utils  # noqa: E402
 from .distributed.parallel import DataParallel  # noqa: E402

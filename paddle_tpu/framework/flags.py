@@ -59,6 +59,26 @@ register_flag("FLAGS_xla_compilation_cache", True,
 register_flag("FLAGS_xla_compilation_cache_dir",
               os.path.join("~", ".cache", "paddle_tpu", "xla"),
               "directory backing the persistent XLA compilation cache")
+register_flag("FLAGS_serving_max_batch_size", 64,
+              "serving.InferenceEngine: most request rows coalesced into "
+              "one device batch (also the largest default shape bucket)")
+register_flag("FLAGS_serving_max_batch_delay_ms", 2.0,
+              "serving.InferenceEngine: how long the micro-batcher holds "
+              "the first request of a batch open for co-riders before "
+              "dispatching a partial batch")
+register_flag("FLAGS_serving_batch_buckets", "1,4,16,64",
+              "serving.InferenceEngine: comma-separated batch-size buckets "
+              "a device batch is padded up to, so XLA compiles exactly one "
+              "executable per bucket instead of one per observed batch size")
+register_flag("FLAGS_serving_max_queue_depth", 256,
+              "serving.InferenceEngine: pending-request bound; submits "
+              "beyond it fail fast with EngineOverloaded (backpressure) "
+              "instead of growing an unbounded queue")
+register_flag("FLAGS_serving_request_timeout_ms", 30000.0,
+              "serving.InferenceEngine: default per-request deadline; a "
+              "request still queued past it fails with "
+              "ExecutionTimeoutError instead of occupying a batch slot "
+              "(0 disables)")
 
 
 def set_flags(flags: Dict[str, Any]) -> None:

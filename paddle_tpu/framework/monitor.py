@@ -15,7 +15,8 @@ import time
 from typing import Dict
 
 __all__ = ["StatValue", "stat_add", "stat_sub", "stat_reset", "stat_get",
-           "all_stats", "stat_time", "STAT_ADD", "STAT_SUB", "STAT_RESET"]
+           "all_stats", "stat_time", "STAT_ADD", "STAT_SUB", "STAT_RESET",
+           "StatHistogram", "histogram", "all_histograms"]
 
 
 class StatValue:
@@ -45,9 +46,103 @@ class StatValue:
         return self._v
 
 
+class StatHistogram:
+    """Streaming latency histogram: fixed log-spaced buckets, O(1) observe,
+    approximate percentiles (error bounded by the ~7% bucket width).
+
+    The serving engine records per-request latency here (p50/p99 without
+    retaining per-request state — the same reason the reference exports
+    bucketed latency metrics rather than raw samples)."""
+
+    # 10% geometric spacing from 1us to ~1000s expressed in the caller's
+    # unit (buckets are unit-agnostic ratios; callers pick ms or ns)
+    _BASE = 1.10
+    _MIN = 1e-3
+    _NBUCKETS = 240
+
+    __slots__ = ("name", "_counts", "_count", "_sum", "_min", "_max",
+                 "_lock")
+
+    def __init__(self, name: str):
+        self.name = name
+        self._counts = [0] * (self._NBUCKETS + 2)  # +underflow +overflow
+        self._count = 0
+        self._sum = 0.0
+        self._min = float("inf")
+        self._max = float("-inf")
+        self._lock = threading.Lock()
+
+    def _bucket(self, v: float) -> int:
+        if v < self._MIN:
+            return 0
+        import math
+        i = int(math.log(v / self._MIN) / math.log(self._BASE)) + 1
+        return min(i, self._NBUCKETS + 1)
+
+    def observe(self, value: float) -> None:
+        i = self._bucket(value)
+        with self._lock:
+            self._counts[i] += 1
+            self._count += 1
+            self._sum += value
+            self._min = min(self._min, value)
+            self._max = max(self._max, value)
+
+    def _percentile_locked(self, p: float) -> float:
+        if self._count == 0:
+            return 0.0
+        rank = max(1, int(round(p / 100.0 * self._count)))
+        seen = 0
+        for i, c in enumerate(self._counts):
+            seen += c
+            if seen >= rank:
+                if i == 0:
+                    return min(self._MIN, self._max)
+                # geometric midpoint of the bucket, clamped to
+                # observed extremes so p0/p100 stay honest
+                lo = self._MIN * (self._BASE ** (i - 1))
+                mid = lo * (self._BASE ** 0.5)
+                return max(self._min, min(mid, self._max))
+        return self._max
+
+    def percentile(self, p: float) -> float:
+        """Approximate p-th percentile (p in [0, 100])."""
+        with self._lock:
+            return self._percentile_locked(p)
+
+    @property
+    def count(self) -> int:
+        return self._count
+
+    @property
+    def sum(self) -> float:
+        return self._sum
+
+    def mean(self) -> float:
+        return self._sum / self._count if self._count else 0.0
+
+    def reset(self) -> None:
+        with self._lock:
+            self._counts = [0] * (self._NBUCKETS + 2)
+            self._count = 0
+            self._sum = 0.0
+            self._min = float("inf")
+            self._max = float("-inf")
+
+    def snapshot(self) -> Dict[str, float]:
+        with self._lock:  # one lock: count/mean/percentiles stay coherent
+            count = self._count
+            return {"count": count,
+                    "mean": round(self._sum / count, 4) if count else 0.0,
+                    "p50": round(self._percentile_locked(50), 4),
+                    "p99": round(self._percentile_locked(99), 4),
+                    "max": round(self._max, 4) if count else 0.0}
+
+
 class _Registry:
     def __init__(self):
         self._stats: Dict[str, StatValue] = {}
+        self._hists: Dict[str, StatHistogram] = {}
         self._lock = threading.Lock()
 
     def get(self, name: str) -> StatValue:
@@ -57,8 +152,18 @@ class _Registry:
                 s = self._stats.setdefault(name, StatValue(name))
         return s
 
+    def get_hist(self, name: str) -> StatHistogram:
+        h = self._hists.get(name)
+        if h is None:
+            with self._lock:
+                h = self._hists.setdefault(name, StatHistogram(name))
+        return h
+
     def snapshot(self) -> Dict[str, int]:
         return {n: s.get() for n, s in sorted(self._stats.items())}
+
+    def snapshot_hists(self) -> Dict[str, Dict[str, float]]:
+        return {n: h.snapshot() for n, h in sorted(self._hists.items())}
 
 
 _registry = _Registry()
@@ -84,6 +189,16 @@ def all_stats() -> Dict[str, int]:
     """Snapshot of every registered counter (reference
     StatRegistry::publish)."""
     return _registry.snapshot()
+
+
+def histogram(name: str) -> StatHistogram:
+    """Globally registered streaming histogram (get-or-create)."""
+    return _registry.get_hist(name)
+
+
+def all_histograms() -> Dict[str, Dict[str, float]]:
+    """Snapshot {name: {count, mean, p50, p99, max}} of every histogram."""
+    return _registry.snapshot_hists()
 
 
 @contextlib.contextmanager

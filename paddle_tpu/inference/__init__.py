@@ -17,7 +17,7 @@ import numpy as np
 
 __all__ = ["Config", "create_predictor", "Predictor", "PredictorTensor",
            "AnalysisConfig", "Analyzer", "Argument",
-           "compile_subgraph_engine"]
+           "compile_subgraph_engine", "format_input_sig", "check_fed_input"]
 
 from .analysis import Analyzer, Argument, compile_subgraph_engine  # noqa: E402
 
@@ -27,17 +27,21 @@ class Config:
 
     def __init__(self, prog_file: Optional[str] = None,
                  params_file: Optional[str] = None):
-        if prog_file and prog_file.endswith(".pdmodel"):
-            prog_file = prog_file[:-len(".pdmodel")]
-        self.model_path = prog_file
-        self.params_file = params_file
         self._use_accel = True
         self._threads = 1
         self._enable_profile = False
         self._memory_pool_mb = 0
+        self.set_model(prog_file, params_file)
 
     def set_model(self, prog_file, params_file=None):
-        self.__init__(prog_file, params_file)
+        """Update only the model/params paths. (Historically this re-ran
+        __init__, silently resetting user-set options like `_threads`,
+        `_enable_profile` and `_memory_pool_mb` — reference
+        AnalysisConfig::SetModel only touches the paths.)"""
+        if prog_file and prog_file.endswith(".pdmodel"):
+            prog_file = prog_file[:-len(".pdmodel")]
+        self.model_path = prog_file
+        self.params_file = params_file
 
     def enable_use_gpu(self, memory_pool_init_size_mb=100, device_id=0):
         self._use_accel = True
@@ -97,6 +101,47 @@ class PredictorTensor:
         return list(self._value.shape) if self._value is not None else []
 
 
+def format_input_sig(name, dims, dtype):
+    """'name: dtype[b,8]' rendering of one saved-signature entry (symbolic
+    dims print as 'b')."""
+    if dims is None:
+        return str(name)
+    ds = ",".join("b" if d is None else str(d) for d in dims)
+    return f"{name}: {np.dtype(dtype).name if dtype is not None else '?'}[{ds}]"
+
+
+def check_fed_input(arr, name, dims, dtype, *, skip_batch_dim=False,
+                    ctx="Predictor.run", expect=""):
+    """Shared rank/dim/dtype check for one fed array — used by both
+    Predictor.run and serving.InferenceEngine.submit so validation and
+    error wording never drift apart. Returns the array (same-kind-cast to
+    the saved dtype when needed) or raises a ValueError naming the
+    expected signature."""
+    note = f"; model signature is [{expect}]" if expect else ""
+    if dims is not None:
+        if arr.ndim != len(dims):
+            raise ValueError(
+                f"{ctx}: input {name!r} expects rank {len(dims)} "
+                f"({format_input_sig(name, dims, dtype)}) but got rank "
+                f"{arr.ndim} with shape {tuple(arr.shape)}{note}")
+        for axis, (want, got) in enumerate(zip(dims, arr.shape)):
+            if skip_batch_dim and axis == 0:
+                continue
+            if want is not None and int(want) != int(got):
+                raise ValueError(
+                    f"{ctx}: input {name!r} dim {axis} must be {want} but "
+                    f"got {got} (shape {tuple(arr.shape)}; expected "
+                    f"{format_input_sig(name, dims, dtype)}){note}")
+    if dtype is not None and np.dtype(arr.dtype) != np.dtype(dtype):
+        if not np.can_cast(arr.dtype, dtype, casting="same_kind"):
+            raise ValueError(
+                f"{ctx}: input {name!r} expects dtype "
+                f"{np.dtype(dtype).name} but got {np.dtype(arr.dtype).name}"
+                f" (not safely castable){note}")
+        arr = np.asarray(arr, dtype=dtype)
+    return arr
+
+
 class Predictor:
     def __init__(self, config: Config):
         import jax
@@ -135,6 +180,14 @@ class Predictor:
         self._outputs: List[PredictorTensor] = []
         for n in self._input_names:
             self._inputs[n] = PredictorTensor(n)
+        self._jit_call = None
+        self._sig = None
+        self._sig_str = ""
+        import threading
+        self._jit_lock = threading.Lock()
+        # exact per-predictor XLA compile count (bumped at jit trace time;
+        # Python side effects run once per trace = once per new signature)
+        self.compile_count = 0
 
     def get_input_names(self):
         return list(self._input_names)
@@ -142,17 +195,103 @@ class Predictor:
     def get_input_handle(self, name):
         return self._inputs[name]
 
-    def run(self, inputs: Optional[List[np.ndarray]] = None):
-        import jax
-        if inputs is not None:
-            for n, a in zip(self._input_names, inputs):
-                self._inputs[n].copy_from_cpu(np.asarray(a))
-        args = [self._inputs[n]._value for n in self._input_names]
-        if self._legacy is not None:
-            out = self._legacy.run(dict(zip(self._input_names, args)))
+    # -- saved signature ---------------------------------------------------
+
+    def input_signature(self):
+        """[(name, dims, dtype)] from the saved artifact; symbolic dims
+        (shape-polymorphic exports) are None. Legacy ProgramDesc artifacts
+        carry no aval info → dims/dtype are None. Immutable → built once
+        (run() revalidates every request against it)."""
+        if self._sig is not None:
+            return self._sig
+        if self._translated is None:
+            sig = [(n, None, None) for n in self._input_names]
         else:
-            out = self._translated._exported.call(*args)
-        leaves = jax.tree_util.tree_leaves(out)
+            sig = []
+            for n, aval in zip(self._input_names,
+                               self._translated._exported.in_avals):
+                dims = tuple(d if isinstance(d, int) else None
+                             for d in aval.shape)
+                sig.append((n, dims, np.dtype(aval.dtype)))
+        self._sig = sig
+        self._sig_str = ", ".join(format_input_sig(*s) for s in sig)
+        return sig
+
+    def _validate_feed(self, arrays):
+        """Check fed arrays against the saved signature; raise a ValueError
+        naming the expected inputs instead of failing deep inside JAX."""
+        sig = self.input_signature()
+        expect = self._sig_str
+        if len(arrays) != len(sig):
+            raise ValueError(
+                f"Predictor.run: model expects {len(sig)} input(s) "
+                f"[{expect}] but {len(arrays)} were fed")
+        out = []
+        for a, (name, dims, dtype) in zip(arrays, sig):
+            if a is None:
+                raise ValueError(
+                    f"Predictor.run: input {name!r} was never fed "
+                    f"(expected [{expect}]; use get_input_handle"
+                    f"({name!r}).copy_from_cpu(...) or pass inputs=)")
+            arr = np.asarray(a) if not hasattr(a, "dtype") else a
+            out.append(check_fed_input(arr, name, dims, dtype,
+                                       ctx="Predictor.run", expect=expect))
+        return out
+
+    # -- compiled zero-copy path ------------------------------------------
+
+    def _get_jit_call(self):
+        """One jax.jit wrapper around the deserialized executable, cached
+        on the predictor: repeat runs (and every serving-engine dispatch)
+        reuse the compiled-per-shape executable zero-copy instead of
+        re-dispatching `exported.call` eagerly. The trace-time counter
+        bump makes STAT_predictor_compiles an exact compile count (Python
+        side effects run once per trace = once per new input signature)."""
+        if self._jit_call is None:
+            with self._jit_lock:  # concurrent first runs must not build
+                if self._jit_call is not None:  # two wrappers (= two traces
+                    return self._jit_call       # per shape, breaking the
+                import jax                      # exact-compile-count contract)
+                from ..device import maybe_enable_compilation_cache
+                from ..framework import monitor
+                # resolve the deferred persistent-cache decision: a
+                # serving-only process never passes through functionalize(),
+                # so the first predictor compile is its "first framework
+                # compile" (device/__init__.py contract)
+                maybe_enable_compilation_cache()
+                exported = self._translated._exported
+
+                def _call(*args):
+                    monitor.stat_add("STAT_predictor_compiles")
+                    self.compile_count += 1
+                    return exported.call(*args)
+                self._jit_call = jax.jit(_call)
+        return self._jit_call
+
+    def run_device(self, arrays):
+        """Run on already-validated arrays; returns device-resident output
+        leaves (no host round-trip). The serving engine's hot path."""
+        import jax
+        if self._legacy is not None:
+            out = self._legacy.run(dict(zip(self._input_names, arrays)))
+        else:
+            out = self._get_jit_call()(*arrays)
+        return jax.tree_util.tree_leaves(out)
+
+    def run(self, inputs: Optional[List[np.ndarray]] = None):
+        if inputs is not None:
+            # validate BEFORE touching the handles: a rejected call must
+            # not leave half-fed state behind
+            args = self._validate_feed([np.asarray(a) for a in inputs])
+            for n, a in zip(self._input_names, args):
+                self._inputs[n].copy_from_cpu(a)
+            # compute from the device-resident handle values so the upload
+            # copy_from_cpu just did is the only host→device transfer
+            args = [self._inputs[n]._value for n in self._input_names]
+        else:
+            args = self._validate_feed(
+                [self._inputs[n]._value for n in self._input_names])
+        leaves = self.run_device(args)
         self._outputs = []
         for i, leaf in enumerate(leaves):
             t = PredictorTensor(f"output_{i}")

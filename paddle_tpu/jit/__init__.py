@@ -16,6 +16,7 @@ import pickle
 from typing import Any, Callable, Dict, Optional
 
 import jax
+import jax.export
 import jax.numpy as jnp
 import numpy as np
 
@@ -211,13 +212,31 @@ def not_to_static(fn):
 # save / load: weights + StableHLO export
 # ---------------------------------------------------------------------------
 
-def _spec_to_sds(spec):
+def _spec_to_sds(spec, scope=None, idx=0):
+    """InputSpec → ShapeDtypeStruct. With a SymbolicScope, None/-1 dims
+    become symbolic: dim 0 is the shared batch symbol "b" (every input's
+    leading dim covaries — the serving-engine contract), other dynamic
+    dims get a per-input name ("in<idx>_d<axis>") so unrelated inputs are
+    NOT constrained equal. The StableHLO export is then shape-polymorphic:
+    one artifact serves any batch size and `serving.InferenceEngine`
+    compiles once per bucket instead of failing on every batch ≠ 1.
+    Without a scope they collapse to 1 (the pre-polymorphism behavior,
+    kept as the export fallback)."""
     from ..static.input_spec import InputSpec
     if isinstance(spec, InputSpec):
-        shape = tuple(1 if (s is None or s == -1) else int(s)
-                      for s in spec.shape)
         from ..framework.dtype import to_jax_dtype
-        return jax.ShapeDtypeStruct(shape, to_jax_dtype(spec.dtype))
+        dims = []
+        for i, s in enumerate(spec.shape):
+            if s is None or s == -1:
+                if scope is None:
+                    dims.append(1)
+                else:
+                    name = "b" if i == 0 else f"in{idx}_d{i}"
+                    dims.append(jax.export.symbolic_shape(
+                        name, scope=scope)[0])
+            else:
+                dims.append(int(s))
+        return jax.ShapeDtypeStruct(tuple(dims), to_jax_dtype(spec.dtype))
     if isinstance(spec, Tensor):
         return jax.ShapeDtypeStruct(spec._value.shape, spec._value.dtype)
     return spec
@@ -237,20 +256,46 @@ def save(layer, path, input_spec=None, **configs):
             apply_fn = fwd._get_apply()
         if input_spec is None:
             raise ValueError("jit.save requires input_spec")
-        sds = [_spec_to_sds(s) for s in input_spec]
         rng = jax.random.PRNGKey(0)
 
         def infer(*xs):
             out, _ = apply_fn(pv, bv, rng, False, *xs)
             return out
-        exported = jax.export.export(jax.jit(infer))(*sds)
+
+        from ..static.input_spec import InputSpec
+        dynamic = any(isinstance(s, InputSpec)
+                      and any(d is None or d == -1 for d in s.shape)
+                      for s in input_spec)
+        exported = None
+        if dynamic:
+            # shape-polymorphic export: None/-1 dims stay symbolic so the
+            # serving engine can batch-bucket one artifact. Some programs
+            # reject polymorphic shapes (data-dependent reshapes) — fall
+            # back to the concrete dim-1 export rather than failing save.
+            try:
+                scope = jax.export.SymbolicScope()
+                sds = [_spec_to_sds(s, scope=scope, idx=i)
+                       for i, s in enumerate(input_spec)]
+                exported = jax.export.export(jax.jit(infer))(*sds)
+            except Exception as sym_err:  # noqa: BLE001
+                import warnings
+                warnings.warn(
+                    f"jit.save: shape-polymorphic export failed "
+                    f"({sym_err!r}); falling back to concrete dims — the "
+                    f"artifact will only accept the saved shapes")
+                exported = None
+        if exported is None:
+            sds = [_spec_to_sds(s) for s in input_spec]
+            exported = jax.export.export(jax.jit(infer))(*sds)
         with open(path + ".pdmodel", "wb") as f:
             f.write(exported.serialize())
         state = {n: np.asarray(v.numpy()) for n, v in
                  layer.state_dict().items()}
         with open(path + ".pdiparams", "wb") as f:
             pickle.dump(state, f, protocol=4)
-        meta = {"input_specs": [(tuple(s.shape), str(s.dtype)) for s in sds]}
+        meta = {"input_specs": [
+            (tuple(d if isinstance(d, int) else str(d) for d in s.shape),
+             str(s.dtype)) for s in sds]}
         with open(path + ".pdmeta", "wb") as f:
             pickle.dump(meta, f, protocol=4)
         return

@@ -36,30 +36,46 @@ class RecordEvent:
 
     def __init__(self, name: str):
         self.name = name
-        self._t0 = None
-        self._jax_ctx = None
+        # stacks, not scalars: one RecordEvent instance may be entered
+        # re-entrantly (recursive decorated function, nested `with ev:`)
+        self._t0s = []
+        self._jax_ctxs = []
 
     def __enter__(self):
         self.begin()
         return self
 
     def begin(self):
-        self._t0 = time.perf_counter()
+        self._t0s.append(time.perf_counter())
+        ctx = None
         try:
             import jax.profiler
-            self._jax_ctx = jax.profiler.TraceAnnotation(self.name)
-            self._jax_ctx.__enter__()
+            ctx = jax.profiler.TraceAnnotation(self.name)
+            ctx.__enter__()
         except Exception:
-            self._jax_ctx = None
+            ctx = None
+        self._jax_ctxs.append(ctx)
 
     def end(self):
-        if self._jax_ctx is not None:
-            self._jax_ctx.__exit__(None, None, None)
-        if _state.enabled and self._t0 is not None:
-            _state.events.append((self.name, self._t0, time.perf_counter()))
+        """Close the innermost open scope. Safe to call when none is open
+        (idempotent tail call), and closes the jax TraceAnnotation even if
+        host-side bookkeeping raises."""
+        if not self._t0s:
+            return
+        ctx = self._jax_ctxs.pop()
+        t0 = self._t0s.pop()
+        try:
+            if _state.enabled:
+                _state.events.append((self.name, t0, time.perf_counter()))
+        finally:
+            if ctx is not None:
+                ctx.__exit__(None, None, None)
 
     def __exit__(self, *exc):
+        # runs on the exception path too — the scope must not leak an open
+        # TraceAnnotation or a dangling _t0 when the body raises
         self.end()
+        return False
 
     def __call__(self, fn):
         import functools

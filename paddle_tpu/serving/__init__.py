@@ -1,0 +1,33 @@
+"""High-throughput batched serving (reference `paddle/fluid/inference/`
+gave AnalysisPredictor a server-side sibling in Paddle Serving; here the
+TPU-native equivalent is an in-process engine, because on accelerators
+serving throughput *is* dynamic micro-batching into a small set of
+pre-compiled bucketed shapes).
+
+`InferenceEngine` wraps `inference.create_predictor`:
+
+- **micro-batcher** — concurrent `submit()` calls coalesce into one
+  device batch under `max_batch_size` / `max_batch_delay_ms`; each call
+  returns a `concurrent.futures.Future`.
+- **shape bucketing** — batches pad up to configured batch-size buckets
+  (default 1/4/16/64) so XLA compiles exactly once per bucket; results
+  are sliced back per request, bit-identical to unbatched runs.
+- **backpressure & robustness** — bounded queue (`EngineOverloaded`),
+  per-request deadlines (`ExecutionTimeoutError`), a worker that
+  isolates a poisoned request to its own future, `shutdown()` drains.
+- **observability** — `framework.monitor` STAT counters + a streaming
+  latency histogram (p50/p99), `profiler.RecordEvent` scopes.
+"""
+from __future__ import annotations
+
+from ..framework.errors import ResourceExhaustedError
+
+
+class EngineOverloaded(ResourceExhaustedError):
+    """Raised by `InferenceEngine.submit` when the bounded request queue
+    is full — explicit load-shedding backpressure, never silent growth."""
+
+
+from .engine import EngineConfig, InferenceEngine  # noqa: E402
+
+__all__ = ["InferenceEngine", "EngineConfig", "EngineOverloaded"]

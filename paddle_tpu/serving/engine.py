@@ -1,0 +1,543 @@
+"""Dynamic micro-batching inference engine.
+
+Design (the TPU serving hot loop, mirroring what PR 1 did for training):
+submitters only validate + enqueue numpy; ONE worker thread owns all
+device dispatch, coalescing queued requests into a batch, padding it up
+to a pre-compiled bucket shape, and slicing results back per request.
+Because `jit.save` now exports shape-polymorphic StableHLO (symbolic
+batch dim), a single saved artifact serves every bucket and XLA compiles
+exactly once per bucket — the compile count is observable through
+`STAT_predictor_compiles` / `STAT_serving_bucket_compiles`.
+"""
+from __future__ import annotations
+
+import threading
+import time
+from collections import deque
+from concurrent.futures import Future
+from typing import List, Optional, Sequence
+
+import numpy as np
+
+from ..framework import monitor
+from ..framework.errors import (ExecutionTimeoutError, InvalidArgumentError,
+                                UnavailableError)
+from ..framework.flags import flag
+from ..profiler import RecordEvent
+
+__all__ = ["EngineConfig", "InferenceEngine"]
+
+
+def _now_ms() -> float:
+    return time.perf_counter() * 1000.0
+
+
+class EngineConfig:
+    """Micro-batcher knobs; every default comes from the FLAGS_serving_*
+    registry so deployments tune engines without code changes."""
+
+    def __init__(self, max_batch_size: Optional[int] = None,
+                 max_batch_delay_ms: Optional[float] = None,
+                 batch_buckets: Optional[Sequence[int]] = None,
+                 max_queue_depth: Optional[int] = None,
+                 request_timeout_ms: Optional[float] = None,
+                 warmup: bool = True):
+        self.max_batch_size = int(
+            flag("FLAGS_serving_max_batch_size")
+            if max_batch_size is None else max_batch_size)
+        if self.max_batch_size < 1:
+            raise InvalidArgumentError("max_batch_size must be >= 1")
+        self.max_batch_delay_ms = float(
+            flag("FLAGS_serving_max_batch_delay_ms")
+            if max_batch_delay_ms is None else max_batch_delay_ms)
+        explicit = batch_buckets is not None
+        if batch_buckets is None:
+            raw = str(flag("FLAGS_serving_batch_buckets"))
+            batch_buckets = [int(x) for x in raw.split(",") if x.strip()]
+        if explicit and any(int(b) < 1 or int(b) > self.max_batch_size
+                            for b in batch_buckets):
+            # flag-default buckets clip silently (a global default against
+            # a local max), but an explicitly-passed bucket the engine
+            # could never fill is a config error worth surfacing
+            raise InvalidArgumentError(
+                f"batch_buckets {tuple(batch_buckets)} contains buckets "
+                f"outside [1, max_batch_size={self.max_batch_size}]")
+        buckets = sorted({int(b) for b in batch_buckets
+                          if 1 <= int(b) <= self.max_batch_size})
+        if not buckets or buckets[-1] < self.max_batch_size:
+            buckets.append(self.max_batch_size)  # every batch must fit
+        self.batch_buckets = tuple(buckets)
+        self.max_queue_depth = int(
+            flag("FLAGS_serving_max_queue_depth")
+            if max_queue_depth is None else max_queue_depth)
+        self.request_timeout_ms = float(
+            flag("FLAGS_serving_request_timeout_ms")
+            if request_timeout_ms is None else request_timeout_ms)
+        self.warmup = bool(warmup)
+
+
+class _Request:
+    __slots__ = ("arrays", "rows", "future", "deadline_ms", "t_enqueue_ms")
+
+    def __init__(self, arrays, rows, future, deadline_ms, t_enqueue_ms):
+        self.arrays = arrays
+        self.rows = rows
+        self.future = future
+        self.deadline_ms = deadline_ms
+        self.t_enqueue_ms = t_enqueue_ms
+
+
+class InferenceEngine:
+    """Thread-safe batched serving front-end over a saved artifact.
+
+    `model` may be an artifact path prefix (as written by `jit.save` /
+    `static.save_inference_model`), an `inference.Config`, an existing
+    `inference.Predictor`, or any callable `fn(list_of_batched_arrays) ->
+    outputs` (the test/bench seam). `submit()` returns a
+    `concurrent.futures.Future` resolving to the per-request output list.
+
+    Observability is process-global (the same contract as every other
+    STAT counter): multiple engines share the STAT_serving_* counters,
+    and the latency histogram is registered as "<name>_request_ms" — give
+    each engine a unique `name` when per-engine latency attribution
+    matters.
+
+    Model contract (the requirement of every dynamic batcher, cf. TF
+    Serving's batching): output row i must depend only on input row i.
+    Inference-mode networks satisfy this; anything that mixes rows
+    (train-mode batchnorm, cross-batch attention, pairwise x @ x.T
+    outputs) must not be served through a batching engine. The engine
+    detects the common violation class — outputs without a leading batch
+    dim — and falls back to unpadded per-request execution, but
+    row-mixing inside a batch-major output is semantically invisible and
+    stays the caller's responsibility.
+    """
+
+    def __init__(self, model, config: Optional[EngineConfig] = None,
+                 input_spec=None, name: str = "serving", **overrides):
+        if config is None:
+            config = EngineConfig(**overrides)
+        elif overrides:
+            raise InvalidArgumentError(
+                "pass either an EngineConfig or keyword overrides, not both")
+        import copy
+        self._cfg = copy.copy(config)  # never mutate a shared caller config
+        self.name = name
+        self._build_runner(model, input_spec)
+        # a fixed-batch artifact (pre-polymorphism save) admits exactly one
+        # device shape: collapse bucketing to it rather than failing later
+        fixed = self._fixed_batch()
+        if fixed is not None:
+            self._cfg.max_batch_size = fixed
+            self._cfg.batch_buckets = (fixed,)
+        self._queue = deque()
+        self._cv = threading.Condition()
+        self._closed = False
+        self._bucket_stats = {b: {"compiles": 0, "batches": 0, "rows": 0}
+                              for b in self._cfg.batch_buckets}
+        self._hist = monitor.histogram(f"{name}_request_ms")
+        if self._cfg.warmup:
+            self._warmup()
+        self._worker = threading.Thread(target=self._worker_loop,
+                                        name=f"{name}-batcher", daemon=True)
+        self._worker.start()
+
+    # -- model plumbing ----------------------------------------------------
+
+    def _build_runner(self, model, input_spec):
+        from .. import inference
+        predictor = None
+        if isinstance(model, str):
+            predictor = inference.create_predictor(inference.Config(model))
+        elif isinstance(model, inference.Config):
+            predictor = inference.create_predictor(model)
+        elif isinstance(model, inference.Predictor):
+            predictor = model
+        elif callable(model):
+            predictor = None
+        else:
+            raise InvalidArgumentError(
+                f"InferenceEngine: model must be a path, inference.Config, "
+                f"Predictor, or callable, got {type(model).__name__}")
+        self._predictor = predictor
+        if predictor is not None:
+            self._signature = predictor.input_signature()
+            self._runner = predictor.run_device
+        else:
+            self._signature = self._spec_signature(input_spec)
+            self._runner = model
+        from ..inference import format_input_sig
+        self._expect = (", ".join(format_input_sig(*s)
+                                  for s in self._signature)
+                        if self._signature else "")
+        # set once a multi-request batch proves the model's outputs can't
+        # be sliced per request; later batches then skip the wasted
+        # batched execution and go straight to per-request dispatch
+        self._unsliceable = False
+
+    @staticmethod
+    def _spec_signature(input_spec):
+        """Optional signature for callable-backed engines: a list of
+        InputSpec or (shape, dtype) pairs; None disables deep validation
+        (and warmup, which needs concrete trailing dims)."""
+        if input_spec is None:
+            return None
+        sig = []
+        for i, spec in enumerate(input_spec):
+            shape = getattr(spec, "shape", None)
+            dtype = getattr(spec, "dtype", None)
+            if shape is None:
+                shape, dtype = spec
+            dims = tuple(None if (d is None or d == -1) else int(d)
+                         for d in shape)
+            sig.append((getattr(spec, "name", None) or f"input_{i}",
+                        dims, np.dtype(dtype) if dtype is not None
+                        else np.dtype("float32")))
+        return sig
+
+    def _fixed_batch(self) -> Optional[int]:
+        if not self._signature:
+            return None
+        dims0 = [d for _, dims, _ in self._signature if dims
+                 for d in [dims[0]]]
+        fixed = [d for d in dims0 if d is not None]
+        return fixed[0] if fixed else None
+
+    # -- request intake ----------------------------------------------------
+
+    def _validate(self, inputs) -> tuple:
+        from ..inference import check_fed_input
+        sig = self._signature
+        nin = len(sig) if sig else None
+        if isinstance(inputs, np.ndarray) or not isinstance(
+                inputs, (list, tuple)):
+            inputs = [inputs]
+        arrays = [np.asarray(a) for a in inputs]
+        if nin is not None:
+            expect = self._expect
+            if len(arrays) != nin:
+                raise InvalidArgumentError(
+                    f"{self.name}: model expects {nin} input(s) "
+                    f"[{expect}] but {len(arrays)} were submitted")
+            try:
+                # shared checker (same one Predictor.run uses), with the
+                # batch axis exempt — the engine owns that dimension
+                arrays = [check_fed_input(arr, n, dims, dtype,
+                                          skip_batch_dim=True,
+                                          ctx=self.name, expect=expect)
+                          for arr, (n, dims, dtype) in zip(arrays, sig)]
+            except ValueError as e:
+                raise InvalidArgumentError(str(e)) from None
+        rows = {int(a.shape[0]) for a in arrays if a.ndim >= 1}
+        if len(rows) != 1:
+            raise InvalidArgumentError(
+                f"{self.name}: all inputs must share the leading batch "
+                f"dim, got {[tuple(a.shape) for a in arrays]}")
+        n = rows.pop()
+        if n < 1:
+            raise InvalidArgumentError(f"{self.name}: empty request")
+        if n > self._cfg.max_batch_size:
+            raise InvalidArgumentError(
+                f"{self.name}: request batch {n} exceeds max_batch_size "
+                f"{self._cfg.max_batch_size}; split the request")
+        return arrays, n
+
+    def submit(self, inputs, timeout_ms: Optional[float] = None) -> Future:
+        """Enqueue one request (arrays with a leading batch dim); returns a
+        Future of the per-request output list. Raises `EngineOverloaded`
+        when the queue is at max_queue_depth."""
+        from . import EngineOverloaded
+        with RecordEvent("serving::submit"):
+            arrays, rows = self._validate(inputs)
+            t = _now_ms()
+            tmo = (self._cfg.request_timeout_ms if timeout_ms is None
+                   else float(timeout_ms))
+            # 0/None disables the deadline; a negative budget (caller's
+            # remaining time already spent) expires immediately at pop
+            req = _Request(arrays, rows, Future(),
+                           None if not tmo else t + tmo, t)
+            with self._cv:
+                if self._closed:
+                    raise UnavailableError(
+                        f"{self.name}: engine is shut down")
+                if len(self._queue) >= self._cfg.max_queue_depth:
+                    monitor.stat_add("STAT_serving_rejected")
+                    raise EngineOverloaded(
+                        f"{self.name}: queue depth "
+                        f"{self._cfg.max_queue_depth} reached "
+                        f"({len(self._queue)} pending); shed load or "
+                        f"raise FLAGS_serving_max_queue_depth")
+                self._queue.append(req)
+                monitor.stat_add("STAT_serving_queue_depth")
+                self._cv.notify()
+            monitor.stat_add("STAT_serving_requests")
+            return req.future
+
+    def run(self, inputs, timeout_ms: Optional[float] = None) -> List:
+        """Synchronous submit: blocks for this request's result."""
+        return self.submit(inputs, timeout_ms=timeout_ms).result()
+
+    # -- worker ------------------------------------------------------------
+
+    def _peek_live(self) -> Optional[_Request]:
+        """Drop expired/cancelled requests from the queue head and return
+        the first live one WITHOUT popping it (so the caller can size-check
+        before claiming). Caller holds the lock."""
+        while self._queue:
+            req = self._queue[0]
+            if req.deadline_ms is not None and _now_ms() > req.deadline_ms:
+                self._queue.popleft()
+                monitor.stat_sub("STAT_serving_queue_depth")
+                monitor.stat_add("STAT_serving_timeouts")
+                try:
+                    req.future.set_exception(ExecutionTimeoutError(
+                        f"{self.name}: request expired after "
+                        f"{_now_ms() - req.t_enqueue_ms:.1f}ms in queue"))
+                except Exception:  # racing caller-side cancel
+                    pass
+                continue
+            if req.future.cancelled():
+                self._queue.popleft()
+                monitor.stat_sub("STAT_serving_queue_depth")
+                continue
+            return req
+        return None
+
+    def _take(self) -> Optional[_Request]:
+        """Pop + claim the queue head; None if a racing cancel won.
+        Caller holds the lock and has peeked the head."""
+        req = self._queue.popleft()
+        monitor.stat_sub("STAT_serving_queue_depth")
+        if not req.future.set_running_or_notify_cancel():
+            return None
+        return req
+
+    def _collect(self) -> Optional[List[_Request]]:
+        """Block for the next batch: first live request opens the window,
+        co-riders join until max_batch_size or max_batch_delay_ms. A
+        request that would overflow the batch stays queued (peek before
+        take), so rows never exceed the largest bucket."""
+        cfg = self._cfg
+        with self._cv:
+            while not self._queue and not self._closed:
+                self._cv.wait()
+            if not self._queue and self._closed:
+                return None
+            first = None
+            while first is None:
+                if self._peek_live() is None:
+                    return []  # nothing live; outer loop re-waits
+                first = self._take()
+            batch = [first]
+            rows = first.rows
+            window_end = _now_ms() + cfg.max_batch_delay_ms
+            while rows < cfg.max_batch_size:
+                head = self._peek_live() if self._queue else None
+                if head is not None:
+                    if rows + head.rows > cfg.max_batch_size:
+                        break
+                    got = self._take()
+                    if got is None:
+                        continue
+                    batch.append(got)
+                    rows += got.rows
+                else:
+                    remain = window_end - _now_ms()
+                    if remain <= 0 or self._closed:
+                        break
+                    self._cv.wait(remain / 1000.0)
+                    if not self._queue and _now_ms() >= window_end:
+                        break
+            return batch
+
+    def _worker_loop(self):
+        batch = None
+        try:
+            while True:
+                batch = self._collect()
+                if batch is None:
+                    return
+                if batch:
+                    self._dispatch(batch)
+                batch = None
+        except BaseException as e:  # noqa: BLE001 — never hang submitters
+            # fail BOTH the already-claimed in-flight batch and everything
+            # still queued, or their submitters block on result() forever
+            stranded = list(batch or [])
+            with self._cv:
+                self._closed = True
+                while self._queue:
+                    stranded.append(self._queue.popleft())
+                    monitor.stat_sub("STAT_serving_queue_depth")
+            for req in stranded:
+                try:
+                    req.future.set_exception(UnavailableError(
+                        f"{self.name}: worker died: {e!r}"))
+                except Exception:
+                    pass
+            raise
+
+    # -- execution ---------------------------------------------------------
+
+    def _bucket_for(self, rows: int) -> int:
+        for b in self._cfg.batch_buckets:
+            if b >= rows:
+                return b
+        return self._cfg.batch_buckets[-1]
+
+    def _execute(self, arrays, rows: int, bucket: int) -> List[np.ndarray]:
+        """Pad to the bucket, run the model once, host-sync once."""
+        if rows < bucket:
+            arrays = [np.concatenate(
+                [a, np.zeros((bucket - rows,) + a.shape[1:], a.dtype)])
+                for a in arrays]
+        c0 = (self._predictor.compile_count
+              if self._predictor is not None else None)
+        with RecordEvent(f"serving::batch[b={bucket}]"):
+            out = self._runner(list(arrays))
+        # setdefault: unsliceable models run ad-hoc exact-size "buckets"
+        st = self._bucket_stats.setdefault(
+            bucket, {"compiles": 0, "batches": 0, "rows": 0})
+        if c0 is not None:
+            # exact: the predictor counts jit traces; this engine's single
+            # worker (plus init-time warmup) is the only dispatcher
+            d = self._predictor.compile_count - c0
+        else:
+            # callable-backed runner: no trace counter, mark first dispatch
+            d = 1 if st["compiles"] == 0 else 0
+        if d:
+            st["compiles"] += d
+            monitor.stat_add("STAT_serving_bucket_compiles", d)
+        import jax
+        leaves = jax.tree_util.tree_leaves(out)
+        return [np.asarray(leaf) for leaf in leaves]
+
+    def _dispatch(self, batch: List[_Request]):
+        if self._unsliceable and len(batch) > 1:
+            for req in batch:
+                self._dispatch([req])
+            return
+        rows = sum(r.rows for r in batch)
+        # an unsliceable model's outputs may aggregate over batch rows, so
+        # zero padding would contaminate them — run exact-size (one
+        # compile per observed size is the price of such models)
+        bucket = rows if self._unsliceable else self._bucket_for(rows)
+        nin = len(batch[0].arrays)
+        try:
+            # concat inside the try: on a spec-less engine, requests with
+            # inconsistent trailing dims must poison only themselves, not
+            # kill the worker
+            concat = [batch[0].arrays[i] if len(batch) == 1 else
+                      np.concatenate([r.arrays[i] for r in batch])
+                      for i in range(nin)]
+            outs = self._execute(concat, rows, bucket)
+        except Exception as e:  # noqa: BLE001
+            if len(batch) == 1:
+                monitor.stat_add("STAT_serving_request_errors")
+                try:
+                    batch[0].future.set_exception(e)
+                except Exception:
+                    pass
+                return
+            # poisoned batch: isolate — each request reruns alone so the
+            # error lands only on the offending future and the engine
+            # keeps serving everyone else
+            monitor.stat_add("STAT_serving_batch_retries")
+            for req in batch:
+                self._dispatch([req])
+            return
+        if (not self._unsliceable
+                and (len(batch) > 1 or rows < bucket)
+                and any(getattr(o, "ndim", 0) < 1 or o.shape[0] != bucket
+                        for o in outs)):
+            # an output without the batch dim leading can't be sliced back
+            # per request, and if the batch was padded it may even be
+            # computed over the padding rows — never deliver co-mingled or
+            # padding-contaminated data; rerun each request alone and
+            # UNPADDED (the _unsliceable verdict makes the recursive calls
+            # use bucket == rows), and remember the verdict so future
+            # batches skip the wasted bucketed execution
+            self._unsliceable = True
+            monitor.stat_add("STAT_serving_unsliceable_batches")
+            for req in batch:
+                self._dispatch([req])
+            return
+        st = self._bucket_stats[bucket]
+        st["batches"] += 1
+        st["rows"] += rows
+        monitor.stat_add("STAT_serving_batches")
+        monitor.stat_add("STAT_serving_batch_rows", rows)
+        monitor.stat_add("STAT_serving_batch_slots", bucket)
+        t_done = _now_ms()
+        off = 0
+        for req in batch:
+            # multi-request batches are guaranteed batch-major by the guard
+            # above; for a lone request, a non-batch-major output (e.g. a
+            # per-batch aggregate) is its own result and passes through whole
+            res = [o[off:off + req.rows]
+                   if (getattr(o, "ndim", 0) >= 1 and o.shape[0] == bucket)
+                   else o for o in outs]
+            off += req.rows
+            self._hist.observe(t_done - req.t_enqueue_ms)
+            try:
+                req.future.set_result(res)
+            except Exception:  # racing caller-side cancel
+                pass
+
+    def _warmup(self):
+        """Compile every bucket up front so no live request pays a compile.
+        Needs concrete trailing dims; silently skipped otherwise."""
+        if not self._signature:
+            return
+        shapes = []
+        for _, dims, dtype in self._signature:
+            if dims is None or any(d is None for d in dims[1:]):
+                return
+            shapes.append((tuple(dims[1:]), dtype or np.dtype("float32")))
+        with RecordEvent("serving::warmup"):
+            for b in self._cfg.batch_buckets:
+                arrays = [np.zeros((b,) + rest, dtype)
+                          for rest, dtype in shapes]
+                self._execute(arrays, b, b)
+
+    # -- lifecycle / introspection -----------------------------------------
+
+    def stats(self) -> dict:
+        """Engine-local snapshot: per-bucket compile/batch/occupancy, live
+        queue depth, and the latency histogram percentiles."""
+        with self._cv:
+            depth = len(self._queue)
+        slots = sum(b * s["batches"]
+                    for b, s in self._bucket_stats.items())
+        served = sum(s["rows"] for s in self._bucket_stats.values())
+        return {
+            "buckets": {b: dict(s) for b, s in self._bucket_stats.items()},
+            "queue_depth": depth,
+            "rows_served": served,
+            "mean_occupancy": round(served / slots, 4) if slots else 0.0,
+            "latency_ms": self._hist.snapshot(),
+        }
+
+    def shutdown(self, drain: bool = True, timeout_s: Optional[float] = None):
+        """Stop intake; by default the worker drains every queued request
+        before exiting. With drain=False pending futures fail fast."""
+        with self._cv:
+            self._closed = True
+            if not drain:
+                while self._queue:
+                    req = self._queue.popleft()
+                    monitor.stat_sub("STAT_serving_queue_depth")
+                    try:
+                        req.future.set_exception(UnavailableError(
+                            f"{self.name}: engine shut down"))
+                    except Exception:
+                        pass
+            self._cv.notify_all()
+        self._worker.join(timeout_s)
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.shutdown()
+        return False

@@ -1,0 +1,455 @@
+"""serving.InferenceEngine: dynamic micro-batching, shape bucketing,
+backpressure/timeout/poison robustness, observability — plus the
+inference.Config/Predictor and profiler.RecordEvent satellites.
+
+Numerics note: XLA compiles a different executable per batch bucket, and
+different tilings may order float reductions differently — so bit-identity
+is asserted WITHIN a bucket (padding and co-rider rows must never change a
+request's result), not across buckets.
+"""
+import os
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+from paddle_tpu import inference, serving
+from paddle_tpu.framework import monitor
+from paddle_tpu.framework.errors import (ExecutionTimeoutError,
+                                         UnavailableError)
+from paddle_tpu.static.input_spec import InputSpec
+
+
+class _Mlp(nn.Layer):
+    def __init__(self):
+        super().__init__()
+        self.fc1 = nn.Linear(8, 16)
+        self.fc2 = nn.Linear(16, 4)
+
+    def forward(self, x):
+        return self.fc2(paddle.tanh(self.fc1(x)))
+
+
+@pytest.fixture(scope="module")
+def artifact(tmp_path_factory):
+    paddle.seed(7)
+    prefix = str(tmp_path_factory.mktemp("serving") / "mlp")
+    paddle.jit.save(_Mlp(), prefix,
+                    input_spec=[InputSpec([None, 8], "float32")])
+    return prefix
+
+
+def _x(rows, seed=0):
+    return np.random.RandomState(seed).standard_normal(
+        (rows, 8)).astype("float32")
+
+
+# ---------------------------------------------------------------------------
+# satellite: Config.set_model must not reset user options
+# ---------------------------------------------------------------------------
+
+def test_set_model_preserves_options(artifact):
+    cfg = inference.Config()
+    cfg.set_cpu_math_library_num_threads(7)
+    cfg.enable_profile()
+    cfg.enable_use_gpu(memory_pool_init_size_mb=333)
+    cfg.set_model(artifact + ".pdmodel", artifact + ".pdiparams")
+    assert cfg._threads == 7
+    assert cfg._enable_profile is True
+    assert cfg._memory_pool_mb == 333
+    assert cfg.model_path == artifact  # .pdmodel suffix stripped
+    assert cfg.params_file == artifact + ".pdiparams"
+    # and the re-pathed config still loads
+    assert inference.create_predictor(cfg).run([_x(1)])[0].shape == (1, 4)
+
+
+# ---------------------------------------------------------------------------
+# satellite: Predictor.run input validation
+# ---------------------------------------------------------------------------
+
+def test_predictor_validation_messages(artifact):
+    pred = inference.create_predictor(inference.Config(artifact))
+    with pytest.raises(ValueError, match=r"input_0.*rank 2"):
+        pred.run([np.zeros((2, 8, 1), "float32")])
+    with pytest.raises(ValueError, match=r"dim 1 must be 8"):
+        pred.run([np.zeros((2, 9), "float32")])
+    with pytest.raises(ValueError, match=r"float32.*complex64"):
+        pred.run([np.zeros((2, 8), "complex64")])
+    with pytest.raises(ValueError, match=r"expects 1 input"):
+        pred.run([np.zeros((2, 8), "float32")] * 2)
+    with pytest.raises(ValueError, match=r"never fed"):
+        pred.run()  # handle-style call without feeding anything
+    # message names the full signature so the fix is obvious
+    with pytest.raises(ValueError, match=r"float32\[b,8\]"):
+        pred.run([np.zeros((2, 9), "float32")])
+
+
+def test_predictor_safe_cast_accepted(artifact):
+    pred = inference.create_predictor(inference.Config(artifact))
+    out = pred.run([np.zeros((2, 8), "float64")])  # same_kind → cast
+    assert out[0].dtype == np.float32
+
+
+# ---------------------------------------------------------------------------
+# tentpole: shape-polymorphic artifact + compiled zero-copy predictor path
+# ---------------------------------------------------------------------------
+
+def test_symbolic_batch_artifact_serves_any_batch(artifact):
+    pred = inference.create_predictor(inference.Config(artifact))
+    name, dims, dtype = pred.input_signature()[0]
+    assert dims == (None, 8) and dtype == np.dtype("float32")
+    assert pred.run([_x(1)])[0].shape == (1, 4)
+    assert pred.run([_x(13)])[0].shape == (13, 4)
+
+
+def test_predictor_compile_counter_once_per_shape(artifact):
+    pred = inference.create_predictor(inference.Config(artifact))
+    c0 = monitor.stat_get("STAT_predictor_compiles")
+    for _ in range(3):
+        pred.run([_x(2)])
+    assert monitor.stat_get("STAT_predictor_compiles") - c0 == 1
+    pred.run([_x(6)])
+    assert monitor.stat_get("STAT_predictor_compiles") - c0 == 2
+
+
+def test_fixed_shape_artifact_still_works(tmp_path):
+    paddle.seed(3)
+    prefix = str(tmp_path / "fixed")
+    paddle.jit.save(_Mlp(), prefix,
+                    input_spec=[InputSpec([2, 8], "float32")])
+    pred = inference.create_predictor(inference.Config(prefix))
+    assert pred.input_signature()[0][1] == (2, 8)
+    with pytest.raises(ValueError, match=r"dim 0 must be 2"):
+        pred.run([_x(3)])
+    # the engine collapses bucketing to the artifact's fixed batch and
+    # pads smaller requests up to it
+    eng = serving.InferenceEngine(pred, max_batch_delay_ms=1.0)
+    try:
+        assert eng._cfg.batch_buckets == (2,)
+        res = eng.run(_x(1))
+        assert res[0].shape == (1, 4)
+        np.testing.assert_array_equal(res[0], pred.run(
+            [np.concatenate([_x(1), np.zeros((1, 8), "float32")])])[0][:1])
+    finally:
+        eng.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# tentpole: micro-batcher correctness
+# ---------------------------------------------------------------------------
+
+def test_batched_results_bit_identical_under_padding(artifact):
+    """A request's rows must be bit-identical whether padded with zeros or
+    surrounded by co-rider requests — padding never bleeds in."""
+    pred = inference.create_predictor(inference.Config(artifact))
+    eng = serving.InferenceEngine(pred, batch_buckets=(1, 4, 16),
+                                  max_batch_size=16, max_batch_delay_ms=30.0)
+    try:
+        xs = [_x(r, seed=r) for r in (1, 2, 3)]  # 6 rows → bucket 16
+        futs = [eng.submit(x) for x in xs]
+        res = [f.result(timeout=30) for f in futs]
+        # oracle: the same bucket-16 executable over the hand-padded batch
+        padded = np.concatenate(xs + [np.zeros((10, 8), "float32")])
+        oracle = pred.run([padded])[0]
+        off = 0
+        for x, r in zip(xs, res):
+            np.testing.assert_array_equal(r[0], oracle[off:off + len(x)])
+            off += len(x)
+        # one 6-row request alone (zero padding only, same bucket 16) is
+        # bit-identical to the co-rider composition above
+        alone = eng.submit(np.concatenate(xs)).result(timeout=30)
+        np.testing.assert_array_equal(alone[0], oracle[:6])
+    finally:
+        eng.shutdown()
+
+
+def test_one_compile_per_bucket_under_load(artifact):
+    pred = inference.create_predictor(inference.Config(artifact))
+    c0 = monitor.stat_get("STAT_predictor_compiles")
+    eng = serving.InferenceEngine(pred, batch_buckets=(1, 4, 16),
+                                  max_batch_size=16, max_batch_delay_ms=2.0,
+                                  name="one_compile_test")
+    try:
+        warm = monitor.stat_get("STAT_predictor_compiles") - c0
+        assert warm == 3  # warmup compiled each bucket exactly once
+        futs = []
+        for i in range(40):
+            futs.append(eng.submit(_x(1 + i % 3, seed=i)))
+        for f in futs:
+            assert f.result(timeout=30)[0].dtype == np.float32
+        assert monitor.stat_get("STAT_predictor_compiles") - c0 == 3
+        s = eng.stats()
+        assert all(b["compiles"] == 1 for b in s["buckets"].values())
+        assert s["latency_ms"]["count"] == 40
+        assert s["latency_ms"]["p99"] >= s["latency_ms"]["p50"] > 0
+    finally:
+        eng.shutdown()
+
+
+def test_occupancy_under_concurrent_submitters(artifact):
+    eng = serving.InferenceEngine(
+        inference.create_predictor(inference.Config(artifact)),
+        batch_buckets=(1, 4, 16), max_batch_size=16,
+        max_batch_delay_ms=50.0)
+    b0 = monitor.stat_get("STAT_serving_batches")
+    r0 = monitor.stat_get("STAT_serving_requests")
+    try:
+        results = []
+        lock = threading.Lock()
+
+        def client(i):
+            out = eng.run(_x(1, seed=i), timeout_ms=0)
+            with lock:
+                results.append(out)
+
+        threads = [threading.Thread(target=client, args=(i,))
+                   for i in range(24)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(60)
+        assert len(results) == 24
+        batches = monitor.stat_get("STAT_serving_batches") - b0
+        requests = monitor.stat_get("STAT_serving_requests") - r0
+        assert requests == 24
+        assert batches < requests  # coalescing actually happened
+        assert eng.stats()["mean_occupancy"] > 0
+    finally:
+        eng.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# tentpole: backpressure, timeout, poison, shutdown
+# ---------------------------------------------------------------------------
+
+class _Gate:
+    """Callable model whose first batch blocks until released — makes the
+    worker busy so queue behavior is deterministic to test."""
+
+    def __init__(self):
+        self.release = threading.Event()
+        self.entered = threading.Event()
+
+    def __call__(self, arrays):
+        self.entered.set()
+        assert self.release.wait(30)
+        return [np.asarray(arrays[0], "float32") * 2.0]
+
+
+def _gated_engine(**kw):
+    gate = _Gate()
+    eng = serving.InferenceEngine(
+        gate, input_spec=[([None, 4], "float32")], warmup=False, **kw)
+    return eng, gate
+
+
+def test_overload_rejection():
+    eng, gate = _gated_engine(max_queue_depth=2, max_batch_size=1,
+                              batch_buckets=(1,), max_batch_delay_ms=0.0)
+    try:
+        first = eng.submit(np.zeros((1, 4), "float32"))
+        assert gate.entered.wait(10)  # worker busy inside the gate
+        q1 = eng.submit(np.zeros((1, 4), "float32"))
+        q2 = eng.submit(np.zeros((1, 4), "float32"))
+        rej0 = monitor.stat_get("STAT_serving_rejected")
+        with pytest.raises(serving.EngineOverloaded, match="queue depth"):
+            eng.submit(np.zeros((1, 4), "float32"))
+        assert monitor.stat_get("STAT_serving_rejected") == rej0 + 1
+        gate.release.set()
+        for f in (first, q1, q2):
+            assert f.result(timeout=30)[0].shape == (1, 4)
+    finally:
+        gate.release.set()
+        eng.shutdown()
+
+
+def test_request_timeout_while_queued():
+    eng, gate = _gated_engine(max_batch_size=1, batch_buckets=(1,),
+                              max_batch_delay_ms=0.0)
+    try:
+        first = eng.submit(np.zeros((1, 4), "float32"))
+        assert gate.entered.wait(10)
+        stale = eng.submit(np.zeros((1, 4), "float32"), timeout_ms=1.0)
+        time.sleep(0.05)  # let the deadline lapse while the worker is busy
+        fresh = eng.submit(np.zeros((1, 4), "float32"), timeout_ms=0)
+        gate.release.set()
+        with pytest.raises(ExecutionTimeoutError):
+            stale.result(timeout=30)
+        assert isinstance(stale.exception(), TimeoutError)  # typed family
+        assert fresh.result(timeout=30)[0].shape == (1, 4)
+        assert first.result(timeout=30)[0].shape == (1, 4)
+    finally:
+        gate.release.set()
+        eng.shutdown()
+
+
+def test_poisoned_request_only_fails_its_future():
+    def model(arrays):
+        a = np.asarray(arrays[0])
+        if (a == 777.0).any():
+            raise RuntimeError("poisoned batch")
+        return [a + 1.0]
+
+    eng = serving.InferenceEngine(
+        model, input_spec=[([None, 4], "float32")], warmup=False,
+        batch_buckets=(1, 8), max_batch_size=8, max_batch_delay_ms=50.0)
+    try:
+        good1 = eng.submit(np.ones((1, 4), "float32"))
+        poison = eng.submit(np.full((1, 4), 777.0, "float32"))
+        good2 = eng.submit(np.ones((2, 4), "float32") * 3.0)
+        np.testing.assert_array_equal(good1.result(timeout=30)[0],
+                                      np.full((1, 4), 2.0, "float32"))
+        with pytest.raises(RuntimeError, match="poisoned"):
+            poison.result(timeout=30)
+        np.testing.assert_array_equal(good2.result(timeout=30)[0],
+                                      np.full((2, 4), 4.0, "float32"))
+        # the engine survives and keeps serving
+        after = eng.run(np.zeros((1, 4), "float32"))
+        np.testing.assert_array_equal(after[0],
+                                      np.ones((1, 4), "float32"))
+    finally:
+        eng.shutdown()
+
+
+def test_shutdown_drains_and_rejects_new_work(artifact):
+    eng = serving.InferenceEngine(
+        inference.create_predictor(inference.Config(artifact)),
+        batch_buckets=(1, 4), max_batch_size=4, max_batch_delay_ms=5.0)
+    futs = [eng.submit(_x(1, seed=i)) for i in range(9)]
+    eng.shutdown()  # must drain every queued request
+    for f in futs:
+        assert f.result(timeout=1)[0].shape == (1, 4)
+    with pytest.raises(UnavailableError):
+        eng.submit(_x(1))
+
+
+def test_explicit_oversized_bucket_rejected():
+    with pytest.raises(ValueError, match="outside"):
+        serving.EngineConfig(max_batch_size=64, batch_buckets=(1, 128))
+    with pytest.raises(ValueError, match="outside"):
+        serving.EngineConfig(max_batch_size=8, batch_buckets=(0, 4))
+    # flag-default buckets clip silently against a smaller local max
+    assert serving.EngineConfig(max_batch_size=8).batch_buckets == (1, 4, 8)
+
+
+def test_non_batch_major_output_never_comingled_or_padded():
+    """A model whose output lacks the leading batch dim (per-batch
+    aggregate) can't be sliced per request — each future must get its OWN
+    model output, rerun alone and UNPADDED (mean over zero-padding rows
+    would corrupt the value, so this asserts both isolation and
+    padding-freedom)."""
+    def model(arrays):
+        a = np.asarray(arrays[0])
+        return [np.asarray([a.mean()], "float32")]  # shape (1,) aggregate
+
+    eng = serving.InferenceEngine(
+        model, input_spec=[([None, 4], "float32")], warmup=False,
+        batch_buckets=(8,), max_batch_size=8, max_batch_delay_ms=50.0)
+    try:
+        f1 = eng.submit(np.ones((2, 4), "float32"))        # mean 1.0
+        f2 = eng.submit(np.full((1, 4), 2.0, "float32"))   # mean 2.0
+        assert float(f1.result(timeout=30)[0][0]) == 1.0
+        assert float(f2.result(timeout=30)[0][0]) == 2.0
+        # verdict is cached: later lone requests also run unpadded
+        f3 = eng.submit(np.full((3, 4), 3.0, "float32"))   # mean 3.0
+        assert float(f3.result(timeout=30)[0][0]) == 3.0
+        assert monitor.stat_get("STAT_serving_unsliceable_batches") >= 1
+    finally:
+        eng.shutdown()
+
+
+def test_engine_input_validation():
+    eng = serving.InferenceEngine(
+        lambda arrays: [np.asarray(arrays[0])],
+        input_spec=[([None, 4], "float32")], warmup=False,
+        max_batch_size=8, batch_buckets=(8,), max_batch_delay_ms=0.0)
+    try:
+        with pytest.raises(ValueError, match="rank 2"):
+            eng.submit(np.zeros((3,), "float32"))
+        with pytest.raises(ValueError, match="dim 1 must be 4"):
+            eng.submit(np.zeros((1, 5), "float32"))
+        with pytest.raises(ValueError, match="exceeds max_batch_size"):
+            eng.submit(np.zeros((9, 4), "float32"))
+        with pytest.raises(ValueError, match="empty request"):
+            eng.submit(np.zeros((0, 4), "float32"))
+    finally:
+        eng.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# satellite: RecordEvent exception path + re-entrant/decorator use
+# ---------------------------------------------------------------------------
+
+def test_record_event_closes_on_exception():
+    from paddle_tpu import profiler
+    profiler.start_profiler()
+    try:
+        with pytest.raises(RuntimeError):
+            with profiler.RecordEvent("boom_scope"):
+                raise RuntimeError("body failed")
+    finally:
+        events = list(profiler._state.events)
+        profiler.stop_profiler()
+    names = [n for n, _, _ in events]
+    assert "boom_scope" in names  # event recorded despite the raise
+
+
+def test_record_event_reentrant_and_decorator():
+    from paddle_tpu import profiler
+    ev = profiler.RecordEvent("nested")
+    profiler.start_profiler()
+    try:
+        with ev:
+            with ev:      # same instance re-entered
+                pass
+        assert ev._t0s == [] and ev._jax_ctxs == []  # nothing leaked
+
+        @profiler.RecordEvent("fib")
+        def fib(n):
+            if n >= 2 and n == 3:
+                raise ValueError("deliberate")
+            return 1 if n < 2 else fib(n - 1) + fib(n - 2)
+
+        assert fib(2) == 2
+        with pytest.raises(ValueError):
+            fib(3)
+        events = list(profiler._state.events)
+    finally:
+        profiler.stop_profiler()
+    assert len([n for n, _, _ in events if n == "nested"]) == 2
+    # decorator: recursive + exception path both recorded and balanced
+    assert len([n for n, _, _ in events if n == "fib"]) >= 3
+
+
+def test_record_event_end_idempotent():
+    from paddle_tpu import profiler
+    ev = profiler.RecordEvent("idem")
+    ev.begin()
+    ev.end()
+    ev.end()  # extra end: no crash, no underflow
+    assert ev._t0s == []
+
+
+# ---------------------------------------------------------------------------
+# satellite: monitor histogram
+# ---------------------------------------------------------------------------
+
+def test_stat_histogram_percentiles():
+    h = monitor.StatHistogram("t")
+    for v in [1.0] * 98 + [100.0, 200.0]:
+        h.observe(v)
+    assert h.count == 100
+    assert h.percentile(50) == pytest.approx(1.0, rel=0.15)
+    assert h.percentile(99) == pytest.approx(100.0, rel=0.15)
+    assert h.percentile(100) == pytest.approx(200.0, rel=0.15)
+    h.reset()
+    assert h.count == 0 and h.percentile(50) == 0.0
+
+
+def test_histogram_registry_snapshot():
+    monitor.histogram("reg_test_ms").observe(5.0)
+    snap = monitor.all_histograms()
+    assert snap["reg_test_ms"]["count"] >= 1
