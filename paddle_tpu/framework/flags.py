@@ -74,11 +74,23 @@ register_flag("FLAGS_serving_max_queue_depth", 256,
               "serving.InferenceEngine: pending-request bound; submits "
               "beyond it fail fast with EngineOverloaded (backpressure) "
               "instead of growing an unbounded queue")
+register_flag("FLAGS_serving_max_inflight", 2,
+              "serving.InferenceEngine: device batches a dispatch lane may "
+              "have in flight (dispatched but not yet completed). 2 keeps "
+              "the device fed while batch N computes (JAX async dispatch); "
+              "1 disables pipelining (dispatch blocks until completion)")
+register_flag("FLAGS_serving_devices", "",
+              "serving.InferenceEngine default device set: '' = every "
+              "local device for artifact-path/Config models (one dispatch "
+              "lane + Predictor replica per chip), 'all', or a "
+              "comma-separated list of local device INDICES ('0,2'); an "
+              "integer lane COUNT is only meaningful as the devices= "
+              "argument, not through this string flag")
 register_flag("FLAGS_serving_request_timeout_ms", 30000.0,
-              "serving.InferenceEngine: default per-request deadline; a "
-              "request still queued past it fails with "
-              "ExecutionTimeoutError instead of occupying a batch slot "
-              "(0 disables)")
+              "serving.InferenceEngine: default per-request deadline, "
+              "enforced while queued AND again at completion — a request "
+              "that expired while its batch was on-device fails with "
+              "ExecutionTimeoutError, never a late result (0 disables)")
 
 
 def set_flags(flags: Dict[str, Any]) -> None:

@@ -16,7 +16,8 @@ from typing import Dict
 
 __all__ = ["StatValue", "stat_add", "stat_sub", "stat_reset", "stat_get",
            "all_stats", "stat_time", "STAT_ADD", "STAT_SUB", "STAT_RESET",
-           "StatHistogram", "histogram", "all_histograms"]
+           "StatHistogram", "histogram", "all_histograms",
+           "reset_all_stats"]
 
 
 class StatValue:
@@ -165,6 +166,15 @@ class _Registry:
     def snapshot_hists(self) -> Dict[str, Dict[str, float]]:
         return {n: h.snapshot() for n, h in sorted(self._hists.items())}
 
+    def reset_all(self) -> None:
+        with self._lock:
+            stats = list(self._stats.values())
+            hists = list(self._hists.values())
+        for s in stats:
+            s.reset()
+        for h in hists:
+            h.reset()
+
 
 _registry = _Registry()
 
@@ -189,6 +199,14 @@ def all_stats() -> Dict[str, int]:
     """Snapshot of every registered counter (reference
     StatRegistry::publish)."""
     return _registry.snapshot()
+
+
+def reset_all_stats() -> None:
+    """Zero every registered counter AND histogram. STAT counters are
+    process-global (the serving-engine docstring's contract), so a bench
+    or test that measures deltas from a warm process must reset first or
+    it inherits counts from whatever ran before."""
+    _registry.reset_all()
 
 
 def histogram(name: str) -> StatHistogram:

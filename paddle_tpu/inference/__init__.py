@@ -10,6 +10,7 @@ get_input_handle / run / get_output_handle.
 """
 from __future__ import annotations
 
+import contextlib
 import os
 from typing import Dict, List, Optional
 
@@ -17,7 +18,8 @@ import numpy as np
 
 __all__ = ["Config", "create_predictor", "Predictor", "PredictorTensor",
            "AnalysisConfig", "Analyzer", "Argument",
-           "compile_subgraph_engine", "format_input_sig", "check_fed_input"]
+           "compile_subgraph_engine", "format_input_sig", "check_fed_input",
+           "as_device", "resolve_devices"]
 
 from .analysis import Analyzer, Argument, compile_subgraph_engine  # noqa: E402
 
@@ -142,11 +144,49 @@ def check_fed_input(arr, name, dims, dtype, *, skip_batch_dim=False,
     return arr
 
 
+def as_device(dev):
+    """Canonicalize one device spec: an int is an index into
+    `jax.local_devices()`; a jax Device passes through."""
+    if isinstance(dev, (int, np.integer)):
+        import jax
+        local = jax.local_devices()
+        if not 0 <= int(dev) < len(local):
+            raise ValueError(f"device index {dev} out of range; host has "
+                             f"{len(local)} local device(s)")
+        return local[int(dev)]
+    return dev
+
+
+def resolve_devices(devices):
+    """Expand a device-set spec into a list of jax Devices. Accepts
+    'all' (every local device), an int count (first N local devices), a
+    comma-separated index string ('0,2'), or a sequence of indices /
+    Devices. The serving engine builds one Predictor replica (and one
+    dispatch lane) per entry."""
+    import jax
+    local = jax.local_devices()
+    if isinstance(devices, str):
+        if devices.strip().lower() == "all":
+            return list(local)
+        devices = [int(x) for x in devices.split(",") if x.strip()]
+    elif isinstance(devices, (int, np.integer)):
+        n = int(devices)
+        if not 1 <= n <= len(local):
+            raise ValueError(f"asked for {n} serving device(s) but host "
+                             f"has {len(local)}")
+        return list(local[:n])
+    out = [as_device(d) for d in devices]
+    if not out:
+        raise ValueError("empty device list")
+    return out
+
+
 class Predictor:
-    def __init__(self, config: Config):
+    def __init__(self, config: Config, device=None):
         import jax
         from .. import jit
         self._config = config
+        self._device = as_device(device) if device is not None else None
         self._legacy = None
         if config.model_path is None:
             raise ValueError("Config has no model path")
@@ -188,6 +228,31 @@ class Predictor:
         # exact per-predictor XLA compile count (bumped at jit trace time;
         # Python side effects run once per trace = once per new signature)
         self.compile_count = 0
+
+    @property
+    def device(self):
+        """The jax Device this predictor is pinned to (None = backend
+        default). Pinning happens at dispatch via `jax.default_device`,
+        so fed host arrays land — and the executable compiles — there."""
+        return self._device
+
+    def clone_for_device(self, device) -> "Predictor":
+        """Replica on another device sharing the already-deserialized
+        artifact (no disk re-load) but with its OWN cached jit wrapper,
+        trace counter, and I/O handles. Serving lanes need one replica
+        per device precisely because a `jax.jit` executable is per-device
+        state: a fresh wrapper per replica keeps `compile_count` an exact
+        per-(device, bucket) compile ledger."""
+        import copy as _copy
+        import threading
+        p = _copy.copy(self)
+        p._device = as_device(device) if device is not None else None
+        p._inputs = {n: PredictorTensor(n) for n in self._input_names}
+        p._outputs = []
+        p._jit_call = None
+        p._jit_lock = threading.Lock()
+        p.compile_count = 0
+        return p
 
     def get_input_names(self):
         return list(self._input_names)
@@ -270,21 +335,32 @@ class Predictor:
 
     def run_device(self, arrays):
         """Run on already-validated arrays; returns device-resident output
-        leaves (no host round-trip). The serving engine's hot path."""
+        leaves (no host round-trip, and no host sync — under JAX async
+        dispatch the leaves are futures the caller blocks on). The serving
+        engine's lane-dispatch hot path."""
         import jax
-        if self._legacy is not None:
-            out = self._legacy.run(dict(zip(self._input_names, arrays)))
-        else:
-            out = self._get_jit_call()(*arrays)
+        ctx = (jax.default_device(self._device) if self._device is not None
+               else contextlib.nullcontext())
+        with ctx:
+            if self._legacy is not None:
+                out = self._legacy.run(dict(zip(self._input_names, arrays)))
+            else:
+                out = self._get_jit_call()(*arrays)
         return jax.tree_util.tree_leaves(out)
 
     def run(self, inputs: Optional[List[np.ndarray]] = None):
+        import jax
         if inputs is not None:
             # validate BEFORE touching the handles: a rejected call must
             # not leave half-fed state behind
             args = self._validate_feed([np.asarray(a) for a in inputs])
-            for n, a in zip(self._input_names, args):
-                self._inputs[n].copy_from_cpu(a)
+            # upload under the pin so the host array lands directly on
+            # this predictor's device instead of hopping via the default
+            ctx = (jax.default_device(self._device)
+                   if self._device is not None else contextlib.nullcontext())
+            with ctx:
+                for n, a in zip(self._input_names, args):
+                    self._inputs[n].copy_from_cpu(a)
             # compute from the device-resident handle values so the upload
             # copy_from_cpu just did is the only host→device transfer
             args = [self._inputs[n]._value for n in self._input_names]
@@ -309,5 +385,8 @@ class Predictor:
         return self._outputs[idx]
 
 
-def create_predictor(config: Config) -> Predictor:
-    return Predictor(config)
+def create_predictor(config: Config, device=None) -> Predictor:
+    """Build a Predictor; `device` (jax Device or local index) pins its
+    compilation and execution to one chip — `serving.InferenceEngine`
+    passes a different device per dispatch lane."""
+    return Predictor(config, device=device)

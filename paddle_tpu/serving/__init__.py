@@ -9,14 +9,24 @@ pre-compiled bucketed shapes).
 - **micro-batcher** — concurrent `submit()` calls coalesce into one
   device batch under `max_batch_size` / `max_batch_delay_ms`; each call
   returns a `concurrent.futures.Future`.
+- **pipelined multi-device dispatch** — a shared collector routes
+  batches to one dispatch lane per local device (`devices=` / the
+  `FLAGS_serving_devices` default), round-robin with a least-inflight
+  tiebreak; each lane enqueues the device call asynchronously and a
+  completion stage blocks/slices/resolves, so admission, compute, and
+  readback overlap (`FLAGS_serving_max_inflight` bounds the pipeline).
 - **shape bucketing** — batches pad up to configured batch-size buckets
-  (default 1/4/16/64) so XLA compiles exactly once per bucket; results
-  are sliced back per request, bit-identical to unbatched runs.
+  (default 1/4/16/64) so XLA compiles exactly once per (device, bucket);
+  results are sliced back per request, bit-identical to unbatched runs
+  on the same lane+bucket.
 - **backpressure & robustness** — bounded queue (`EngineOverloaded`),
-  per-request deadlines (`ExecutionTimeoutError`), a worker that
-  isolates a poisoned request to its own future, `shutdown()` drains.
-- **observability** — `framework.monitor` STAT counters + a streaming
-  latency histogram (p50/p99), `profiler.RecordEvent` scopes.
+  per-request deadlines enforced both while queued AND at completion
+  (`ExecutionTimeoutError`), poison isolation per lane, a dead lane
+  fails only its own in-flight work and leaves rotation, `shutdown()`
+  drains.
+- **observability** — `framework.monitor` STAT counters (global +
+  per-lane `STAT_serving_lane*`) + streaming latency and in-flight-depth
+  histograms, `profiler.RecordEvent` scopes.
 """
 from __future__ import annotations
 

@@ -1,16 +1,27 @@
-"""Dynamic micro-batching inference engine.
+"""Pipelined multi-device micro-batching inference engine.
 
-Design (the TPU serving hot loop, mirroring what PR 1 did for training):
-submitters only validate + enqueue numpy; ONE worker thread owns all
-device dispatch, coalescing queued requests into a batch, padding it up
-to a pre-compiled bucket shape, and slicing results back per request.
-Because `jit.save` now exports shape-polymorphic StableHLO (symbolic
-batch dim), a single saved artifact serves every bucket and XLA compiles
-exactly once per bucket — the compile count is observable through
-`STAT_predictor_compiles` / `STAT_serving_bucket_compiles`.
+Design (the TPU serving hot loop, Orca-style iteration overlap):
+submitters only validate + enqueue numpy; ONE shared **collector**
+thread owns batching — it coalesces queued requests into a batch, then
+routes it to one of N per-device **dispatch lanes** (round-robin with a
+least-inflight tiebreak). Each lane is a Predictor replica pinned to one
+local device plus two threads: a *dispatcher* that pads the batch up to
+a pre-compiled bucket shape and enqueues the device call (JAX async
+dispatch — no host sync), and a *completer* that blocks on the results,
+slices them back per request, and resolves futures. Because dispatch and
+completion are decoupled, lane K admits batch N+1 while batch N is still
+computing, and with multiple lanes every local chip serves traffic
+concurrently. In-flight batches per lane are bounded by
+`FLAGS_serving_max_inflight`, so backpressure still reaches
+`EngineOverloaded` at the front door instead of piling work on the
+device queue. `jit.save` exports shape-polymorphic StableHLO (symbolic
+batch dim), so a single saved artifact serves every (device, bucket)
+pair and XLA compiles exactly once per pair — observable through the
+per-replica `Predictor.compile_count` / `STAT_predictor_compiles`.
 """
 from __future__ import annotations
 
+import queue
 import threading
 import time
 from collections import deque
@@ -41,6 +52,7 @@ class EngineConfig:
                  batch_buckets: Optional[Sequence[int]] = None,
                  max_queue_depth: Optional[int] = None,
                  request_timeout_ms: Optional[float] = None,
+                 max_inflight: Optional[int] = None,
                  warmup: bool = True):
         self.max_batch_size = int(
             flag("FLAGS_serving_max_batch_size")
@@ -73,6 +85,11 @@ class EngineConfig:
         self.request_timeout_ms = float(
             flag("FLAGS_serving_request_timeout_ms")
             if request_timeout_ms is None else request_timeout_ms)
+        self.max_inflight = int(
+            flag("FLAGS_serving_max_inflight")
+            if max_inflight is None else max_inflight)
+        if self.max_inflight < 1:
+            raise InvalidArgumentError("max_inflight must be >= 1")
         self.warmup = bool(warmup)
 
 
@@ -87,20 +104,379 @@ class _Request:
         self.t_enqueue_ms = t_enqueue_ms
 
 
+class _Lane:
+    """One per-device dispatch lane: a Predictor replica (or callable)
+    plus a dispatcher thread (pads + enqueues the device call, no host
+    sync) and a completer thread (blocks on results, slices, resolves
+    futures). A lane that dies — a BaseException escaping either thread —
+    fails only its OWN in-flight work and is taken out of rotation; the
+    other lanes keep serving.
+    """
+
+    def __init__(self, engine: "InferenceEngine", index: int, runner,
+                 predictor, device):
+        self.engine = engine
+        self.index = index
+        self.runner = runner
+        self.predictor = predictor
+        self.device = device
+        self.alive = True
+        self.death_cause: Optional[BaseException] = None
+        self.inflight = 0           # routed batches not yet resolved (engine._cv)
+        self.batches = 0            # completed device batches (engine._stats_lock)
+        self.rows = 0
+        self.bucket_compiles = {}   # bucket -> compiles on THIS replica
+        self.inbox: "queue.Queue" = queue.Queue()    # collector -> dispatcher
+        self.pending: "queue.Queue" = queue.Queue()  # dispatcher -> completer
+        # serializes runner calls + compile accounting: the completer's
+        # poison/unsliceable reruns share this replica with the
+        # dispatcher, and overlapping compile_count windows would
+        # double-count a trace (it also keeps a single-lane callable
+        # single-threaded, as the engine docstring promises). Held only
+        # across dispatch — never the host sync — so pipelining survives.
+        self._run_lock = threading.Lock()
+        self._dispatcher = threading.Thread(
+            target=self._dispatch_loop, daemon=True,
+            name=f"{engine.name}-lane{index}-dispatch")
+        self._completer = threading.Thread(
+            target=self._complete_loop, daemon=True,
+            name=f"{engine.name}-lane{index}-complete")
+
+    def start(self):
+        self._dispatcher.start()
+        self._completer.start()
+
+    def join(self, deadline):
+        """deadline: time.monotonic() instant (None = wait forever)."""
+        for t in (self._dispatcher, self._completer):
+            t.join(None if deadline is None
+                   else max(0.0, deadline - time.monotonic()))
+
+    # -- execution ---------------------------------------------------------
+
+    def _execute_async(self, arrays, rows: int, bucket: int):
+        """Pad to the bucket and enqueue the device call; returns
+        device-resident output leaves WITHOUT a host sync (the completer
+        blocks on them). Compile accounting is exact per replica: jit
+        traces are synchronous even under async dispatch."""
+        if rows < bucket:
+            arrays = [np.concatenate(
+                [a, np.zeros((bucket - rows,) + a.shape[1:], a.dtype)])
+                for a in arrays]
+        with self._run_lock:
+            c0 = (self.predictor.compile_count
+                  if self.predictor is not None else None)
+            with RecordEvent(
+                    f"serving::lane{self.index}::dispatch[b={bucket}]"):
+                if self.device is not None and self.predictor is None:
+                    # jax-backed callable lanes honor the lane device too;
+                    # predictor replicas pin themselves (Predictor._device)
+                    import jax
+                    with jax.default_device(self.device):
+                        out = self.runner(list(arrays))
+                else:
+                    out = self.runner(list(arrays))
+            import jax
+            leaves = jax.tree_util.tree_leaves(out)
+            d = (self.predictor.compile_count - c0
+                 if c0 is not None else None)
+        eng = self.engine
+        with eng._stats_lock:
+            # setdefault: unsliceable models run ad-hoc exact-size "buckets"
+            st = eng._bucket_stats.setdefault(
+                bucket, {"compiles": 0, "batches": 0, "rows": 0})
+            lane_c = self.bucket_compiles.setdefault(bucket, 0)
+            if d is None:
+                # callable-backed runner: no trace counter, mark the first
+                # dispatch of each (lane, bucket); predictor lanes got the
+                # exact per-replica trace delta under the run lock above
+                d = 1 if lane_c == 0 else 0
+            if d:
+                self.bucket_compiles[bucket] = lane_c + d
+                st["compiles"] += d
+        if d:
+            monitor.stat_add("STAT_serving_bucket_compiles", d)
+        return leaves
+
+    def _units_for(self, batch: List[_Request]):
+        """Dispatch a claimed batch; returns completion units
+        (reqs, rows, bucket, leaves, err). A dispatch-time failure of a
+        multi-request batch is retried per request so the error lands
+        only on the offending future (poison isolation, per lane)."""
+        eng = self.engine
+        if eng._unsliceable and len(batch) > 1:
+            return [u for req in batch for u in self._units_for([req])]
+        rows = sum(r.rows for r in batch)
+        # an unsliceable model's outputs may aggregate over batch rows, so
+        # zero padding would contaminate them — run exact-size (one
+        # compile per observed size is the price of such models)
+        bucket = rows if eng._unsliceable else eng._bucket_for(rows)
+        nin = len(batch[0].arrays)
+        try:
+            # concat inside the try: on a spec-less engine, requests with
+            # inconsistent trailing dims must poison only themselves, not
+            # kill the lane
+            concat = [batch[0].arrays[i] if len(batch) == 1 else
+                      np.concatenate([r.arrays[i] for r in batch])
+                      for i in range(nin)]
+            leaves = self._execute_async(concat, rows, bucket)
+            return [(batch, rows, bucket, leaves, None)]
+        except Exception as e:  # noqa: BLE001
+            if len(batch) == 1:
+                return [(batch, rows, bucket, None, e)]
+            monitor.stat_add("STAT_serving_batch_retries")
+            return [u for req in batch for u in self._units_for([req])]
+
+    def warm(self, shapes):
+        """Compile every bucket on THIS lane's device, blocking on each."""
+        for b in self.engine._cfg.batch_buckets:
+            arrays = [np.zeros((b,) + rest, dtype) for rest, dtype in shapes]
+            for leaf in self._execute_async(arrays, b, b):
+                np.asarray(leaf)
+
+    # -- dispatcher --------------------------------------------------------
+
+    def _dispatch_loop(self):
+        batch = None
+        try:
+            while True:
+                batch = self.inbox.get()
+                if batch is None:
+                    self.pending.put(None)
+                    return
+                if not self.alive:  # completer died while we were idle
+                    self._fail_reqs(batch, self.death_cause)
+                    self._dec_inflight(1)
+                    batch = None
+                    continue
+                self.pending.put(self._units_for(batch))
+                if not self.alive:
+                    # completer died racing the put above: it may have
+                    # drained `pending` already, so drain again ourselves
+                    # — one side is guaranteed to see the entry
+                    dropped = self._drain_pending()
+                    if dropped:
+                        self._dec_inflight(dropped)
+                batch = None
+        except BaseException as e:  # noqa: BLE001 — lane death, not engine death
+            self._die(e, batch)
+            self.pending.put(None)  # completer finishes dispatched work, exits
+            raise
+
+    # -- completer ---------------------------------------------------------
+
+    def _expired(self, req: _Request, t_ms: float) -> bool:
+        """Completion-time deadline: a request whose deadline lapsed while
+        its batch was on-device gets ExecutionTimeoutError, not a late
+        result the caller already gave up on."""
+        if req.deadline_ms is None or t_ms <= req.deadline_ms:
+            return False
+        monitor.stat_add("STAT_serving_timeouts")
+        try:
+            req.future.set_exception(ExecutionTimeoutError(
+                f"{self.engine.name}: request expired after "
+                f"{t_ms - req.t_enqueue_ms:.1f}ms (deadline passed while "
+                f"the batch was in flight)"))
+        except Exception:  # racing caller-side cancel
+            pass
+        return True
+
+    def _complete_unit(self, reqs, rows, bucket, leaves, err):
+        eng = self.engine
+        outs = None
+        if err is None:
+            try:
+                with RecordEvent(
+                        f"serving::lane{self.index}::complete[b={bucket}]"):
+                    # THE host sync: under async dispatch a device-side
+                    # failure (nan trap, OOM) surfaces here, not at dispatch
+                    outs = [np.asarray(leaf) for leaf in leaves]
+            except Exception as e:  # noqa: BLE001
+                err = e
+        if err is not None:
+            if len(reqs) == 1:
+                monitor.stat_add("STAT_serving_request_errors")
+                try:
+                    reqs[0].future.set_exception(err)
+                except Exception:
+                    pass
+                return
+            # poisoned batch: isolate — each request reruns alone so the
+            # error lands only on the offending future and the lane
+            # keeps serving everyone else
+            monitor.stat_add("STAT_serving_batch_retries")
+            for req in reqs:
+                if not self._expired(req, _now_ms()):
+                    for u in self._units_for([req]):
+                        self._complete_unit(*u)
+            return
+        if (not eng._unsliceable
+                and (len(reqs) > 1 or rows < bucket)
+                and any(getattr(o, "ndim", 0) < 1 or o.shape[0] != bucket
+                        for o in outs)):
+            # an output without the batch dim leading can't be sliced back
+            # per request, and if the batch was padded it may even be
+            # computed over the padding rows — never deliver co-mingled or
+            # padding-contaminated data; rerun each request alone and
+            # UNPADDED (the _unsliceable verdict makes the reruns use
+            # bucket == rows), and remember the verdict so future batches
+            # skip the wasted bucketed execution
+            eng._unsliceable = True
+            monitor.stat_add("STAT_serving_unsliceable_batches")
+            for req in reqs:
+                if not self._expired(req, _now_ms()):
+                    for u in self._units_for([req]):
+                        self._complete_unit(*u)
+            return
+        with eng._stats_lock:
+            st = eng._bucket_stats[bucket]
+            st["batches"] += 1
+            st["rows"] += rows
+            self.batches += 1
+            self.rows += rows
+        monitor.stat_add("STAT_serving_batches")
+        monitor.stat_add("STAT_serving_batch_rows", rows)
+        monitor.stat_add("STAT_serving_batch_slots", bucket)
+        monitor.stat_add(f"STAT_serving_lane{self.index}_batches")
+        monitor.stat_add(f"STAT_serving_lane{self.index}_rows", rows)
+        t_done = _now_ms()
+        off = 0
+        for req in reqs:
+            # multi-request batches are guaranteed batch-major by the guard
+            # above; for a lone request, a non-batch-major output (e.g. a
+            # per-batch aggregate) is its own result and passes through whole
+            res = [o[off:off + req.rows]
+                   if (getattr(o, "ndim", 0) >= 1 and o.shape[0] == bucket)
+                   else o for o in outs]
+            off += req.rows
+            eng._hist.observe(t_done - req.t_enqueue_ms)
+            if self._expired(req, t_done):
+                continue
+            try:
+                req.future.set_result(res)
+            except Exception:  # racing caller-side cancel
+                pass
+
+    def _complete_loop(self):
+        units = None
+        try:
+            while True:
+                units = self.pending.get()
+                if units is None:
+                    return
+                for u in units:
+                    self._complete_unit(*u)
+                units = None
+                self._dec_inflight(1)
+        except BaseException as e:  # noqa: BLE001
+            self._die(e, None,
+                      current_reqs=[r for u in (units or []) for r in u[0]])
+            raise
+
+    # -- death / accounting ------------------------------------------------
+
+    def _dec_inflight(self, n: int):
+        eng = self.engine
+        with eng._cv:
+            self.inflight -= n
+            eng._cv.notify_all()  # collector may be waiting for capacity
+
+    def _fail_reqs(self, reqs, exc):
+        err = UnavailableError(
+            f"{self.engine.name} lane{self.index} "
+            f"(device={self.device}): died: {exc!r}")
+        for req in reqs:
+            try:
+                req.future.set_exception(err)
+            except Exception:
+                pass
+
+    def _drain_pending(self) -> int:
+        """Fail every dispatched-but-uncompleted unit; returns how many
+        routed batches were dropped (for in-flight accounting)."""
+        dropped = 0
+        while True:
+            try:
+                units = self.pending.get_nowait()
+            except queue.Empty:
+                return dropped
+            if units is None:
+                continue
+            dropped += 1
+            for u in units:
+                self._fail_reqs(u[0], self.death_cause)
+
+    def _die(self, exc: BaseException, current_batch,
+             current_reqs: Optional[list] = None):
+        """Take this lane out of rotation and fail ONLY its own in-flight
+        work: the current batch/units, everything routed to its inbox,
+        and (on completer death) everything awaiting completion."""
+        eng = self.engine
+        stranded_batches = []
+        saw_sentinel = False
+        with eng._cv:
+            first = self.alive
+            self.alive = False
+            if self.death_cause is None:
+                self.death_cause = exc
+            while True:  # puts happen under _cv, so this drain is consistent
+                try:
+                    item = self.inbox.get_nowait()
+                except queue.Empty:
+                    break
+                if item is None:
+                    saw_sentinel = True  # shutdown's drain sentinel
+                else:
+                    stranded_batches.append(item)
+            if saw_sentinel:
+                # restore it — a completer-side death must not strand the
+                # dispatcher in inbox.get() forever and hang shutdown()
+                self.inbox.put(None)
+            eng._cv.notify_all()
+        if first:
+            monitor.stat_add("STAT_serving_lane_deaths")
+            monitor.stat_add(f"STAT_serving_lane{self.index}_deaths")
+        dropped = 0
+        if current_batch is not None:
+            self._fail_reqs(current_batch, exc)
+            dropped += 1
+        if current_reqs:
+            self._fail_reqs(current_reqs, exc)
+            dropped += 1
+        for b in stranded_batches:
+            self._fail_reqs(b, exc)
+            dropped += 1
+        if current_reqs is not None:
+            # completer is the dying thread: nobody will consume `pending`
+            dropped += self._drain_pending()
+        if dropped:
+            self._dec_inflight(dropped)
+
+
 class InferenceEngine:
-    """Thread-safe batched serving front-end over a saved artifact.
+    """Thread-safe batched serving front-end over a saved artifact,
+    pipelined across every local device.
 
     `model` may be an artifact path prefix (as written by `jit.save` /
     `static.save_inference_model`), an `inference.Config`, an existing
-    `inference.Predictor`, or any callable `fn(list_of_batched_arrays) ->
-    outputs` (the test/bench seam). `submit()` returns a
-    `concurrent.futures.Future` resolving to the per-request output list.
+    `inference.Predictor`, any callable `fn(list_of_batched_arrays) ->
+    outputs`, or a list of such callables (one dispatch lane each — the
+    test/bench seam). `submit()` returns a `concurrent.futures.Future`
+    resolving to the per-request output list.
+
+    `devices` picks the dispatch lanes: None defaults from
+    `FLAGS_serving_devices` — for a path/Config model the default is
+    EVERY local device (one Predictor replica per chip); a user-built
+    Predictor or callable stays single-lane unless `devices` says
+    otherwise. Accepts 'all', an int count, or a list of local device
+    indices / jax Devices. A callable model with multi-lane `devices`
+    must be thread-safe — lanes dispatch concurrently.
 
     Observability is process-global (the same contract as every other
     STAT counter): multiple engines share the STAT_serving_* counters,
-    and the latency histogram is registered as "<name>_request_ms" — give
-    each engine a unique `name` when per-engine latency attribution
-    matters.
+    and the latency/in-flight histograms are registered as
+    "<name>_request_ms" / "<name>_inflight_depth" — give each engine a
+    unique `name` when per-engine attribution matters.
 
     Model contract (the requirement of every dynamic batcher, cf. TF
     Serving's batching): output row i must depend only on input row i.
@@ -111,10 +487,18 @@ class InferenceEngine:
     dim — and falls back to unpadded per-request execution, but
     row-mixing inside a batch-major output is semantically invisible and
     stays the caller's responsibility.
+
+    Numerics: results are bit-identical within one (device, bucket) —
+    padding and co-riders never change a request's rows — but different
+    buckets, and different lanes, are different compiled executables
+    whose float reductions may be ordered differently. Callers that need
+    bit-stable replies across repeats must pin a single device and
+    bucket.
     """
 
     def __init__(self, model, config: Optional[EngineConfig] = None,
-                 input_spec=None, name: str = "serving", **overrides):
+                 input_spec=None, name: str = "serving", devices=None,
+                 **overrides):
         if config is None:
             config = EngineConfig(**overrides)
         elif overrides:
@@ -123,57 +507,105 @@ class InferenceEngine:
         import copy
         self._cfg = copy.copy(config)  # never mutate a shared caller config
         self.name = name
-        self._build_runner(model, input_spec)
+        self._stats_lock = threading.Lock()
+        self._queue = deque()
+        self._cv = threading.Condition()
+        self._closed = False
+        self._rr = 0
+        # set once a multi-request batch proves the model's outputs can't
+        # be sliced per request; later batches then skip the wasted
+        # batched execution and go straight to per-request dispatch
+        self._unsliceable = False
+        self._build_lanes(model, input_spec, devices)
         # a fixed-batch artifact (pre-polymorphism save) admits exactly one
         # device shape: collapse bucketing to it rather than failing later
         fixed = self._fixed_batch()
         if fixed is not None:
             self._cfg.max_batch_size = fixed
             self._cfg.batch_buckets = (fixed,)
-        self._queue = deque()
-        self._cv = threading.Condition()
-        self._closed = False
         self._bucket_stats = {b: {"compiles": 0, "batches": 0, "rows": 0}
                               for b in self._cfg.batch_buckets}
         self._hist = monitor.histogram(f"{name}_request_ms")
+        self._inflight_hist = monitor.histogram(f"{name}_inflight_depth")
         if self._cfg.warmup:
             self._warmup()
-        self._worker = threading.Thread(target=self._worker_loop,
-                                        name=f"{name}-batcher", daemon=True)
-        self._worker.start()
+        for lane in self._lanes:
+            lane.start()
+        self._collector = threading.Thread(target=self._collector_loop,
+                                           name=f"{name}-collector",
+                                           daemon=True)
+        self._collector.start()
 
-    # -- model plumbing ----------------------------------------------------
+    # -- model / lane plumbing ---------------------------------------------
 
-    def _build_runner(self, model, input_spec):
+    def _build_lanes(self, model, input_spec, devices):
         from .. import inference
+        if isinstance(model, (list, tuple)) and model and all(
+                callable(m) and not isinstance(m, (str, inference.Config,
+                                                   inference.Predictor))
+                for m in model):
+            # one lane per callable — the deterministic failover seam
+            if devices is not None:
+                raise InvalidArgumentError(
+                    "a list-of-callables model already fixes the lane "
+                    "count; don't pass devices too")
+            self._signature = self._spec_signature(input_spec)
+            self._set_expect()
+            self._lanes = [_Lane(self, i, m, None, None)
+                           for i, m in enumerate(model)]
+            return
         predictor = None
         if isinstance(model, str):
-            predictor = inference.create_predictor(inference.Config(model))
-        elif isinstance(model, inference.Config):
-            predictor = inference.create_predictor(model)
+            model = inference.Config(model)
+        if isinstance(model, inference.Config):
+            cfg_model = model
         elif isinstance(model, inference.Predictor):
             predictor = model
+            cfg_model = None
         elif callable(model):
-            predictor = None
+            cfg_model = None
         else:
             raise InvalidArgumentError(
                 f"InferenceEngine: model must be a path, inference.Config, "
-                f"Predictor, or callable, got {type(model).__name__}")
-        self._predictor = predictor
+                f"Predictor, callable(s), got {type(model).__name__}")
+        if devices is None:
+            # the flag is a fleet-wide default for ARTIFACT engines only:
+            # a user-built Predictor or callable stays single-lane unless
+            # the caller passes devices= explicitly (replicating it behind
+            # the caller's back would be a surprise, and a callable may
+            # not be thread-safe)
+            if cfg_model is not None:
+                devices = str(flag("FLAGS_serving_devices")).strip() or "all"
+        devs = (inference.resolve_devices(devices)
+                if devices is not None else [None])
+        if cfg_model is not None:
+            predictor = inference.create_predictor(cfg_model,
+                                                   device=devs[0])
+            lane0 = predictor
+        elif predictor is not None and devs[0] is not None:
+            # same policy as the config copy above: never mutate the
+            # caller's Predictor — pin a clone, leave theirs untouched
+            lane0 = predictor.clone_for_device(devs[0])
+        else:
+            lane0 = predictor
         if predictor is not None:
             self._signature = predictor.input_signature()
-            self._runner = predictor.run_device
+            replicas = [lane0] + [predictor.clone_for_device(d)
+                                  for d in devs[1:]]
+            self._set_expect()
+            self._lanes = [_Lane(self, i, p.run_device, p, p.device)
+                           for i, p in enumerate(replicas)]
         else:
             self._signature = self._spec_signature(input_spec)
-            self._runner = model
+            self._set_expect()
+            self._lanes = [_Lane(self, i, model, None, d)
+                           for i, d in enumerate(devs)]
+
+    def _set_expect(self):
         from ..inference import format_input_sig
         self._expect = (", ".join(format_input_sig(*s)
                                   for s in self._signature)
                         if self._signature else "")
-        # set once a multi-request batch proves the model's outputs can't
-        # be sliced per request; later batches then skip the wasted
-        # batched execution and go straight to per-request dispatch
-        self._unsliceable = False
 
     @staticmethod
     def _spec_signature(input_spec):
@@ -269,7 +701,7 @@ class InferenceEngine:
                         f"raise FLAGS_serving_max_queue_depth")
                 self._queue.append(req)
                 monitor.stat_add("STAT_serving_queue_depth")
-                self._cv.notify()
+                self._cv.notify_all()
             monitor.stat_add("STAT_serving_requests")
             return req.future
 
@@ -277,7 +709,7 @@ class InferenceEngine:
         """Synchronous submit: blocks for this request's result."""
         return self.submit(inputs, timeout_ms=timeout_ms).result()
 
-    # -- worker ------------------------------------------------------------
+    # -- collector ---------------------------------------------------------
 
     def _peek_live(self) -> Optional[_Request]:
         """Drop expired/cancelled requests from the queue head and return
@@ -350,34 +782,82 @@ class InferenceEngine:
                         break
             return batch
 
-    def _worker_loop(self):
+    def _wait_capacity(self) -> bool:
+        """Block until some alive lane has a free in-flight slot — BEFORE
+        claiming requests from the queue, so backpressure stays at the
+        front door (submit sees true depth → EngineOverloaded) instead of
+        leaking into lane inboxes. False = every lane is dead."""
+        with self._cv:
+            while True:
+                alive = [l for l in self._lanes if l.alive]
+                if not alive:
+                    return False
+                if any(l.inflight < self._cfg.max_inflight for l in alive):
+                    return True
+                self._cv.wait()
+
+    def _route(self, batch: List[_Request]) -> None:
+        """Hand a claimed batch to the best lane: least in-flight, ties
+        broken round-robin so equal lanes share warm-cache traffic."""
+        with self._cv:
+            while True:
+                alive = [l for l in self._lanes if l.alive]
+                if not alive:
+                    raise UnavailableError(
+                        f"{self.name}: all {len(self._lanes)} dispatch "
+                        f"lanes dead")
+                ready = [l for l in alive
+                         if l.inflight < self._cfg.max_inflight]
+                if ready:
+                    n = len(self._lanes)
+                    lane = min(ready, key=lambda l: (
+                        l.inflight, (l.index - self._rr) % n))
+                    lane.inflight += 1
+                    self._rr = (lane.index + 1) % n
+                    self._inflight_hist.observe(lane.inflight)
+                    # put under _cv: lane death drains its inbox under the
+                    # same lock, so a batch can never land in a dead inbox
+                    lane.inbox.put(batch)
+                    return
+                self._cv.wait()
+
+    def _collector_loop(self):
         batch = None
         try:
             while True:
+                if not self._wait_capacity():
+                    raise UnavailableError(
+                        f"{self.name}: all {len(self._lanes)} dispatch "
+                        f"lanes dead")
                 batch = self._collect()
                 if batch is None:
-                    return
+                    return  # closed + drained
                 if batch:
-                    self._dispatch(batch)
+                    self._route(batch)
                 batch = None
         except BaseException as e:  # noqa: BLE001 — never hang submitters
-            # fail BOTH the already-claimed in-flight batch and everything
-            # still queued, or their submitters block on result() forever
+            # fail BOTH the already-claimed batch and everything still
+            # queued, or their submitters block on result() forever
             stranded = list(batch or [])
             with self._cv:
                 self._closed = True
                 while self._queue:
                     stranded.append(self._queue.popleft())
                     monitor.stat_sub("STAT_serving_queue_depth")
+                self._cv.notify_all()
             for req in stranded:
                 try:
                     req.future.set_exception(UnavailableError(
-                        f"{self.name}: worker died: {e!r}"))
+                        f"{self.name}: collector died: {e!r}"))
                 except Exception:
                     pass
-            raise
+            if not isinstance(e, UnavailableError):
+                raise
+        finally:
+            for lane in self._lanes:
+                lane.inbox.put(None)  # drain sentinel: lanes finish + exit
 
-    # -- execution ---------------------------------------------------------
+    # -- execution helpers -------------------------------------------------
 
     def _bucket_for(self, rows: int) -> int:
         for b in self._cfg.batch_buckets:
@@ -385,108 +865,10 @@ class InferenceEngine:
                 return b
         return self._cfg.batch_buckets[-1]
 
-    def _execute(self, arrays, rows: int, bucket: int) -> List[np.ndarray]:
-        """Pad to the bucket, run the model once, host-sync once."""
-        if rows < bucket:
-            arrays = [np.concatenate(
-                [a, np.zeros((bucket - rows,) + a.shape[1:], a.dtype)])
-                for a in arrays]
-        c0 = (self._predictor.compile_count
-              if self._predictor is not None else None)
-        with RecordEvent(f"serving::batch[b={bucket}]"):
-            out = self._runner(list(arrays))
-        # setdefault: unsliceable models run ad-hoc exact-size "buckets"
-        st = self._bucket_stats.setdefault(
-            bucket, {"compiles": 0, "batches": 0, "rows": 0})
-        if c0 is not None:
-            # exact: the predictor counts jit traces; this engine's single
-            # worker (plus init-time warmup) is the only dispatcher
-            d = self._predictor.compile_count - c0
-        else:
-            # callable-backed runner: no trace counter, mark first dispatch
-            d = 1 if st["compiles"] == 0 else 0
-        if d:
-            st["compiles"] += d
-            monitor.stat_add("STAT_serving_bucket_compiles", d)
-        import jax
-        leaves = jax.tree_util.tree_leaves(out)
-        return [np.asarray(leaf) for leaf in leaves]
-
-    def _dispatch(self, batch: List[_Request]):
-        if self._unsliceable and len(batch) > 1:
-            for req in batch:
-                self._dispatch([req])
-            return
-        rows = sum(r.rows for r in batch)
-        # an unsliceable model's outputs may aggregate over batch rows, so
-        # zero padding would contaminate them — run exact-size (one
-        # compile per observed size is the price of such models)
-        bucket = rows if self._unsliceable else self._bucket_for(rows)
-        nin = len(batch[0].arrays)
-        try:
-            # concat inside the try: on a spec-less engine, requests with
-            # inconsistent trailing dims must poison only themselves, not
-            # kill the worker
-            concat = [batch[0].arrays[i] if len(batch) == 1 else
-                      np.concatenate([r.arrays[i] for r in batch])
-                      for i in range(nin)]
-            outs = self._execute(concat, rows, bucket)
-        except Exception as e:  # noqa: BLE001
-            if len(batch) == 1:
-                monitor.stat_add("STAT_serving_request_errors")
-                try:
-                    batch[0].future.set_exception(e)
-                except Exception:
-                    pass
-                return
-            # poisoned batch: isolate — each request reruns alone so the
-            # error lands only on the offending future and the engine
-            # keeps serving everyone else
-            monitor.stat_add("STAT_serving_batch_retries")
-            for req in batch:
-                self._dispatch([req])
-            return
-        if (not self._unsliceable
-                and (len(batch) > 1 or rows < bucket)
-                and any(getattr(o, "ndim", 0) < 1 or o.shape[0] != bucket
-                        for o in outs)):
-            # an output without the batch dim leading can't be sliced back
-            # per request, and if the batch was padded it may even be
-            # computed over the padding rows — never deliver co-mingled or
-            # padding-contaminated data; rerun each request alone and
-            # UNPADDED (the _unsliceable verdict makes the recursive calls
-            # use bucket == rows), and remember the verdict so future
-            # batches skip the wasted bucketed execution
-            self._unsliceable = True
-            monitor.stat_add("STAT_serving_unsliceable_batches")
-            for req in batch:
-                self._dispatch([req])
-            return
-        st = self._bucket_stats[bucket]
-        st["batches"] += 1
-        st["rows"] += rows
-        monitor.stat_add("STAT_serving_batches")
-        monitor.stat_add("STAT_serving_batch_rows", rows)
-        monitor.stat_add("STAT_serving_batch_slots", bucket)
-        t_done = _now_ms()
-        off = 0
-        for req in batch:
-            # multi-request batches are guaranteed batch-major by the guard
-            # above; for a lone request, a non-batch-major output (e.g. a
-            # per-batch aggregate) is its own result and passes through whole
-            res = [o[off:off + req.rows]
-                   if (getattr(o, "ndim", 0) >= 1 and o.shape[0] == bucket)
-                   else o for o in outs]
-            off += req.rows
-            self._hist.observe(t_done - req.t_enqueue_ms)
-            try:
-                req.future.set_result(res)
-            except Exception:  # racing caller-side cancel
-                pass
-
     def _warmup(self):
-        """Compile every bucket up front so no live request pays a compile.
-        Needs concrete trailing dims; silently skipped otherwise."""
+        """Compile every (device, bucket) pair up front so no live request
+        pays a compile on any lane. Needs concrete trailing dims; silently
+        skipped otherwise."""
         if not self._signature:
             return
         shapes = []
@@ -495,32 +877,65 @@ class InferenceEngine:
                 return
             shapes.append((tuple(dims[1:]), dtype or np.dtype("float32")))
         with RecordEvent("serving::warmup"):
-            for b in self._cfg.batch_buckets:
-                arrays = [np.zeros((b,) + rest, dtype)
-                          for rest, dtype in shapes]
-                self._execute(arrays, b, b)
+            if len(self._lanes) == 1:
+                self._lanes[0].warm(shapes)
+                return
+            # lanes are independent replicas (own jit wrapper + run lock):
+            # warm them concurrently or constructor latency scales with
+            # the device count (N lanes x buckets sequential compiles)
+            errs = []
+
+            def _warm(lane):
+                try:
+                    lane.warm(shapes)
+                except BaseException as e:  # noqa: BLE001
+                    errs.append(e)
+
+            threads = [threading.Thread(target=_warm, args=(lane,),
+                                        name=f"{self.name}-warm{lane.index}")
+                       for lane in self._lanes]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join()
+            if errs:
+                raise errs[0]
 
     # -- lifecycle / introspection -----------------------------------------
 
     def stats(self) -> dict:
         """Engine-local snapshot: per-bucket compile/batch/occupancy, live
-        queue depth, and the latency histogram percentiles."""
+        queue depth, per-lane state, and latency/in-flight histograms."""
         with self._cv:
             depth = len(self._queue)
-        slots = sum(b * s["batches"]
-                    for b, s in self._bucket_stats.items())
-        served = sum(s["rows"] for s in self._bucket_stats.values())
+            lanes = [{"index": l.index,
+                      "device": str(l.device) if l.device is not None
+                      else None,
+                      "alive": l.alive,
+                      "inflight": l.inflight} for l in self._lanes]
+        with self._stats_lock:
+            buckets = {b: dict(s) for b, s in self._bucket_stats.items()}
+            for snap, l in zip(lanes, self._lanes):
+                snap["batches"] = l.batches
+                snap["rows"] = l.rows
+                snap["bucket_compiles"] = dict(l.bucket_compiles)
+        slots = sum(b * s["batches"] for b, s in buckets.items())
+        served = sum(s["rows"] for s in buckets.values())
         return {
-            "buckets": {b: dict(s) for b, s in self._bucket_stats.items()},
+            "buckets": buckets,
+            "lanes": lanes,
             "queue_depth": depth,
             "rows_served": served,
             "mean_occupancy": round(served / slots, 4) if slots else 0.0,
             "latency_ms": self._hist.snapshot(),
+            "inflight_depth": self._inflight_hist.snapshot(),
         }
 
     def shutdown(self, drain: bool = True, timeout_s: Optional[float] = None):
-        """Stop intake; by default the worker drains every queued request
-        before exiting. With drain=False pending futures fail fast."""
+        """Stop intake; by default the collector routes every queued
+        request and the lanes finish them before exiting. With
+        drain=False pending futures fail fast (in-flight device batches
+        still complete)."""
         with self._cv:
             self._closed = True
             if not drain:
@@ -533,7 +948,14 @@ class InferenceEngine:
                     except Exception:
                         pass
             self._cv.notify_all()
-        self._worker.join(timeout_s)
+        # one deadline for the WHOLE shutdown: timeout_s bounds the caller's
+        # wait, not each of the 1 + 2*lanes joins separately
+        deadline = (None if timeout_s is None
+                    else time.monotonic() + timeout_s)
+        self._collector.join(None if deadline is None
+                             else max(0.0, deadline - time.monotonic()))
+        for lane in self._lanes:
+            lane.join(deadline)
 
     def __enter__(self):
         return self

@@ -241,6 +241,10 @@ class _Gate:
 
 def _gated_engine(**kw):
     gate = _Gate()
+    # max_inflight=1 disables dispatch pipelining so exactly ONE request
+    # is past the queue while the gate is held — keeps the queue-depth
+    # arithmetic below deterministic
+    kw.setdefault("max_inflight", 1)
     eng = serving.InferenceEngine(
         gate, input_spec=[([None, 4], "float32")], warmup=False, **kw)
     return eng, gate

@@ -1,0 +1,448 @@
+"""Pipelined multi-device serving: shared collector + per-chip dispatch
+lanes with async completion.
+
+Runs on the 8-virtual-device CPU mesh (conftest), so every lane is a
+real jax device: replica placement, per-(device, bucket) warmup
+compiles, and lane failover exercise the same code path a multi-chip
+host uses. Numerics note: different lanes (devices) are different
+compiled executables — cross-lane results are compared with allclose,
+not bitwise (bit-identity holds only within one compiled shape/device).
+"""
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+from paddle_tpu import inference, serving
+from paddle_tpu.framework import monitor
+from paddle_tpu.framework.errors import (ExecutionTimeoutError,
+                                         UnavailableError)
+from paddle_tpu.static.input_spec import InputSpec
+
+
+class _Mlp(nn.Layer):
+    def __init__(self):
+        super().__init__()
+        self.fc1 = nn.Linear(8, 16)
+        self.fc2 = nn.Linear(16, 4)
+
+    def forward(self, x):
+        return self.fc2(paddle.tanh(self.fc1(x)))
+
+
+@pytest.fixture(scope="module")
+def artifact(tmp_path_factory):
+    paddle.seed(11)
+    prefix = str(tmp_path_factory.mktemp("serving_ml") / "mlp")
+    paddle.jit.save(_Mlp(), prefix,
+                    input_spec=[InputSpec([None, 8], "float32")])
+    return prefix
+
+
+def _x(rows, seed=0):
+    return np.random.RandomState(seed).standard_normal(
+        (rows, 8)).astype("float32")
+
+
+# ---------------------------------------------------------------------------
+# tentpole: one dispatch lane (+ Predictor replica) per local device
+# ---------------------------------------------------------------------------
+
+def test_path_model_defaults_to_all_local_devices(artifact):
+    import jax
+    n = len(jax.local_devices())
+    assert n >= 2  # conftest forces the 8-virtual-device mesh
+    # oracle predictor built + warmed BEFORE the compile snapshot so its
+    # own trace never pollutes the engine's compile ledger below
+    pred = inference.create_predictor(inference.Config(artifact))
+    pred.run([_x(1)])
+    c0 = monitor.stat_get("STAT_predictor_compiles")
+    eng = serving.InferenceEngine(artifact, batch_buckets=(1, 4),
+                                  max_batch_size=4, max_batch_delay_ms=1.0,
+                                  name="ml_default")
+    try:
+        s = eng.stats()
+        assert len(s["lanes"]) == n
+        assert len({l["device"] for l in s["lanes"]}) == n  # distinct chips
+        # warmup compiled every (device, bucket) pair exactly once — the
+        # per-replica trace counters sum into STAT_predictor_compiles
+        assert monitor.stat_get("STAT_predictor_compiles") - c0 == 2 * n
+        futs = [eng.submit(_x(1, seed=i)) for i in range(6 * n)]
+        res = [f.result(timeout=60) for f in futs]
+        # correctness on every lane: allclose vs the single-predictor
+        # oracle (different devices = different executables; bitwise
+        # identity is only guaranteed within one compiled shape/device)
+        for i, r in enumerate(res):
+            np.testing.assert_allclose(r[0], pred.run([_x(1, seed=i)])[0],
+                                       rtol=1e-5, atol=1e-6)
+        s = eng.stats()
+        assert sum(l["batches"] for l in s["lanes"]) >= 1
+        assert sum(l["rows"] for l in s["lanes"]) == 6 * n
+        # no live compiles beyond warmup, on ANY lane
+        assert monitor.stat_get("STAT_predictor_compiles") - c0 == 2 * n
+        assert all(c == 1 for l in s["lanes"]
+                   for c in l["bucket_compiles"].values())
+    finally:
+        eng.shutdown()
+
+
+def test_explicit_device_list_pins_replicas(artifact):
+    import jax
+    local = jax.local_devices()
+    eng = serving.InferenceEngine(artifact, devices=[0, 3],
+                                  batch_buckets=(1,), max_batch_size=1,
+                                  max_batch_delay_ms=0.0, name="ml_pin")
+    try:
+        s = eng.stats()
+        assert [l["device"] for l in s["lanes"]] == [str(local[0]),
+                                                     str(local[3])]
+        assert eng._lanes[0].predictor.device == local[0]
+        assert eng._lanes[1].predictor.device == local[3]
+        # replicas share the deserialized artifact but not jit state
+        assert (eng._lanes[0].predictor._translated
+                is eng._lanes[1].predictor._translated)
+        assert eng.run(_x(1))[0].shape == (1, 4)
+    finally:
+        eng.shutdown()
+
+
+def test_user_predictor_stays_single_lane(artifact):
+    # replicating a user-built Predictor implicitly would be a surprise:
+    # devices=None keeps the engine on exactly that replica
+    pred = inference.create_predictor(inference.Config(artifact))
+    eng = serving.InferenceEngine(pred, batch_buckets=(1,),
+                                  max_batch_size=1, max_batch_delay_ms=0.0)
+    try:
+        assert len(eng.stats()["lanes"]) == 1
+        assert eng._lanes[0].predictor is pred
+    finally:
+        eng.shutdown()
+
+
+def test_caller_predictor_never_mutated_by_pinning(artifact):
+    import jax
+    pred = inference.create_predictor(inference.Config(artifact))
+    assert pred.device is None
+    eng = serving.InferenceEngine(pred, devices=[1, 2], batch_buckets=(1,),
+                                  max_batch_size=1, max_batch_delay_ms=0.0)
+    try:
+        assert pred.device is None  # the engine pinned a CLONE, not ours
+        assert eng._lanes[0].predictor is not pred
+        assert eng._lanes[0].predictor.device == jax.local_devices()[1]
+        assert eng.run(_x(1))[0].shape == (1, 4)
+    finally:
+        eng.shutdown()
+
+
+def test_int_and_bad_device_specs(artifact):
+    import jax
+    n = len(jax.local_devices())
+    eng = serving.InferenceEngine(artifact, devices=2, batch_buckets=(1,),
+                                  max_batch_size=1, max_batch_delay_ms=0.0)
+    try:
+        assert len(eng.stats()["lanes"]) == 2
+    finally:
+        eng.shutdown()
+    with pytest.raises(ValueError, match="host has"):
+        serving.InferenceEngine(artifact, devices=n + 5)
+    with pytest.raises(ValueError, match="max_inflight"):
+        serving.EngineConfig(max_inflight=0)
+
+
+# ---------------------------------------------------------------------------
+# tentpole: async dispatch pipelines within a lane, bounded by max_inflight
+# ---------------------------------------------------------------------------
+
+class _Gate:
+    """Callable model that blocks inside dispatch until released; input
+    value 666 kills the lane (a BaseException, not a poisoned request)."""
+
+    class Death(BaseException):
+        pass
+
+    def __init__(self):
+        self.release = threading.Event()
+        self.entered = threading.Event()
+        self.calls = 0
+
+    def __call__(self, arrays):
+        a = np.asarray(arrays[0])
+        self.calls += 1
+        self.entered.set()
+        assert self.release.wait(30)
+        if (a == 666.0).any():
+            raise _Gate.Death("replica wedged")
+        return [a * 2.0]
+
+
+def _v(val):
+    return np.full((1, 4), float(val), "float32")
+
+
+def test_inflight_bound_pipelines_and_backpressures():
+    gate = _Gate()
+    eng = serving.InferenceEngine(
+        gate, input_spec=[([None, 4], "float32")], warmup=False,
+        max_batch_size=1, batch_buckets=(1,), max_batch_delay_ms=0.0,
+        max_inflight=2, name="ml_pipe")
+    try:
+        f1 = eng.submit(_v(1))
+        assert gate.entered.wait(10)  # batch 1 "on device"
+        f2 = eng.submit(_v(2))        # admitted: lane pipelines batch 2
+        f3 = eng.submit(_v(3))        # beyond max_inflight: stays queued
+        deadline = time.monotonic() + 5
+        while time.monotonic() < deadline:
+            s = eng.stats()
+            if s["lanes"][0]["inflight"] == 2 and s["queue_depth"] == 1:
+                break
+            time.sleep(0.005)
+        s = eng.stats()
+        assert s["lanes"][0]["inflight"] == 2  # dispatch ran ahead of completion
+        assert s["queue_depth"] == 1           # backpressure stays at the door
+        gate.release.set()
+        for f, v in ((f1, 2.0), (f2, 4.0), (f3, 6.0)):
+            np.testing.assert_array_equal(f.result(timeout=30)[0],
+                                          np.full((1, 4), v, "float32"))
+        assert eng.stats()["inflight_depth"]["max"] == 2
+    finally:
+        gate.release.set()
+        eng.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# tentpole: lane failover — a dead lane fails only its own in-flight work
+# ---------------------------------------------------------------------------
+
+def test_lane_failover_only_kills_own_inflight():
+    g0, g1 = _Gate(), _Gate()
+    eng = serving.InferenceEngine(
+        [g0, g1], input_spec=[([None, 4], "float32")], warmup=False,
+        max_batch_size=1, batch_buckets=(1,), max_batch_delay_ms=0.0,
+        max_inflight=2, name="ml_failover")
+    d0 = monitor.stat_get("STAT_serving_lane_deaths")
+    try:
+        # routing is deterministic: least-inflight with round-robin ties
+        f1 = eng.submit(_v(666))   # lane0, enters gate0
+        assert g0.entered.wait(10)
+        f2 = eng.submit(_v(2))     # lane1 (least inflight), enters gate1
+        assert g1.entered.wait(10)
+        f3 = eng.submit(_v(3))     # tie → round-robin → lane0's inbox
+        f4 = eng.submit(_v(4))     # lane0 full → lane1's inbox
+        deadline = time.monotonic() + 5
+        while (time.monotonic() < deadline
+               and eng.stats()["lanes"][0]["inflight"] < 2):
+            time.sleep(0.005)
+        g0.release.set()           # lane0 dies on the 666 request
+        with pytest.raises(UnavailableError, match="lane0.*died"):
+            f1.result(timeout=30)
+        with pytest.raises(UnavailableError, match="lane0.*died"):
+            f3.result(timeout=30)  # lane0's other in-flight batch
+        g1.release.set()           # lane1 unaffected
+        np.testing.assert_array_equal(f2.result(timeout=30)[0], _v(4))
+        np.testing.assert_array_equal(f4.result(timeout=30)[0], _v(8))
+        assert monitor.stat_get("STAT_serving_lane_deaths") == d0 + 1
+        s = eng.stats()
+        assert [l["alive"] for l in s["lanes"]] == [False, True]
+        # the engine keeps serving on the surviving lane
+        for i in range(4):
+            np.testing.assert_array_equal(eng.run(_v(5))[0], _v(10))
+        assert g1.calls >= 6
+    finally:
+        g0.release.set()
+        g1.release.set()
+        eng.shutdown()
+
+
+def test_all_lanes_dead_closes_engine():
+    gate = _Gate()
+    eng = serving.InferenceEngine(
+        gate, input_spec=[([None, 4], "float32")], warmup=False,
+        max_batch_size=1, batch_buckets=(1,), max_batch_delay_ms=0.0,
+        max_inflight=1, name="ml_alldead")
+    f1 = eng.submit(_v(666))
+    assert gate.entered.wait(10)
+    f2 = eng.submit(_v(2))  # queued behind the doomed batch
+    gate.release.set()
+    with pytest.raises(UnavailableError):
+        f1.result(timeout=30)
+    with pytest.raises(UnavailableError):
+        f2.result(timeout=30)  # collector failed the stranded queue
+    deadline = time.monotonic() + 5
+    while time.monotonic() < deadline and not eng._closed:
+        time.sleep(0.005)
+    with pytest.raises(UnavailableError, match="shut down"):
+        eng.submit(_v(1))
+    eng.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# satellite: deadlines are enforced at completion too
+# ---------------------------------------------------------------------------
+
+def test_deadline_enforced_at_completion():
+    gate = _Gate()
+    eng = serving.InferenceEngine(
+        gate, input_spec=[([None, 4], "float32")], warmup=False,
+        max_batch_size=1, batch_buckets=(1,), max_batch_delay_ms=0.0,
+        max_inflight=1, name="ml_deadline")
+    t0 = monitor.stat_get("STAT_serving_timeouts")
+    try:
+        # the request is claimed and dispatched IMMEDIATELY (capacity is
+        # free), so the queue-time deadline check never sees it; it
+        # expires while "on device" inside the gate
+        f = eng.submit(_v(1), timeout_ms=30.0)
+        assert gate.entered.wait(10)
+        time.sleep(0.08)
+        gate.release.set()
+        with pytest.raises(ExecutionTimeoutError, match="in flight"):
+            f.result(timeout=30)
+        assert monitor.stat_get("STAT_serving_timeouts") == t0 + 1
+        # an un-deadlined request on the same lane still serves
+        np.testing.assert_array_equal(eng.run(_v(3), timeout_ms=0)[0],
+                                      _v(6))
+    finally:
+        gate.release.set()
+        eng.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# satellite: shutdown-during-submit races
+# ---------------------------------------------------------------------------
+
+def test_shutdown_during_submit_race():
+    for _ in range(3):
+        eng = serving.InferenceEngine(
+            lambda arrays: [np.asarray(arrays[0]) + 1.0],
+            input_spec=[([None, 4], "float32")], warmup=False,
+            max_batch_size=8, batch_buckets=(8,), max_batch_delay_ms=0.2,
+            name="ml_race")
+        futs, lock = [], threading.Lock()
+        stop = threading.Event()
+
+        def hammer():
+            while not stop.is_set():
+                try:
+                    f = eng.submit(np.ones((1, 4), "float32"),
+                                   timeout_ms=0)
+                except (UnavailableError, serving.EngineOverloaded):
+                    return
+                with lock:
+                    futs.append(f)
+
+        threads = [threading.Thread(target=hammer, daemon=True)
+                   for _ in range(6)]
+        for t in threads:
+            t.start()
+        time.sleep(0.05)
+        eng.shutdown()  # races live submits; must drain, never hang
+        stop.set()
+        for t in threads:
+            t.join(10)
+            assert not t.is_alive()
+        assert futs
+        for f in futs:
+            # every accepted future resolves: a result (drained) — never
+            # a silent hang
+            assert f.result(timeout=10)[0].shape == (1, 4)
+        with pytest.raises(UnavailableError):
+            eng.submit(np.ones((1, 4), "float32"))
+
+
+# ---------------------------------------------------------------------------
+# satellite: monitor.reset_all_stats
+# ---------------------------------------------------------------------------
+
+def test_reset_all_stats():
+    monitor.stat_add("STAT_reset_probe", 7)
+    monitor.histogram("reset_probe_ms").observe(3.0)
+    assert monitor.stat_get("STAT_reset_probe") == 7
+    monitor.reset_all_stats()
+    assert monitor.stat_get("STAT_reset_probe") == 0
+    assert monitor.histogram("reset_probe_ms").count == 0
+    # registry still works after reset
+    monitor.stat_add("STAT_reset_probe")
+    assert monitor.stat_get("STAT_reset_probe") == 1
+
+
+# ---------------------------------------------------------------------------
+# slow: multi-lane stress (excluded from tier-1 via -m 'not slow')
+# ---------------------------------------------------------------------------
+
+@pytest.mark.slow
+def test_multilane_stress_throughput(artifact):
+    c0 = monitor.stat_get("STAT_predictor_compiles")
+    eng = serving.InferenceEngine(artifact, devices=4,
+                                  batch_buckets=(1, 4, 16),
+                                  max_batch_size=16, max_batch_delay_ms=2.0,
+                                  max_queue_depth=1024, name="ml_stress")
+    try:
+        warm = monitor.stat_get("STAT_predictor_compiles") - c0
+        assert warm == 4 * 3
+        done = []
+        lock = threading.Lock()
+
+        def client(i):
+            from collections import deque
+            out = deque()
+            for k in range(25):
+                out.append(eng.submit(_x(1 + (i + k) % 3, seed=i)))
+                if len(out) >= 2:
+                    out.popleft().result(timeout=120)
+            while out:
+                out.popleft().result(timeout=120)
+            with lock:
+                done.append(i)
+
+        threads = [threading.Thread(target=client, args=(i,), daemon=True)
+                   for i in range(32)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(300)
+        assert len(done) == 32
+        s = eng.stats()
+        assert sum(l["batches"] for l in s["lanes"]) >= 4
+        assert sum(1 for l in s["lanes"] if l["batches"] > 0) >= 2
+        # the compile ledger stays exact under stress: warmup only
+        assert monitor.stat_get("STAT_predictor_compiles") - c0 == warm
+        assert all(c == 1 for l in s["lanes"]
+                   for c in l["bucket_compiles"].values())
+    finally:
+        eng.shutdown()
+
+
+@pytest.mark.slow
+def test_shutdown_submit_storm_cycles(artifact):
+    for cycle in range(5):
+        eng = serving.InferenceEngine(artifact, devices=2,
+                                      batch_buckets=(1, 4),
+                                      max_batch_size=4,
+                                      max_batch_delay_ms=0.5,
+                                      name=f"ml_storm{cycle}")
+        futs, lock = [], threading.Lock()
+        stop = threading.Event()
+
+        def hammer(seed):
+            while not stop.is_set():
+                try:
+                    f = eng.submit(_x(1, seed=seed), timeout_ms=0)
+                except (UnavailableError, serving.EngineOverloaded):
+                    return
+                with lock:
+                    futs.append(f)
+
+        threads = [threading.Thread(target=hammer, args=(i,), daemon=True)
+                   for i in range(8)]
+        for t in threads:
+            t.start()
+        time.sleep(0.1)
+        eng.shutdown()
+        stop.set()
+        for t in threads:
+            t.join(20)
+            assert not t.is_alive()
+        for f in futs:
+            assert f.result(timeout=20)[0].shape == (1, 4)
