@@ -124,7 +124,22 @@ class Fleet:
             shard_params(model)
         return _DistributedModel(model, self)
 
-    def build_sharded_train_step(self, layer, optimizer, loss_fn):
+    def batch_placement(self):
+        """Per-leaf placement callable for io.DeviceFeeder, consistent
+        with the sharding the strategy's train step expects (batch axis 0
+        over 'dp', sequence axis over 'sp' when sequence_parallel is on).
+        None when no mesh is live."""
+        from ...parallel.spmd import batch_placement
+        if get_mesh() is None:
+            return None
+        st = self._strategy or DistributedStrategy()
+        return batch_placement(
+            get_mesh(),
+            sp_axis="sp" if getattr(st, "sequence_parallel", False)
+            else None)
+
+    def build_sharded_train_step(self, layer, optimizer, loss_fn,
+                                 donate=True):
         """The heart: strategy → one compiled SPMD step (see module doc)."""
         from ...parallel.spmd import make_sharded_train_step
         st = self._strategy or DistributedStrategy()
@@ -145,7 +160,7 @@ class Fleet:
                 begin_step=cfg.get("begin_step", 1),
                 adaptive=st.adaptive_localsgd)
         return make_sharded_train_step(
-            layer, opt, loss_fn, mesh=get_mesh(),
+            layer, opt, loss_fn, mesh=get_mesh(), donate=donate,
             zero_stage=(st.sharding_configs.get("stage", 1)
                         if st.sharding else 0),
             sp_axis="sp" if st.sequence_parallel else None,

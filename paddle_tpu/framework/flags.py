@@ -52,6 +52,17 @@ register_flag("FLAGS_train_step_donate", True,
               "train step so XLA updates parameters in place instead of "
               "allocating a second copy of the model state every step; "
               "disable for A/B numerics checks (hapi/model.py)")
+register_flag("FLAGS_train_tail_bucketing", True,
+              "Model.fit/evaluate/predict with drop_last=False: pad the "
+              "partial tail batch up to the loader's batch size (rows "
+              "replicated from the last real sample) with a row mask "
+              "folded into the loss mean, so the tail reuses the "
+              "full-batch executable instead of compiling one extra XLA "
+              "program per tail shape. Requires a row-independent forward "
+              "(the serving engine's contract; BatchNorm-style cross-row "
+              "stats will see the padded rows) and a loss that is a "
+              "mean/sum over rows (hapi/model.py falls back to the "
+              "unpadded step otherwise)")
 register_flag("FLAGS_xla_compilation_cache", True,
               "persist compiled XLA executables across processes so repeat "
               "runs skip recompiles (device/__init__.py wires this into "

@@ -33,12 +33,33 @@ Training hot-loop contract (the zero-copy / async-dispatch design):
   follow-up that lifts this.
 * Input batches are staged onto the device one step ahead by
   io.DeviceFeeder (double buffer) when the DataLoader has
-  `use_buffer_reader=True` (the default).
+  `use_buffer_reader=True` (the default). Under fleet the feeder gets the
+  mesh's batch placement (parallel.spmd.batch_placement), so each batch
+  lands directly in its dp/sp-sharded layout and the sharded step's
+  synchronous per-step device_put disappears (STAT_sharded_batch_puts
+  stays flat).
+* The fleet path keeps `_sharded_state` device-resident across fit steps
+  exactly like the single-device donated carry: `write_back` to the
+  network's Tensors runs on epoch boundaries / save / load / parameters
+  only (STAT_sharded_carry_syncs), with the same poisoned-carry
+  validation. `FLAGS_train_step_donate=0` restores per-step write-back.
+* `FLAGS_train_tail_bucketing` (default on): with `drop_last=False` the
+  last partial batch is padded up to the loader's batch size (rows
+  replicated from the last real sample) and a row mask is folded into the
+  loss mean, so the tail reuses the full-batch executable — exactly one
+  train-step compile per epoch instead of one per tail shape. The mask
+  zero-weights padded rows and divides by the real-row count; per-row
+  losses on the real rows are untouched (requires a row-independent
+  forward — the serving engine's contract — and a loss that reduces
+  rows by mean/sum; otherwise the model falls back to the unpadded step
+  once and warns). eval_batch/predict_batch share the same padding so
+  their per-exact-shape jit caches stop growing one entry per tail shape.
 
 Monitor counters (framework/monitor.py): STAT_train_steps,
 STAT_train_step_compiles (one per input-shape key), STAT_train_step_ns
 (dispatch wall time), STAT_train_host_syncs (DeferredScalar
-materializations).
+materializations), STAT_sharded_carry_syncs (fleet write-backs),
+STAT_tail_pad_batches / STAT_tail_pad_compiles_avoided (tail bucketing).
 """
 from __future__ import annotations
 
@@ -55,7 +76,7 @@ from ..framework import random as frandom
 from ..framework.deferred import DeferredScalar, materialize_many
 from ..framework.flags import flag
 from ..framework.functional import functionalize, get_buffers, get_params
-from ..framework.monitor import STAT_ADD, stat_time
+from ..framework.monitor import STAT_ADD, STAT_SUB, stat_get, stat_time
 from ..framework.tensor import Tensor
 from ..io import DataLoader, Dataset
 from ..io.device_loader import DeviceFeeder
@@ -73,6 +94,71 @@ def _flatten_batch(data):
     return [data]
 
 
+class _TailMaskError(TypeError):
+    """The prepared loss cannot expose per-row values, so a padded tail's
+    row mask cannot be folded into it (raised at trace time)."""
+
+
+def _batch_rows(leaves):
+    for x in leaves:
+        v = x._value if isinstance(x, Tensor) else x
+        if hasattr(v, "shape") and getattr(v, "ndim", 0) >= 1:
+            return int(v.shape[0])
+    return None
+
+
+def _pad_leaf(x, rows, target):
+    """Grow a batch-major leaf to `target` rows by replicating its last
+    real row (a real sample: stays in-distribution and finite, unlike
+    zeros which can be invalid labels)."""
+    v = x._value if isinstance(x, Tensor) else x
+    if not (hasattr(v, "shape") and getattr(v, "ndim", 0) >= 1
+            and v.shape[0] == rows):
+        return x
+    v = jnp.asarray(v)
+    v = jnp.concatenate([v, jnp.repeat(v[-1:], target - rows, axis=0)],
+                        axis=0)
+    return Tensor(v) if isinstance(x, Tensor) else v
+
+
+def _real_rows(mask):
+    """(padded_rows, real-row index array) for a row mask. fit's own
+    masks are ones-prefixes, but loss_mask is a public train_batch/
+    eval_batch parameter and may have holes."""
+    m = np.asarray(mask)
+    return int(m.shape[0]), np.flatnonzero(m)
+
+
+def _select_rows(leaves, padded_rows, idx):
+    """Keep only the real rows of every batch-major leaf (host-side view
+    for metrics / fallback reruns). A contiguous prefix uses a cheap
+    slice; arbitrary masks gather by index."""
+    n = len(idx)
+    prefix = bool(np.array_equal(idx, np.arange(n)))
+    out = []
+    for x in leaves:
+        v = x._value if isinstance(x, Tensor) else x
+        if hasattr(v, "shape") and getattr(v, "ndim", 0) >= 1 and \
+                v.shape[0] == padded_rows:
+            sel = v[:n] if prefix else v[idx]
+            out.append(Tensor(sel) if isinstance(x, Tensor) else sel)
+        else:
+            out.append(x)
+    return out
+
+
+def _steps_of(loader):
+    """len(loader) or None — a generator has no __len__ and a DataLoader
+    over an IterableDataset raises TypeError from its own; both mean the
+    progress display falls back to countless mode."""
+    if not hasattr(loader, "__len__"):
+        return None
+    try:
+        return len(loader)
+    except TypeError:
+        return None
+
+
 class Model:
     def __init__(self, network, inputs=None, labels=None):
         self.network = network
@@ -86,6 +172,11 @@ class Model:
         self._opt_state = None
         self._train_carry = None  # donated {params,buffers,opt_state} pytree
         self._in_fit = False  # fit() defers carry write-back to epoch ends
+        self._sharded_state = None  # fleet device-resident donated carry
+        self._sharded_dirty = False  # sharded state ahead of the Tensors
+        self._sharded_mask_live = False  # trace-time: mask rides labels[-1]
+        self._tail_maskable = True  # cleared once the loss refuses a mask
+        self._mask_cache = {}  # (mask bytes, sharded) -> placed device mask
         self._train_step_cache = {}
         self._eval_step_cache = {}
         self._pred_step_cache = {}
@@ -134,12 +225,56 @@ class Model:
             return self._loss(*outs, *labels)
         raise TypeError("loss must be callable")
 
+    def _masked_loss(self, outputs, labels, mask):
+        """User loss folded with the tail row mask: padded rows get zero
+        weight and the mean divides by the real-row count, so the scalar
+        equals the loss of the unpadded batch (for losses that reduce
+        rows by mean/sum). Losses with a `reduction` attribute are traced
+        with reduction='none' to expose per-row values; a loss that only
+        yields a scalar raises _TailMaskError at trace time and the
+        caller falls back to the unpadded step.
+
+        CAVEAT: a loss whose mean has a data-dependent denominator (e.g.
+        cross_entropy with ignore_index labels present) is reduced here
+        as a mean of per-row means, which weights rows uniformly instead
+        of by valid-element count.
+        """
+        m = mask._value if isinstance(mask, Tensor) else mask
+        red = getattr(self._loss, "reduction", None)
+        if red in ("mean", "sum"):
+            self._loss.reduction = "none"
+            try:
+                lv = self._loss_value(outputs, labels)
+            finally:
+                self._loss.reduction = red
+        else:
+            lv = self._loss_value(outputs, labels)
+        lv_raw = (lv._value if isinstance(lv, Tensor) else lv)
+        lv_raw = lv_raw.astype("float32")
+        rows = int(m.shape[0])
+        if lv_raw.ndim < 1 or lv_raw.shape[0] != rows:
+            raise _TailMaskError(
+                f"loss produced shape {tuple(getattr(lv_raw, 'shape', ()))}"
+                f" — not per-row over the {rows}-row batch, so the tail "
+                "row mask cannot be folded in; set "
+                "FLAGS_train_tail_bucketing=0 or use a loss with a "
+                "mean/sum `reduction`")
+        per_row = lv_raw.reshape((rows, -1))
+        per_row = (per_row.sum(axis=1) if red == "sum"
+                   else per_row.mean(axis=1))
+        # where, not multiply: a non-finite padded-row value must not
+        # poison the sum through NaN * 0
+        per_row = jnp.where(m > 0, per_row, jnp.zeros_like(per_row))
+        if red == "sum":
+            return jnp.sum(per_row)
+        return jnp.sum(per_row) / jnp.sum(m.astype("float32"))
+
     def _make_train_step(self):
         apply_fn = self._apply_fn
         opt = self._optimizer
         amp_level = self._amp_level
 
-        def loss_fn(pv, bv, rng, inputs, labels):
+        def loss_fn(pv, bv, rng, inputs, labels, mask):
             def fwd():
                 wrapped_in = [Tensor(x) for x in inputs]
                 wrapped_lb = [Tensor(x) for x in labels]
@@ -147,7 +282,10 @@ class Model:
                                          *[w._value for w in wrapped_in])
                 wout = jax.tree_util.tree_map(
                     lambda x: Tensor(x), out)
-                lv = self._loss_value(wout, wrapped_lb)
+                if mask is None:
+                    lv = self._loss_value(wout, wrapped_lb)
+                else:
+                    lv = self._masked_loss(wout, wrapped_lb, mask)
                 return lv, (out, new_bufs)
             if amp_level:
                 from .. import amp as amp_mod
@@ -161,11 +299,11 @@ class Model:
             lv_raw = lv._value if isinstance(lv, Tensor) else lv
             return jnp.mean(lv_raw.astype("float32")), aux
 
-        def step(carry, rng, step_no, lr, inputs, labels):
+        def step(carry, rng, step_no, lr, inputs, labels, mask=None):
             pv, bv, opt_state = (carry["params"], carry["buffers"],
                                  carry["opt_state"])
             (lv, (out, new_bufs)), grads = jax.value_and_grad(
-                loss_fn, has_aux=True)(pv, bv, rng, inputs, labels)
+                loss_fn, has_aux=True)(pv, bv, rng, inputs, labels, mask)
             new_pv, new_state = opt.apply_gradients_pytree(
                 grads, pv, opt_state, lr, step_no)
             return {"params": new_pv, "buffers": new_bufs,
@@ -207,6 +345,7 @@ class Model:
         via ModelCheckpoint epoch saves, which flush to host files. With
         FLAGS_train_step_donate=0 the Tensors keep valid pre-carry values.
         """
+        self._sync_sharded_carry(validate=validate)
         carry = self._train_carry
         if carry is None:
             return
@@ -229,6 +368,38 @@ class Model:
         self._opt_state = carry["opt_state"]
         self._train_carry = None
 
+    def _sync_sharded_carry(self, validate=False):
+        """Fleet analogue of the single-device carry flush: write the
+        device-resident `_sharded_state` params/buffers back into the
+        network's Tensors. Unlike the single-device carry the state stays
+        live (it carries the sharded optimizer moments across epochs);
+        only the dirty bit clears. Same poisoned-carry rule: with
+        `validate` a state whose async step failed is DROPPED, not
+        written back (recovery is via checkpoint saves — the donated
+        pre-epoch buffers were already consumed)."""
+        if not getattr(self, "_sharded_dirty", False):
+            return
+        state = self._sharded_state
+        if validate:
+            try:
+                jax.block_until_ready(jax.tree_util.tree_leaves(state))
+            except Exception:
+                # poisoned: never write failed arrays into the Tensors.
+                # With donation off the Tensors are still healthy, so a
+                # rebuilt step can restart from them; with donation on
+                # the pre-epoch buffers are consumed — the next sharded
+                # step raises until a checkpoint is loaded.
+                self._sharded_state = None
+                self._sharded_dirty = False
+                if not getattr(self, "_sharded_donate", True) and \
+                        hasattr(self, "_sharded_step"):
+                    del self._sharded_step
+                return
+        from ..parallel.spmd import write_back
+        write_back(self.network, state)
+        STAT_ADD("STAT_sharded_carry_syncs")
+        self._sharded_dirty = False
+
     def _current_values(self):
         """(params, buffers) value dicts for eval/predict: the live carry
         when training is in flight (no flush — eval doesn't donate), else
@@ -236,21 +407,69 @@ class Model:
         carry = self._train_carry
         if carry is not None:
             return carry["params"], carry["buffers"]
+        state = getattr(self, "_sharded_state", None)
+        if state is not None and getattr(self, "_sharded_dirty", False):
+            # sharded training in flight: Tensors are stale until the
+            # epoch-boundary write_back — read the live carry directly
+            return state["params"], state["buffers"]
         return ({n: t._value for n, t in get_params(self.network).items()},
                 {n: t._value for n, t in get_buffers(self.network).items()})
 
-    def train_batch(self, inputs, labels=None, update=True):
+    def _placed_mask(self, loss_mask):
+        """Device-resident row mask, cached per exact mask pattern.
+
+        fit passes the same handful of masks every epoch (all-ones per
+        full batch, one tail pattern); caching their placement keeps the
+        hot loop free of per-step host->device mask uploads — and on the
+        fleet path the dp-sharded placement lets the step's pre-placed
+        fast path skip the mask too. Keyed by the exact byte pattern:
+        train_batch's loss_mask parameter is public, and two masks with
+        the same population count need not select the same rows."""
+        m = np.ascontiguousarray(np.asarray(loss_mask, "float32"))
+        sharded = self._dist_ctx is not None
+        key = (m.tobytes(), sharded)
+        hit = self._mask_cache.get(key)
+        if hit is not None:
+            return hit
+        arr = jnp.asarray(m, "float32")
+        if sharded:
+            from ..parallel.mesh import get_mesh
+            from ..parallel.spmd import batch_sharding
+            mesh = get_mesh()
+            if mesh is not None:
+                arr = jax.device_put(arr, batch_sharding(1, mesh))
+        self._mask_cache[key] = arr
+        return arr
+
+    def _mask_fallback(self, inputs, labels, loss_mask):
+        """A loss that cannot fold the tail row mask: warn once, pin the
+        model to unpadded tails, and rerun this batch on its real rows."""
+        if getattr(self, "_tail_maskable", True):
+            self._tail_maskable = False
+            warnings.warn(
+                "FLAGS_train_tail_bucketing: the prepared loss does not "
+                "expose per-row values; falling back to unpadded tail "
+                "batches (one extra XLA compile per tail shape)",
+                stacklevel=3)
+        rows, idx = _real_rows(loss_mask)
+        return (_select_rows(inputs, rows, idx),
+                _select_rows(labels, rows, idx))
+
+    def train_batch(self, inputs, labels=None, update=True, loss_mask=None):
         if self._dist_ctx is not None:
-            return self._train_batch_sharded(inputs, labels)
+            return self._train_batch_sharded(inputs, labels,
+                                             loss_mask=loss_mask)
         inputs = [t._value if isinstance(t, Tensor) else jnp.asarray(t)
                   for t in _flatten_batch(inputs)]
         labels = [t._value if isinstance(t, Tensor) else jnp.asarray(t)
                   for t in _flatten_batch(labels or [])]
         carry = self._ensure_carry()
         donate = bool(flag("FLAGS_train_step_donate"))
+        mask = None if loss_mask is None else self._placed_mask(loss_mask)
         key = (donate,
                tuple((tuple(a.shape), str(a.dtype)) for a in inputs),
-               tuple((tuple(a.shape), str(a.dtype)) for a in labels))
+               tuple((tuple(a.shape), str(a.dtype)) for a in labels),
+               None if mask is None else tuple(mask.shape))
         fn = self._train_step_cache.get(key)
         if fn is None:
             fn = jax.jit(self._make_train_step(),
@@ -265,7 +484,17 @@ class Model:
                 new_carry, lv, out = fn(
                     carry, rng, jnp.asarray(step_no, "int32"),
                     jnp.asarray(self._optimizer.get_lr(), "float32"),
-                    tuple(inputs), tuple(labels))
+                    tuple(inputs), tuple(labels), mask)
+        except _TailMaskError:
+            # trace-time failure: the carry was never dispatched into —
+            # rerun the real rows through the plain (unpadded) step. The
+            # evicted entry never produced an executable, so it does not
+            # count against the compile budget either.
+            if self._train_step_cache.pop(key, None) is not None:
+                STAT_SUB("STAT_train_step_compiles")
+            self._global_step = step_no - 1
+            ins, lbs = self._mask_fallback(inputs, labels, loss_mask)
+            return self.train_batch(ins, lbs, update=update)
         except BaseException:
             # a step that died mid-call may have consumed the donated
             # carry (XLA error after dispatch). Keep the carry when its
@@ -289,50 +518,106 @@ class Model:
             # across steps.
             self._sync_carry()
         outs = jax.tree_util.tree_leaves(out)
+        if loss_mask is not None and self._metrics:
+            # metrics must never see the masked-out rows
+            rows, idx = _real_rows(loss_mask)
+            if len(idx) < rows:
+                outs = _select_rows(outs, rows, idx)
+                labels = _select_rows(labels, rows, idx)
         metrics = self._update_metrics(outs, labels)
         loss = DeferredScalar(lv)
         return (loss, metrics) if self._metrics else ([loss], metrics)
 
-    def _train_batch_sharded(self, inputs, labels):
+    def _train_batch_sharded(self, inputs, labels, loss_mask=None):
         """fleet path: one pjit'ed step over the mesh (dp/tp/zero per
-        strategy); params written back so eval/save see fresh values."""
-        import jax
-        from ..parallel.spmd import write_back
+        strategy). The state is a device-resident donated carry like the
+        single-device path: inside fit it stays live across steps and is
+        written back to the network's Tensors on epoch boundaries only
+        (`_sync_sharded_carry`); standalone calls — and
+        FLAGS_train_step_donate=0 — keep the per-call write-back
+        contract. A padded tail's row mask rides along as an extra
+        dp-sharded "label" so the pjit signature (and the single
+        compiled executable) is shared with full batches."""
+        donate = bool(flag("FLAGS_train_step_donate"))
         if not hasattr(self, "_sharded_step"):
             def loss_fn(outs, lbs):
                 out = outs[0] if isinstance(outs, (list, tuple)) else outs
+                if self._sharded_mask_live:
+                    mask = lbs[-1]
+                    lv = self._masked_loss(out, list(lbs[:-1]), mask)
+                    return Tensor(lv)
                 return self._loss_value(out, lbs)
+            self._sharded_donate = donate
             self._sharded_step, self._sharded_state = \
                 self._dist_ctx.build_sharded_train_step(
-                    self.network, self._optimizer, loss_fn)
+                    self.network, self._optimizer, loss_fn, donate=donate)
+        if self._sharded_state is None:
+            raise RuntimeError(
+                "sharded training state was dropped after a failed step "
+                "and the donated pre-epoch buffers are consumed; restore "
+                "from a checkpoint (Model.load) before training on")
         ins = [t._value if isinstance(t, Tensor) else jnp.asarray(t)
                for t in _flatten_batch(inputs)]
         lbs = [t._value if isinstance(t, Tensor) else jnp.asarray(t)
                for t in _flatten_batch(labels or [])]
-        self._sharded_state, lv = self._sharded_step(
-            self._sharded_state, tuple(ins), tuple(lbs))
-        write_back(self.network, self._sharded_state)
+        if loss_mask is not None:
+            lbs = lbs + [self._placed_mask(loss_mask)]
+        # read at trace time by loss_fn; consistent because pjit retraces
+        # exactly when the label structure (mask present/absent) changes
+        self._sharded_mask_live = loss_mask is not None
+        state = self._sharded_state
+        try:
+            with stat_time("STAT_train_step_ns"):
+                new_state, lv = self._sharded_step(
+                    state, tuple(ins), tuple(lbs))
+        except _TailMaskError:
+            ins, lbs = self._mask_fallback(ins, lbs[:-1], loss_mask)
+            return self._train_batch_sharded(ins, lbs)
+        except BaseException:
+            # same donated-carry hygiene as the single-device path: a
+            # step that consumed the donated state mid-failure must not
+            # leave deleted buffers where the epoch-end write_back (or
+            # the next step) will read them
+            if any(getattr(leaf, "is_deleted", lambda: False)()
+                   for leaf in jax.tree_util.tree_leaves(state)):
+                self._sharded_state = None
+                self._sharded_dirty = False
+            raise
+        self._sharded_state = new_state
+        self._sharded_dirty = True
+        STAT_ADD("STAT_train_steps")
+        if not (self._in_fit and getattr(self, "_sharded_donate", donate)):
+            # standalone contract / donation off: Tensors stay fresh
+            self._sync_sharded_carry()
         loss = DeferredScalar(lv)
         return (loss, []) if self._metrics else ([loss], [])
 
-    def eval_batch(self, inputs, labels=None):
+    def eval_batch(self, inputs, labels=None, loss_mask=None):
         pv, bv = self._current_values()
         inputs = [t._value if isinstance(t, Tensor) else jnp.asarray(t)
                   for t in _flatten_batch(inputs)]
         labels = [t._value if isinstance(t, Tensor) else jnp.asarray(t)
                   for t in _flatten_batch(labels or [])]
-        key = tuple((tuple(a.shape), str(a.dtype)) for a in inputs + labels)
+        mask = None if loss_mask is None else self._placed_mask(loss_mask)
+        key = (tuple((tuple(a.shape), str(a.dtype))
+                     for a in inputs + labels),
+               None if mask is None else tuple(mask.shape))
         fn = self._eval_step_cache.get(key)
         if fn is None:
             apply_fn = self._apply_fn
 
-            def estep(pv_, bv_, rng, ins, lbs):
+            def estep(pv_, bv_, rng, ins, lbs, mask_=None):
                 from ..framework.autograd import trace_mode
                 out, _ = apply_fn(pv_, bv_, rng, False, *ins)
                 with trace_mode():
                     wout = jax.tree_util.tree_map(lambda x: Tensor(x), out)
-                    lv = self._loss_value(wout, [Tensor(x) for x in lbs]) \
-                        if (self._loss is not None and lbs) else None
+                    if self._loss is not None and lbs:
+                        wlbs = [Tensor(x) for x in lbs]
+                        lv = (self._loss_value(wout, wlbs) if mask_ is None
+                              else Tensor(self._masked_loss(wout, wlbs,
+                                                            mask_)))
+                    else:
+                        lv = None
                 lv_raw = (jnp.mean(lv._value.astype("float32"))
                           if isinstance(lv, Tensor) else
                           (lv if lv is not None else jnp.zeros(())))
@@ -340,12 +625,25 @@ class Model:
             fn = jax.jit(estep)
             self._eval_step_cache[key] = fn
         rng = frandom.get_rng_key()
-        lv, out = fn(pv, bv, rng, tuple(inputs), tuple(labels))
+        try:
+            lv, out = fn(pv, bv, rng, tuple(inputs), tuple(labels), mask)
+        except _TailMaskError:
+            self._eval_step_cache.pop(key, None)
+            ins, lbs = self._mask_fallback(inputs, labels, loss_mask)
+            return self.eval_batch(ins, lbs)
         outs = jax.tree_util.tree_leaves(out)
+        if loss_mask is not None:
+            rows, idx = _real_rows(loss_mask)
+            if len(idx) < rows:
+                outs = _select_rows(outs, rows, idx)
+                labels = _select_rows(labels, rows, idx)
         metrics = self._update_metrics(outs, labels)
         return DeferredScalar(lv), metrics
 
-    def predict_batch(self, inputs):
+    def predict_batch(self, inputs, nreal=None):
+        """`nreal` (tail bucketing): the batch was padded; only the first
+        `nreal` output rows are returned — and the padded shape means the
+        per-exact-shape jit cache gets no tail-shape entry."""
         pv, bv = self._current_values()
         inputs = [t._value if isinstance(t, Tensor) else jnp.asarray(t)
                   for t in _flatten_batch(inputs)]
@@ -357,7 +655,15 @@ class Model:
                 pv_, bv_, rng, False, *ins)[0])
             self._pred_step_cache[key] = fn
         out = fn(pv, bv, frandom.get_rng_key(), tuple(inputs))
-        return jax.tree_util.tree_map(lambda x: np.asarray(x), out)
+        rows = _batch_rows(inputs)
+        out = jax.tree_util.tree_map(lambda x: np.asarray(x), out)
+        if nreal is not None and rows is not None and nreal < rows:
+            out = jax.tree_util.tree_map(
+                lambda x: (x[:nreal] if (hasattr(x, "shape")
+                                         and getattr(x, "ndim", 0) >= 1
+                                         and x.shape[0] == rows) else x),
+                out)
+        return out
 
     def _update_metrics(self, outputs, labels):
         res = []
@@ -380,11 +686,69 @@ class Model:
     def _buffered(self, loader):
         """Wrap a DataLoader with the async DeviceFeeder double buffer
         (host->device transfer of batch N+1 overlaps batch N's compute)
-        when the loader opted into buffering (`use_buffer_reader`)."""
+        when the loader opted into buffering (`use_buffer_reader`).
+
+        Under fleet the feeder gets the strategy's batch placement, so
+        the background thread lays every batch directly into its
+        dp/sp-sharded layout and the sharded step consumes it without a
+        synchronous re-placement."""
         if isinstance(loader, DataLoader) and \
                 getattr(loader, "use_buffer_reader", False):
-            return DeviceFeeder(loader)
+            placement = None
+            if self._dist_ctx is not None:
+                try:
+                    placement = self._dist_ctx.batch_placement()
+                except Exception:
+                    placement = None
+            return DeviceFeeder(loader, device=placement)
         return loader
+
+    def _tail_target(self, loader, need_mask=True):
+        """The loader's batch size when its epochs can actually produce a
+        partial tail batch (unknown-length loaders count as "can"), else
+        None. Gating on this keeps datasets that only ever emit full
+        batches on the exact maskless step they always had — the masked
+        reduction is numerically identical for row-uniform losses but
+        weights rows (not valid elements) for losses with data-dependent
+        denominators like cross_entropy ignore_index, so it must not be
+        paid where it buys nothing. `need_mask=False` (predict: no loss,
+        rows just sliced off the output) pads even when the prepared
+        loss refused the mask."""
+        if not flag("FLAGS_train_tail_bucketing"):
+            return None
+        if need_mask and not getattr(self, "_tail_maskable", True):
+            return None
+        bs = getattr(loader, "batch_size", None)
+        if not bs:
+            return None
+        sampler = getattr(loader, "batch_sampler", None)
+        if getattr(sampler, "drop_last", False):
+            return None  # the sampler already drops the tail
+        ds = getattr(loader, "dataset", None)
+        if ds is not None and sampler is not None:
+            try:
+                if len(ds) % bs == 0:
+                    return None  # every batch is full
+            except TypeError:
+                pass  # unsized dataset: a tail is possible
+        return bs
+
+    def _pad_tail(self, ins, lbs, target):
+        """Tail bucketing: grow a partial batch to `target` rows and
+        return (ins, lbs, row_mask, nreal). Full batches pass through
+        with an all-ones mask (same jit signature -> same executable as
+        the padded tail: exactly one train-step compile per epoch)."""
+        rows = _batch_rows(ins + lbs)
+        if rows is None:
+            return ins, lbs, None, None
+        if rows >= target:
+            return ins, lbs, np.ones((rows,), "float32"), rows
+        mask = np.zeros((target,), "float32")
+        mask[:rows] = 1.0
+        ins = [_pad_leaf(x, rows, target) for x in ins]
+        lbs = [_pad_leaf(x, rows, target) for x in lbs]
+        STAT_ADD("STAT_tail_pad_batches")
+        return ins, lbs, mask, rows
 
     def _split_batch(self, batch):
         data = _flatten_batch(batch)
@@ -404,7 +768,7 @@ class Model:
                                       num_workers, False)
         cbks = cbks_mod.config_callbacks(
             callbacks, model=self, epochs=epochs,
-            steps=len(loader) if hasattr(loader, "__len__") else None,
+            steps=_steps_of(loader),
             log_freq=log_freq, save_freq=save_freq, save_dir=save_dir,
             verbose=verbose,
             metrics=["loss"] + [n for m in self._metrics
@@ -426,10 +790,36 @@ class Model:
                 for m in self._metrics:
                     m.reset()
                 logs = {}
+                # tail bucketing: pad the drop_last=False partial batch
+                # to the loader's batch size and fold a row mask into the
+                # loss, so every batch of the epoch shares ONE compiled
+                # step (the mask rides the signature even on full
+                # batches; epochs that cannot produce a tail skip the
+                # mask entirely and keep the plain step)
+                pad_to = self._tail_target(loader)
                 for step, batch in enumerate(feed):
                     cbks.on_batch_begin("train", step, logs)
                     ins, lbs = self._split_batch(batch)
-                    loss, metrics = self.train_batch(ins, lbs)
+                    mask, nreal = None, None
+                    if pad_to and self._tail_maskable:
+                        # _tail_maskable re-checked per batch: a
+                        # mid-epoch fallback stops the masked attempts
+                        ins, lbs, mask, nreal = self._pad_tail(
+                            ins, lbs, pad_to)
+                    padded = mask is not None and nreal is not None and \
+                        nreal < len(mask)
+                    c0 = (stat_get("STAT_train_step_compiles") if padded
+                          else 0)
+                    loss, metrics = self.train_batch(ins, lbs,
+                                                     loss_mask=mask)
+                    if padded and self._dist_ctx is None and \
+                            stat_get("STAT_train_step_compiles") == c0:
+                        # the padded tail rode an executable some full
+                        # batch already compiled — the win this is for.
+                        # (single-device only: pjit compiles are not
+                        # observable through this counter, so the fleet
+                        # path makes no claim here)
+                        STAT_ADD("STAT_tail_pad_compiles_avoided")
                     lv = loss[0] if isinstance(loss, (list, tuple)) else loss
                     # deferred host sync: the loss stays a device handle
                     # except on the log cadence (one sync per log_freq)
@@ -437,8 +827,9 @@ class Model:
                             isinstance(lv, DeferredScalar):
                         lv = float(lv)
                     logs = {"loss": lv, "step": step, "batch_size":
-                            ins[0].shape[0] if hasattr(ins[0], "shape") else
-                            batch_size}
+                            nreal if nreal is not None else
+                            (ins[0].shape[0] if hasattr(ins[0], "shape")
+                             else batch_size)}
                     for m, r in zip(self._metrics, metrics):
                         names = m.name() if isinstance(m.name(), list) else \
                             [m.name()]
@@ -501,9 +892,13 @@ class Model:
         for m in self._metrics:
             m.reset()
         losses = []
+        pad_to = self._tail_target(loader)
         for batch in self._buffered(loader):
             ins, lbs = self._split_batch(batch)
-            lv, _ = self.eval_batch(ins, lbs)
+            mask = None
+            if pad_to and self._tail_maskable:
+                ins, lbs, mask, _ = self._pad_tail(ins, lbs, pad_to)
+            lv, _ = self.eval_batch(ins, lbs, loss_mask=mask)
             losses.append(lv)
         # one device->host sync for the whole pass: every per-batch handle
         # rides a single stacked transfer (framework.deferred)
@@ -522,9 +917,17 @@ class Model:
         loader = self._as_loader(test_data, batch_size, False, num_workers,
                                  False)
         outputs = []
+        pad_to = self._tail_target(loader, need_mask=False)
         for batch in self._buffered(loader):
             ins, _ = self._split_batch(batch)
-            outputs.append(self.predict_batch(ins))
+            nreal = None
+            if pad_to:
+                rows = _batch_rows(ins)
+                if rows is not None and rows < pad_to:
+                    ins = [_pad_leaf(x, rows, pad_to) for x in ins]
+                    nreal = rows
+                    STAT_ADD("STAT_tail_pad_batches")
+            outputs.append(self.predict_batch(ins, nreal=nreal))
         if stack_outputs and outputs:
             if isinstance(outputs[0], (list, tuple)):
                 outputs = [np.concatenate([o[i] for o in outputs])
@@ -553,6 +956,12 @@ class Model:
     def load(self, path, skip_mismatch=False, reset_optimizer=False):
         from ..framework.io_state import load as pload
         self._train_carry = None  # loaded values supersede any live carry
+        # the sharded step closed over the pre-load param placements;
+        # rebuild it (and its state) from the freshly loaded Tensors
+        self._sharded_state = None
+        self._sharded_dirty = False
+        if hasattr(self, "_sharded_step"):
+            del self._sharded_step
         state = pload(path + ".pdparams")
         self.network.set_state_dict(state)
         opt_path = path + ".pdopt"

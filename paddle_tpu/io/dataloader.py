@@ -4,18 +4,29 @@
 `num_workers>0` runs REAL worker processes (reference
 `_DataLoaderIterMultiProcess`, `dataloader_iter.py:469`): each worker
 fetches+collates to numpy and ships the batch through a POSIX
-shared-memory segment (the reference's mmap'd `_shared_memory` allocator,
-`fluid/memory/allocation/mmap_allocator.cc`), so decode-heavy pipelines
-are not Python-GIL-bound. Metadata rides a small mp.Queue; the parent
-copies each array once out of the segment (JAX's CPU backend may alias
-numpy buffers, so live views over an unlinked segment are unsafe) and
-frees it. Ordered hand-out, worker-error propagation with the original
-traceback, sentinel + join shutdown.
+shared-memory RING (the reference's mmap'd `_shared_memory` allocator,
+`fluid/memory/allocation/mmap_allocator.cc`): `num_workers *
+prefetch_factor` reusable slots with explicit slot-free handoff. A worker
+claims a free slot, writes the batch into its segment, and the parent
+returns the slot once it copied the arrays out — so after the ring warms
+up, steady state does ZERO shared-memory create/mmap/unlink syscalls (a
+slot's segment is only recreated when a batch outgrows it). Metadata
+rides a small mp.Queue; the parent copies each array once out of the
+segment (JAX's CPU backend may alias numpy buffers, so live views over a
+reusable slot would be clobbered by the next batch). Ordered hand-out,
+worker-error propagation with the original traceback, sentinel + join
+shutdown that sweeps exactly the fixed set of ring-slot names (not one
+name per batch of the epoch).
 
 `use_thread_workers=True` keeps the lighter in-process thread pool
 (useful when the dataset is closure-heavy and cheap to decode). Batches
 are handed out as framework Tensors (host-resident; H2D overlaps with
 compute under jit).
+
+Counters (framework/monitor.py, parent side): STAT_shm_slots_reused —
+batches served from an already-mapped slot segment (steady state);
+STAT_shm_slot_segments — parent-side segment (re)maps: ring size + any
+regrows, constant across an arbitrarily long epoch.
 """
 from __future__ import annotations
 
@@ -84,7 +95,7 @@ def _to_tensors(collated):
 
 
 # ---------------------------------------------------------------------------
-# multiprocess workers with shared-memory batch transport
+# multiprocess workers with a reusable shared-memory slot ring
 # ---------------------------------------------------------------------------
 
 class _ArrRef:
@@ -95,16 +106,89 @@ class _ArrRef:
         self.idx = idx
 
 
-def _shm_encode(obj, name=None):
-    """Strip ndarray leaves into one shared-memory segment.
+# shutdown token pushed onto the free-slot queue so a worker blocked on a
+# slot claim wakes up, drops its task and reaches the task sentinel
+_RING_ABORT = -1
 
-    Returns (tree, shm_name, specs): `tree` mirrors `obj` with ndarrays
-    replaced by _ArrRef; `specs` is [(offset, shape, dtype_str)] into the
-    segment. shm_name is None when the batch holds no arrays. `name` pins
-    the segment name so the parent can sweep segments whose metadata never
-    made it out of a killed worker.
+
+def _untrack(shm):
+    """The PARENT owns every ring segment's lifetime (it unlinks them in
+    shutdown); deregister from this process's resource_tracker so a
+    worker's exit doesn't double-free a live slot."""
+    try:
+        from multiprocessing import resource_tracker
+        resource_tracker.unregister(shm._name, "shared_memory")
+    except Exception:
+        pass
+
+
+def _slot_name(uid, slot):
+    return f"{uid}r{slot}"
+
+
+def _ring_claim(slot_q, cache, uid, needed):
+    """Claim a free ring slot with capacity >= `needed` bytes.
+
+    Returns (slot, gen, size, shm) or None on shutdown. The (gen, size)
+    pair from the free queue is authoritative: gen bumps every time the
+    slot's segment is recreated, so every process-local handle cache can
+    tell a stale mapping from a live one. Steady state (cached handle,
+    big-enough segment) touches no kernel object at all.
     """
     from multiprocessing import shared_memory
+    slot, gen, size = slot_q.get()
+    if slot == _RING_ABORT:
+        return None
+    cached = cache.pop(slot, None)
+    if cached is not None and cached[0] != gen:
+        try:
+            cached[1].close()
+        except Exception:
+            pass
+        cached = None
+    if size < needed:
+        # regrow: drop the current segment (if any) and recreate the same
+        # name larger — the only syscalls after the ring has warmed up.
+        # Unlink through a FRESH attach: its tracker register pairs with
+        # unlink's unregister (cached handles were already deregistered)
+        if cached is not None:
+            try:
+                cached[1].close()
+            except Exception:
+                pass
+            cached = None
+        if size > 0:
+            try:
+                old = shared_memory.SharedMemory(name=_slot_name(uid,
+                                                                 slot))
+                old.unlink()
+                old.close()
+            except FileNotFoundError:
+                pass
+            except Exception:
+                pass
+        shm = shared_memory.SharedMemory(name=_slot_name(uid, slot),
+                                         create=True, size=needed)
+        _untrack(shm)
+        gen += 1
+        size = needed
+    elif cached is None:
+        shm = shared_memory.SharedMemory(name=_slot_name(uid, slot))
+        _untrack(shm)
+    else:
+        shm = cached[1]
+    cache[slot] = (gen, shm)
+    return slot, gen, size, shm
+
+
+def _shm_encode_ring(obj, slot_q, cache, uid):
+    """Strip ndarray leaves into a claimed ring slot.
+
+    Returns (tree, slot, gen, size, specs) — slot None when the batch
+    holds no arrays — or None when shutdown raced the claim. A failure
+    AFTER the claim returns the slot before propagating, so the ring
+    never loses capacity to a poisoned batch.
+    """
     arrays = []
 
     def strip(x):
@@ -119,51 +203,61 @@ def _shm_encode(obj, name=None):
 
     tree = strip(obj)
     if not arrays:
-        return tree, None, []
+        return tree, None, 0, 0, []
     total = sum(a.nbytes for a in arrays) or 1
-    shm = shared_memory.SharedMemory(name=name, create=True, size=total)
-    specs, off = [], 0
-    for a in arrays:
-        if a.nbytes:
-            dst = np.ndarray(a.shape, a.dtype, buffer=shm.buf, offset=off)
-            np.copyto(dst, a)
-        specs.append((off, a.shape, a.dtype.str))
-        off += a.nbytes
-    name = shm.name
-    shm.close()
-    # the PARENT owns the segment's lifetime (it unlinks after device-put);
-    # deregister here so this worker's resource_tracker doesn't double-free
+    claim = _ring_claim(slot_q, cache, uid, total)
+    if claim is None:
+        return None
+    slot, gen, size, shm = claim
     try:
-        from multiprocessing import resource_tracker
-        resource_tracker.unregister(shm._name, "shared_memory")
-    except Exception:
-        pass
-    return tree, name, specs
+        specs, off = [], 0
+        for a in arrays:
+            if a.nbytes:
+                dst = np.ndarray(a.shape, a.dtype, buffer=shm.buf,
+                                 offset=off)
+                np.copyto(dst, a)
+            specs.append((off, a.shape, a.dtype.str))
+            off += a.nbytes
+    except BaseException:
+        slot_q.put((slot, gen, size))
+        raise
+    return tree, slot, gen, size, specs
 
 
-def _shm_decode(tree, shm_name, specs):
-    """Rebuild the batch from the segment and release it.
+def _shm_decode_ring(payload, slot_q, cache, uid):
+    """Rebuild the batch from its ring slot and hand the slot back.
 
     Leaves are copied out (one memcpy per array): JAX's CPU backend may
-    zero-copy alias a numpy buffer, so handing out live views over a
-    segment we are about to unlink would leave tensors over unmapped
-    pages. The expensive per-sample decode already happened in the worker;
-    this single sequential memcpy is the transport cost.
+    zero-copy alias a numpy buffer, and the slot's segment is reused by
+    the next batch the moment it is freed. The expensive per-sample
+    decode already happened in the worker; this single sequential memcpy
+    is the transport cost. The parent's handle cache makes the steady
+    state mmap-free (STAT_shm_slots_reused vs STAT_shm_slot_segments).
     """
-    if shm_name is None:
+    tree, slot, gen, size, specs = payload
+    if slot is None:
         return tree
     from multiprocessing import shared_memory
-    shm = shared_memory.SharedMemory(name=shm_name)
+    cached = cache.get(slot)
+    if cached is None or cached[0] != gen:
+        if cached is not None:
+            try:
+                cached[1].close()
+            except Exception:
+                pass
+        shm = shared_memory.SharedMemory(name=_slot_name(uid, slot))
+        _untrack(shm)  # the iterator's shutdown sweep owns the unlink
+        cache[slot] = (gen, shm)
+        STAT_ADD("STAT_shm_slot_segments")
+    else:
+        shm = cached[1]
+        STAT_ADD("STAT_shm_slots_reused")
     try:
         arrays = [np.ndarray(shape, np.dtype(dt), buffer=shm.buf,
                              offset=off).copy()
                   for off, shape, dt in specs]
     finally:
-        try:
-            shm.close()
-            shm.unlink()
-        except Exception:
-            pass
+        slot_q.put((slot, gen, size))
 
     def rebuild(x):
         if isinstance(x, _ArrRef):
@@ -178,10 +272,11 @@ def _shm_decode(tree, shm_name, specs):
 
 
 def _mp_worker_loop(dataset, collate_fn, worker_init_fn, wid, nw,
-                    task_q, result_q, use_shm, uid):
+                    task_q, result_q, slot_q, use_shm, uid):
     """Target of one DataLoader worker process (numpy-only; never touches
     the accelerator)."""
     _worker_info.info = WorkerInfo(wid, nw, dataset)
+    ring_cache = {}  # slot -> (gen, SharedMemory) — this worker's mappings
     rc = 0
     if worker_init_fn:
         try:
@@ -196,11 +291,20 @@ def _mp_worker_loop(dataset, collate_fn, worker_init_fn, wid, nw,
         seq, indices = item
         try:
             out = collate_fn([dataset[i] for i in indices])
-            payload = _shm_encode(out, f"{uid}s{seq}") if use_shm \
-                else (out, None, [])
+            if use_shm:
+                payload = _shm_encode_ring(out, slot_q, ring_cache, uid)
+                if payload is None:  # shutdown raced the slot claim
+                    continue
+            else:
+                payload = (out, None, 0, 0, [])
             result_q.put((seq, "ok", payload))
         except Exception:
             result_q.put((seq, "err", _traceback.format_exc()))
+    for _, shm in ring_cache.values():
+        try:
+            shm.close()
+        except Exception:
+            pass
     result_q.close()
     result_q.join_thread()  # flush the feeder thread before hard exit
     os._exit(rc)            # skip atexit: the fork inherited jax/XLA state
@@ -276,34 +380,45 @@ class DataLoader:
             yield _to_tensors(self.collate_fn(batch))
 
     def _iter_multiprocess(self):
-        """Real worker processes + shared-memory transport (reference
-        `_DataLoaderIterMultiProcess`, `dataloader_iter.py:469`)."""
+        """Real worker processes + shared-memory ring transport (reference
+        `_DataLoaderIterMultiProcess`, `dataloader_iter.py:469`).
+
+        The ring holds `num_workers * prefetch_factor` slots — exactly the
+        prefetch window, so a worker always finds a free slot once the
+        consumer keeps up, and the in-flight segment set is fixed-size:
+        shutdown sweeps those names only, never one name per batch."""
         ctx = mp.get_context("fork" if "fork" in mp.get_all_start_methods()
                              else "spawn")
         nw = self.num_workers
         task_q = ctx.Queue()
         result_q = ctx.Queue()
+        slot_q = ctx.Queue()
         use_shm = self.use_shared_memory
-        # deterministic segment names ("<uid>s<seq>") let shutdown sweep
-        # segments whose metadata never escaped a killed worker
+        # deterministic slot names ("<uid>r<slot>") let shutdown sweep the
+        # whole ring even when a killed worker never reported its claim
         uid = f"ptpu{os.getpid()}x{uuid.uuid4().hex[:8]}"
+        n_slots = max(1, nw * self.prefetch_factor)
+        if use_shm:
+            for slot in range(n_slots):
+                slot_q.put((slot, 0, 0))  # gen 0, size 0: not yet created
         procs = [ctx.Process(
             target=_mp_worker_loop,
             args=(self.dataset, self.collate_fn, self.worker_init_fn,
-                  wid, nw, task_q, result_q, use_shm, uid),
+                  wid, nw, task_q, result_q, slot_q, use_shm, uid),
             daemon=True) for wid in range(nw)]
         for p in procs:
             p.start()
 
         batches = list(self.batch_sampler)
         total = len(batches)
-        depth = nw * self.prefetch_factor
+        depth = n_slots
         sent = 0
         for seq in range(min(depth, total)):
             task_q.put((seq, batches[seq]))
             sent += 1
 
         pending = {}
+        ring_cache = {}  # slot -> (gen, SharedMemory): parent's mappings
 
         def shutdown():
             # drop queued-but-unstarted work so workers reach the sentinel
@@ -313,6 +428,14 @@ class DataLoader:
                     task_q.get_nowait()
                 except Exception:
                     break
+            if use_shm:
+                # wake workers parked on a slot claim; they drop the task
+                # and fall through to the sentinel
+                for _ in procs:
+                    try:
+                        slot_q.put((_RING_ABORT, 0, 0))
+                    except Exception:
+                        pass
             for _ in procs:
                 try:
                     task_q.put(None)
@@ -322,30 +445,30 @@ class DataLoader:
                 p.join(timeout=30)
                 if p.is_alive():
                     p.terminate()
-            # release segments still in flight (reorder buffer + queue)
-            while True:
+            for _, shm in ring_cache.values():
                 try:
-                    seq, status, payload = result_q.get_nowait()
+                    shm.close()
                 except Exception:
-                    break
-                if status == "ok":
-                    pending[seq] = payload
-            for _, payload in pending.items():
-                _shm_decode(*payload)
+                    pass
+            ring_cache.clear()
             pending.clear()
             if use_shm:
+                # the whole in-flight set IS the ring: O(n_slots) names,
+                # not O(total batches)
                 from multiprocessing import shared_memory
-                for seq in range(total):
+                for slot in range(n_slots):
                     try:
                         leak = shared_memory.SharedMemory(
-                            name=f"{uid}s{seq}")
+                            name=_slot_name(uid, slot))
                     except FileNotFoundError:
                         continue
                     except Exception:
                         break
                     try:
-                        leak.close()
+                        # the attach registered the name; unlink's own
+                        # unregister pairs with it — no explicit untrack
                         leak.unlink()
+                        leak.close()
                     except Exception:
                         pass
 
@@ -380,7 +503,8 @@ class DataLoader:
                 deadline = (time.monotonic() + self.timeout
                             if self.timeout else None)
                 STAT_ADD("STAT_dataloader_batches")
-                yield _to_tensors(_shm_decode(*pending.pop(want)))
+                yield _to_tensors(_shm_decode_ring(
+                    pending.pop(want), slot_q, ring_cache, uid))
         finally:
             shutdown()
 

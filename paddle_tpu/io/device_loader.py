@@ -12,15 +12,33 @@ preserves batch order and structure; Tensor/ndarray leaves come out as
 device-committed Tensors. `Model.fit`/`evaluate` wrap their DataLoader
 with this automatically when `use_buffer_reader` is set (the default).
 
+Sharding-aware placement: `device` may be a jax Device, a
+`jax.sharding.Sharding`, or a CALLABLE `leaf -> Device/Sharding` (see
+`parallel.spmd.batch_placement`). With a placement callable the feeder
+thread lays every batch directly into its dp/sp-sharded device layout, so
+the sharded train step consumes the arrays as-is instead of re-splitting
+them on the synchronous step path.
+
 Counters (framework/monitor.py):
   STAT_device_feeder_batches  — batches handed to the consumer
-  STAT_device_feeder_overlap  — hand-outs where the next batch was already
-                                staged (proof the overlap actually engaged)
+  STAT_device_feeder_overlap  — hand-outs whose staging was actually
+                                hidden behind the consumer's compute: the
+                                consumer blocked for < 25% of the wall
+                                time since the previous hand-out (an
+                                instantaneous queue probe instead would
+                                read false whenever the producer's
+                                device_put lands just-in-time — e.g. a
+                                CPU mesh whose copies contend with the
+                                step for the same cores — even though the
+                                fetch latency WAS hidden). Only real
+                                batches count; the end-of-stream sentinel
+                                or a forwarded exception never does.
 """
 from __future__ import annotations
 
 import queue
 import threading
+import time
 
 import numpy as np
 
@@ -33,17 +51,25 @@ _DONE = object()
 
 
 def _device_put_tree(obj, device=None):
-    """jax.device_put every array leaf, preserving the batch structure."""
+    """jax.device_put every array leaf, preserving the batch structure.
+
+    `device` may be None, a Device, a Sharding, or a callable resolving a
+    per-leaf placement (a leaf's target sharding depends on its rank).
+    """
     import jax
+
+    def target(x):
+        return device(x) if callable(device) else device
 
     def put(x):
         if isinstance(x, Tensor):
-            return Tensor(jax.device_put(x._value, device),
+            return Tensor(jax.device_put(x._value, target(x._value)),
                           stop_gradient=x.stop_gradient)
         if isinstance(x, (np.ndarray, np.generic)):
-            return Tensor(jax.device_put(np.asarray(x), device))
+            arr = np.asarray(x)
+            return Tensor(jax.device_put(arr, target(arr)))
         if isinstance(x, jax.Array):
-            return jax.device_put(x, device)
+            return jax.device_put(x, target(x))
         if isinstance(x, dict):
             return {k: put(v) for k, v in x.items()}
         if isinstance(x, (list, tuple)):
@@ -68,7 +94,16 @@ class DeviceFeeder:
         self.device = device
 
     def __len__(self):
-        return len(self.loader)
+        # delegate without assuming the source sized itself: generators
+        # have no __len__, and a DataLoader over an IterableDataset raises
+        # TypeError from its own — both must surface as TypeError so
+        # callers probing with try/except fall back to countless mode
+        n = getattr(self.loader, "__len__", None)
+        if n is None:
+            raise TypeError(
+                f"{type(self.loader).__name__} loader has no __len__; "
+                "iterate the feeder instead of sizing it")
+        return n()
 
     def __iter__(self):
         q: "queue.Queue" = queue.Queue(maxsize=self.depth)
@@ -82,11 +117,11 @@ class DeviceFeeder:
                         batch = next(it)
                     except StopIteration:
                         break
-                    staged = _device_put_tree(batch, self.device)
+                    item = _device_put_tree(batch, self.device)
                     # bounded put that stays responsive to consumer exit
                     while not stop.is_set():
                         try:
-                            q.put(staged, timeout=0.1)
+                            q.put(item, timeout=0.1)
                             break
                         except queue.Full:
                             continue
@@ -117,18 +152,25 @@ class DeviceFeeder:
                              name="paddle_tpu-device-feeder")
         t.start()
         try:
+            last = time.perf_counter()
             while True:
-                staged_ahead = not q.empty()
+                t0 = time.perf_counter()
                 item = q.get()
+                now = time.perf_counter()
                 if item is _DONE:
                     return
                 if isinstance(item, BaseException):
                     raise item
-                if staged_ahead:
-                    # this batch was staged while the last one computed —
-                    # only real batches count, not the sentinel/exceptions
+                # overlap = the producer hid this batch's staging behind
+                # the consumer's compute: the consumer's blocking wait is
+                # a small fraction of the inter-hand-out wall time. (The
+                # first hand-out has nothing to hide behind: wait ==
+                # elapsed, so it never counts.)
+                wait, elapsed = now - t0, now - last
+                if wait < 0.25 * elapsed:
                     STAT_ADD("STAT_device_feeder_overlap")
                 STAT_ADD("STAT_device_feeder_batches")
+                last = now
                 yield item
         finally:
             stop.set()

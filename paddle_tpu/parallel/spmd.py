@@ -28,11 +28,12 @@ from jax.sharding import NamedSharding, PartitionSpec
 
 from ..framework import random as frandom
 from ..framework.functional import functionalize, get_buffers, get_params
+from ..framework.monitor import STAT_ADD
 from ..framework.tensor import Tensor
 from .mesh import get_mesh
 
 __all__ = ["param_sharding", "zero_sharding", "batch_sharding",
-           "make_sharded_train_step", "shard_params"]
+           "batch_placement", "make_sharded_train_step", "shard_params"]
 
 
 def _spec_of(param) -> PartitionSpec:
@@ -106,6 +107,61 @@ def batch_sharding(ndim, mesh=None, dp_axis="dp", sp_axis=None,
             and ndim > seq_dim:
         spec[seq_dim] = sp_axis
     return NamedSharding(mesh, PartitionSpec(*spec))
+
+
+def batch_placement(mesh=None, dp_axis="dp", sp_axis=None, seq_dim=1):
+    """Per-leaf placement callable for io.DeviceFeeder: leaf -> the
+    NamedSharding a training batch of that rank gets on `mesh`.
+
+    Handing this to the feeder moves the batch split/upload onto the
+    feeder thread, so the sharded train step receives arrays already in
+    their dp/sp layout and skips its synchronous per-step device_put
+    (the step's pre-placed fast path below). Every leaf — labels
+    included — gets the same policy; GSPMD reshards inside the step if
+    the computation wants a different layout.
+
+    A dimension that does not divide its mesh axis is left unsharded
+    (jax.device_put hard-fails on uneven shards). A leaf with no
+    shardable dimension at all — e.g. the raw drop_last=False tail
+    batch before Model.fit pads it — returns None: it stays on the
+    default device and the step (or the padded re-placement) lays it
+    out once it is even.
+    """
+    mesh = mesh or get_mesh()
+
+    def place(x):
+        sh = batch_sharding(np.ndim(x), mesh, dp_axis, sp_axis, seq_dim)
+        shape = np.shape(x)
+        spec = []
+        for d, a in enumerate(tuple(sh.spec)):
+            if a is not None and shape[d] % mesh.shape[a] != 0:
+                a = None
+            spec.append(a)
+        if not any(s is not None for s in spec):
+            return None
+        return NamedSharding(mesh, PartitionSpec(*spec))
+
+    return place
+
+
+def _place_batch(x, mesh, dp_axis, sp_axis):
+    """Lay one batch leaf onto the mesh — unless the feeder already did.
+
+    An array that is committed to a NamedSharding on this mesh is consumed
+    as-is (zero re-placement; STAT_sharded_batch_puts stays flat), which is
+    what makes the sharding-aware DeviceFeeder a true overlap instead of a
+    double transfer.
+    """
+    v = x._value if isinstance(x, Tensor) else x
+    if not isinstance(v, jax.Array):
+        v = jnp.asarray(v)
+    sh = getattr(v, "sharding", None)
+    if isinstance(sh, NamedSharding) and sh.mesh == mesh and \
+            getattr(v, "committed", False):
+        return v
+    STAT_ADD("STAT_sharded_batch_puts")
+    return jax.device_put(v, batch_sharding(np.ndim(v), mesh, dp_axis,
+                                            sp_axis))
 
 
 def shard_params(layer, mesh=None):
@@ -214,20 +270,10 @@ def make_sharded_train_step(layer, optimizer, loss_fn: Callable,
         state["dgc"] = dgc_state
 
     def step(state, inputs, labels, lr=None, rng=None):
-        inputs = tuple(
-            jax.device_put(x._value if isinstance(x, Tensor) else
-                           jnp.asarray(x),
-                           batch_sharding(np.ndim(
-                               x._value if isinstance(x, Tensor) else x),
-                               mesh, dp_axis, sp_axis))
-            for x in inputs)
-        labels = tuple(
-            jax.device_put(x._value if isinstance(x, Tensor) else
-                           jnp.asarray(x),
-                           batch_sharding(np.ndim(
-                               x._value if isinstance(x, Tensor) else x),
-                               mesh, dp_axis, None))
-            for x in labels)
+        inputs = tuple(_place_batch(x, mesh, dp_axis, sp_axis)
+                       for x in inputs)
+        labels = tuple(_place_batch(x, mesh, dp_axis, None)
+                       for x in labels)
         lr = jnp.asarray(optimizer.get_lr() if lr is None else lr,
                          "float32")
         rng = rng if rng is not None else frandom.get_rng_key()

@@ -1,0 +1,504 @@
+"""Input-pipeline perf semantics (ISSUE 4): sharding-aware DeviceFeeder,
+device-resident sharded carry, tail-batch bucketing, and the DataLoader
+shared-memory slot ring.
+
+CPU-checkable contracts for the perf work: feeder leaves land in the
+requested NamedSharding and the sharded step does zero re-placement,
+the padded tail's masked loss isolates the real rows bitwise (within one
+compiled shape — cross-shape bit-identity is not an XLA guarantee),
+drop_last=False costs exactly one train-step compile per epoch, the shm
+ring maps a fixed number of segments no matter how long the epoch runs,
+and the fleet fit loop writes the carry back once per epoch, not once
+per step.
+"""
+import numpy as np
+import pytest
+
+import jax
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+from paddle_tpu.framework.monitor import stat_get, stat_reset
+from paddle_tpu.io import DataLoader, Dataset, DeviceFeeder, IterableDataset, \
+    TensorDataset
+from paddle_tpu.parallel import batch_placement, create_mesh, \
+    make_sharded_train_step, mesh_scope, set_mesh
+
+
+def _toy(n=128, dim=8, classes=3, seed=0):
+    rng = np.random.RandomState(seed)
+    centers = rng.randn(classes, dim).astype("float32") * 3
+    y = rng.randint(0, classes, n)
+    x = (centers[y] + rng.randn(n, dim)).astype("float32")
+    return x, y.astype("int64")
+
+
+def _toy_model(dim=8, classes=3, lr=0.01, seed=0, loss=None):
+    paddle.seed(seed)
+    net = nn.Sequential(nn.Linear(dim, 16), nn.ReLU(),
+                        nn.Linear(16, classes))
+    model = paddle.Model(net)
+    model.prepare(paddle.optimizer.Adam(lr, parameters=net.parameters()),
+                  loss if loss is not None else nn.CrossEntropyLoss())
+    # pin the single-process path; earlier tests may have left fleet/mesh
+    # globals initialized
+    model._dist_ctx = None
+    return model, net
+
+
+@pytest.fixture
+def tail_flag():
+    prev = paddle.get_flags(["FLAGS_train_tail_bucketing"])
+    yield
+    paddle.set_flags(prev)
+
+
+@pytest.fixture
+def clean_mesh():
+    yield
+    set_mesh(None)
+
+
+# ---------------------------------------------------------------------------
+# sharding-aware DeviceFeeder
+# ---------------------------------------------------------------------------
+
+def test_feeder_places_leaves_with_requested_sharding(clean_mesh):
+    mesh = create_mesh({"dp": 8})
+    place = batch_placement(mesh)
+    batches = [[np.ones((16, 4), "float32") * i,
+                np.arange(16, dtype="int64")] for i in range(3)]
+    out = list(DeviceFeeder(batches, device=place))
+    assert len(out) == 3
+    want2d = NamedSharding(mesh, P("dp", None))
+    want1d = NamedSharding(mesh, P("dp"))
+    for i, (xb, yb) in enumerate(out):
+        assert xb._value.sharding == want2d
+        assert yb._value.sharding == want1d
+        np.testing.assert_array_equal(np.asarray(xb._value),
+                                      batches[i][0])
+
+
+def test_sharded_step_consumes_preplaced_batches_without_reput(clean_mesh):
+    """A feeder-placed batch must ride into the pjit step as-is: zero
+    device_put re-placements (STAT_sharded_batch_puts stays flat), and
+    the loss matches the host-array path exactly."""
+    rng = np.random.RandomState(0)
+    x = rng.randn(16, 8).astype("float32")
+    y = rng.randint(0, 4, 16).astype("int64")
+
+    def loss_fn(outs, labels):
+        out = outs[0] if isinstance(outs, (list, tuple)) else outs
+        return nn.CrossEntropyLoss()(out, labels[0])
+
+    with mesh_scope(create_mesh({"dp": 8})) as mesh:
+        paddle.seed(7)
+        net = nn.Sequential(nn.Linear(8, 16), nn.ReLU(), nn.Linear(16, 4))
+        opt = paddle.optimizer.Momentum(0.1, parameters=net.parameters())
+        step, state = make_sharded_train_step(net, opt, loss_fn)
+
+        # host-array path: the step itself places inputs + labels
+        stat_reset("STAT_sharded_batch_puts")
+        state, lv_host = step(state, (x,), (y,), rng=jax.random.PRNGKey(0))
+        assert stat_get("STAT_sharded_batch_puts") == 2
+
+        # feeder-placed path: committed NamedShardings on this mesh
+        (xb, yb), = list(DeviceFeeder([[x, y]],
+                                      device=batch_placement(mesh)))
+        stat_reset("STAT_sharded_batch_puts")
+        state, lv_fed = step(state, (xb._value,), (yb._value,),
+                             rng=jax.random.PRNGKey(0))
+        assert stat_get("STAT_sharded_batch_puts") == 0
+        assert np.isfinite(float(lv_fed))
+
+
+def test_feeder_len_delegates_and_raises_for_generators():
+    x, y = _toy(32)
+    dl = DataLoader(TensorDataset([x, y]), batch_size=8)
+    assert len(DeviceFeeder(dl)) == 4
+
+    def gen():
+        yield [x[:8], y[:8]]
+
+    with pytest.raises(TypeError):
+        len(DeviceFeeder(gen()))
+
+
+def test_fit_over_generator_and_iterable_dataset():
+    """Countless mode: fit must run over loaders with no __len__."""
+    x, y = _toy(40)
+    model, _ = _toy_model()
+
+    def gen():
+        for i in range(5):
+            yield [x[i * 8:(i + 1) * 8], y[i * 8:(i + 1) * 8]]
+
+    stat_reset("STAT_train_steps")
+    model.fit(gen(), epochs=1, verbose=0)
+    assert stat_get("STAT_train_steps") == 5
+
+    class Stream(IterableDataset):
+        def __iter__(self):
+            for i in range(20):
+                yield x[i], y[i]
+
+    loader = DataLoader(Stream(), batch_size=8)  # len() raises TypeError
+    model2, net2 = _toy_model(seed=1)
+    stat_reset("STAT_train_steps")
+    model2.fit(loader, epochs=1, verbose=0)
+    assert stat_get("STAT_train_steps") == 3  # 8 + 8 + tail 4
+    assert np.isfinite(net2[0].weight.numpy()).all()
+
+
+def test_feeder_overlap_counts_only_real_batches():
+    stat_reset("STAT_device_feeder_overlap")
+    stat_reset("STAT_device_feeder_batches")
+    assert list(DeviceFeeder([])) == []
+    assert stat_get("STAT_device_feeder_overlap") == 0
+    assert stat_get("STAT_device_feeder_batches") == 0
+
+    def boom():
+        raise RuntimeError("dead source")
+        yield  # pragma: no cover
+
+    with pytest.raises(RuntimeError, match="dead source"):
+        list(DeviceFeeder(boom()))
+    # the forwarded exception raced into the queue but is not a batch
+    assert stat_get("STAT_device_feeder_overlap") == 0
+    assert stat_get("STAT_device_feeder_batches") == 0
+
+
+# ---------------------------------------------------------------------------
+# tail-batch bucketing
+# ---------------------------------------------------------------------------
+
+def test_masked_tail_matches_unpadded_and_isolates_real_rows(tail_flag):
+    paddle.set_flags({"FLAGS_train_tail_bucketing": True})
+    x, y = _toy(8)
+    nreal, full = 5, 8
+    mask = np.zeros((full,), "float32")
+    mask[:nreal] = 1.0
+
+    # (a) value parity: masked padded loss == unpadded loss on the real
+    # rows (different compiled shapes -> allclose, not bitwise; the
+    # within-one-shape caveat is pinned by (b))
+    model_u, _ = _toy_model(seed=3)
+    lv_u, _ = model_u.train_batch([x[:nreal]], [y[:nreal]])
+    model_p, net_p = _toy_model(seed=3)
+    xp = np.concatenate([x[:nreal], np.repeat(x[nreal - 1:nreal], 3, 0)])
+    yp = np.concatenate([y[:nreal], np.repeat(y[nreal - 1:nreal], 3)])
+    lv_p, _ = model_p.train_batch([xp], [yp], loss_mask=mask)
+    np.testing.assert_allclose(float(lv_u[0]), float(lv_p[0]),
+                               rtol=1e-6, atol=1e-7)
+
+    # (b) bitwise within one compiled shape: what rides the pad rows is
+    # irrelevant — loss AND the updated weights are bit-identical
+    model_q, net_q = _toy_model(seed=3)
+    xq = np.concatenate([x[:nreal], np.repeat(x[:1], 3, 0) * 7.5])
+    yq = np.concatenate([y[:nreal], np.repeat(y[:1], 3)])
+    lv_q, _ = model_q.train_batch([xq], [yq], loss_mask=mask)
+    assert float(lv_p[0]) == float(lv_q[0])
+    np.testing.assert_array_equal(net_p[0].weight.numpy(),
+                                  net_q[0].weight.numpy())
+
+
+def test_fit_drop_last_false_compiles_once_per_epoch(tail_flag):
+    paddle.set_flags({"FLAGS_train_tail_bucketing": True})
+    x, y = _toy(70)  # bs 16 -> 4 full batches + a 6-row tail
+    model, net = _toy_model()
+    stat_reset("STAT_train_step_compiles")
+    stat_reset("STAT_tail_pad_batches")
+    stat_reset("STAT_tail_pad_compiles_avoided")
+    model.fit(TensorDataset([x, y]), batch_size=16, epochs=2, verbose=0,
+              shuffle=False, drop_last=False)
+    assert stat_get("STAT_train_step_compiles") == 1
+    assert stat_get("STAT_tail_pad_batches") == 2  # one tail per epoch
+    assert stat_get("STAT_tail_pad_compiles_avoided") == 2
+    assert np.isfinite(net[0].weight.numpy()).all()
+
+    # flag off restores the old two-compiles behavior
+    paddle.set_flags({"FLAGS_train_tail_bucketing": False})
+    model2, _ = _toy_model(seed=2)
+    stat_reset("STAT_train_step_compiles")
+    model2.fit(TensorDataset([x, y]), batch_size=16, epochs=1, verbose=0,
+               shuffle=False, drop_last=False)
+    assert stat_get("STAT_train_step_compiles") == 2
+
+
+def test_tail_bucketing_training_matches_unpadded(tail_flag):
+    """End-to-end numerics: a fit over a tailed dataset converges to the
+    same weights whether the tail is padded+masked or compiled unpadded."""
+    x, y = _toy(40)  # bs 16 -> 2 full + 8-row tail
+
+    def run(flag_on, seed=11):
+        paddle.set_flags({"FLAGS_train_tail_bucketing": flag_on})
+        model, net = _toy_model(seed=seed)
+        model.fit(TensorDataset([x, y]), batch_size=16, epochs=3,
+                  verbose=0, shuffle=False, drop_last=False)
+        return net[0].weight.numpy().copy()
+
+    np.testing.assert_allclose(run(True), run(False), rtol=1e-5,
+                               atol=1e-6)
+
+
+def test_tail_mask_fallback_for_scalar_loss(tail_flag):
+    """A loss that only yields a scalar cannot fold the row mask: the
+    model warns once, reruns the real rows unpadded, and keeps training
+    (one extra compile for the tail shape — the old behavior)."""
+    paddle.set_flags({"FLAGS_train_tail_bucketing": True})
+    import paddle_tpu.nn.functional as F
+    x, _ = _toy(24)
+    t = np.tanh(x[:, :3]).astype("float32")
+
+    def scalar_loss(out, label):
+        return F.mse_loss(out, label)  # reduction='mean' baked in: scalar
+
+    model, net = _toy_model(loss=scalar_loss)
+    stat_reset("STAT_train_step_compiles")
+    with pytest.warns(UserWarning, match="per-row"):
+        model.fit(TensorDataset([x, t]), batch_size=16, epochs=1,
+                  verbose=0, shuffle=False, drop_last=False)
+    assert model._tail_maskable is False
+    assert stat_get("STAT_train_step_compiles") == 2  # full + tail shapes
+    assert np.isfinite(net[0].weight.numpy()).all()
+
+
+def test_hole_mask_fallback_trains_on_exactly_the_real_rows(tail_flag):
+    """loss_mask is public and may have holes: the scalar-loss fallback
+    must rerun the rows the mask selects, not the first popcount rows."""
+    paddle.set_flags({"FLAGS_train_tail_bucketing": True})
+    import paddle_tpu.nn.functional as F
+    x, _ = _toy(8)
+    t = np.tanh(x[:, :3]).astype("float32")
+
+    def scalar_loss(out, label):
+        return F.mse_loss(out, label)
+
+    mask = np.array([1, 0, 1, 1, 0, 1, 0, 0], "float32")
+    sel = np.flatnonzero(mask)
+    with pytest.warns(UserWarning, match="per-row"):
+        m_a, net_a = _toy_model(seed=7, loss=scalar_loss)
+        m_a.train_batch([x], [t], loss_mask=mask)
+    m_b, net_b = _toy_model(seed=7, loss=scalar_loss)
+    m_b.train_batch([x[sel]], [t[sel]])
+    np.testing.assert_array_equal(net_a[0].weight.numpy(),
+                                  net_b[0].weight.numpy())
+
+
+def test_predict_still_pads_after_mask_fallback(tail_flag):
+    """predict has no loss, so a loss that refused the row mask must not
+    cost predict its tail padding (one executable, rows sliced off)."""
+    paddle.set_flags({"FLAGS_train_tail_bucketing": True})
+    import paddle_tpu.nn.functional as F
+    x, _ = _toy(20)
+    t = np.tanh(x[:, :3]).astype("float32")
+    model, _ = _toy_model(loss=lambda o, l: F.mse_loss(o, l))
+    with pytest.warns(UserWarning, match="per-row"):
+        model.fit(TensorDataset([x, t]), batch_size=16, epochs=1,
+                  verbose=0, shuffle=False)
+    assert model._tail_maskable is False
+    out = model.predict(TensorDataset([x]), batch_size=8,
+                        stack_outputs=True, verbose=0)
+    assert out.shape[0] == 20
+    assert len(model._pred_step_cache) == 1
+
+
+def test_eval_and_predict_share_the_padded_shape(tail_flag):
+    paddle.set_flags({"FLAGS_train_tail_bucketing": True})
+    x, y = _toy(20)
+    model, _ = _toy_model()
+    logs = model.evaluate(TensorDataset([x, y]), batch_size=8, verbose=0)
+    assert np.isfinite(logs["loss"])
+    assert len(model._eval_step_cache) == 1  # tail reused the 8-row entry
+
+    out = model.predict(TensorDataset([x]), batch_size=8,
+                        stack_outputs=True, verbose=0)
+    assert out.shape[0] == 20  # padded rows never reach the caller
+    assert len(model._pred_step_cache) == 1
+
+
+def test_eval_masked_loss_matches_unpadded(tail_flag):
+    x, y = _toy(20)
+    paddle.set_flags({"FLAGS_train_tail_bucketing": True})
+    model, _ = _toy_model(seed=5)
+    padded = model.evaluate(TensorDataset([x, y]), batch_size=8,
+                            verbose=0)["loss"]
+    paddle.set_flags({"FLAGS_train_tail_bucketing": False})
+    model2, _ = _toy_model(seed=5)
+    plain = model2.evaluate(TensorDataset([x, y]), batch_size=8,
+                            verbose=0)["loss"]
+    np.testing.assert_allclose(padded, plain, rtol=1e-6, atol=1e-7)
+
+
+# ---------------------------------------------------------------------------
+# shared-memory slot ring
+# ---------------------------------------------------------------------------
+
+class _ArrayDataset(Dataset):
+    def __init__(self, n=256, dim=16):
+        rng = np.random.RandomState(3)
+        self.x = rng.standard_normal((n, dim)).astype(np.float32)
+        self.y = rng.randint(0, 10, size=(n,)).astype(np.int64)
+
+    def __len__(self):
+        return len(self.x)
+
+    def __getitem__(self, i):
+        return self.x[i], self.y[i]
+
+
+def test_shm_ring_segment_count_constant_across_long_epoch():
+    ds = _ArrayDataset(n=256)
+    loader = DataLoader(ds, batch_size=4, num_workers=2, shuffle=False,
+                        prefetch_factor=2)  # ring of 4 slots, 64 batches
+    stat_reset("STAT_shm_slot_segments")
+    stat_reset("STAT_shm_slots_reused")
+    seen = 0
+    for xb, yb in loader:
+        seen += 1
+        assert xb.numpy().shape == (4, 16)
+    assert seen == 64
+    segments = stat_get("STAT_shm_slot_segments")
+    reused = stat_get("STAT_shm_slots_reused")
+    # parent maps at most one segment per ring slot; every other batch is
+    # served from an already-mapped slot with ZERO shm syscalls
+    assert 1 <= segments <= 4
+    assert reused == seen - segments
+
+    # parity with the single-process path (data is bitwise intact
+    # through slot reuse)
+    single = list(DataLoader(ds, batch_size=4, num_workers=0,
+                             shuffle=False))
+    multi = list(DataLoader(ds, batch_size=4, num_workers=2,
+                            shuffle=False))
+    for (xs, ys), (xm, ym) in zip(single, multi):
+        np.testing.assert_array_equal(xs.numpy(), xm.numpy())
+        np.testing.assert_array_equal(ys.numpy(), ym.numpy())
+
+
+def test_shm_ring_regrows_slots_for_bigger_batches():
+    class Ragged(Dataset):
+        def __len__(self):
+            return 24
+
+        def __getitem__(self, i):
+            # later samples are larger: slots must regrow, data stays right
+            return np.full((8 * (1 + i // 8),), i, dtype=np.int64)
+
+    loader = DataLoader(Ragged(), batch_size=4, num_workers=2,
+                        shuffle=False,
+                        collate_fn=lambda b: np.concatenate(b))
+    out = list(loader)
+    assert len(out) == 6
+    for j, t in enumerate(out):
+        arr = t.numpy()
+        want = np.concatenate([np.full((8 * (1 + i // 8),), i, np.int64)
+                               for i in range(j * 4, j * 4 + 4)])
+        np.testing.assert_array_equal(arr, want)
+
+
+# ---------------------------------------------------------------------------
+# device-resident sharded carry
+# ---------------------------------------------------------------------------
+
+def _fleet_model(x_dim=8, classes=4, lr=0.01, seed=3):
+    from paddle_tpu.distributed import fleet
+    strategy = fleet.DistributedStrategy()
+    strategy.sharding = True
+    strategy.hybrid_configs = {"dp_degree": 8}
+    fleet.init(is_collective=True, strategy=strategy)
+    paddle.seed(seed)
+    net = nn.Sequential(nn.Linear(x_dim, 16), nn.ReLU(),
+                        nn.Linear(16, classes))
+    model = paddle.Model(net)
+    opt = fleet.distributed_optimizer(
+        paddle.optimizer.Adam(lr, parameters=net.parameters()))
+    model.prepare(opt, nn.CrossEntropyLoss())
+    assert model._dist_ctx is not None
+    return model, net
+
+
+def test_sharded_fit_syncs_carry_once_per_epoch(clean_mesh, tail_flag):
+    paddle.set_flags({"FLAGS_train_tail_bucketing": True})
+    rng = np.random.RandomState(3)
+    x = rng.randn(72, 8).astype("float32")  # bs 16 -> 4 full + 8-row tail
+    y = rng.randint(0, 4, 72).astype("int64")
+    model, net = _fleet_model()
+    w0 = net[0].weight.numpy().copy()
+    stat_reset("STAT_sharded_carry_syncs")
+    stat_reset("STAT_train_steps")
+    model.fit(TensorDataset([x, y]), batch_size=16, epochs=2, verbose=0,
+              shuffle=False, drop_last=False)
+    assert stat_get("STAT_train_steps") == 10  # 5 batches x 2 epochs
+    # ONE write_back per epoch — not one per step
+    assert stat_get("STAT_sharded_carry_syncs") == 2
+    assert model._sharded_dirty is False
+    w1 = net[0].weight.numpy()
+    assert np.isfinite(w1).all()
+    assert not np.allclose(w0, w1)
+
+
+def test_sharded_standalone_train_batch_writes_back(clean_mesh):
+    rng = np.random.RandomState(5)
+    x = rng.randn(16, 8).astype("float32")
+    y = rng.randint(0, 4, 16).astype("int64")
+    model, net = _fleet_model(seed=5)
+    stat_reset("STAT_sharded_carry_syncs")
+    model.train_batch([x], [y])
+    # outside fit the public contract holds: Tensors are fresh per call
+    assert stat_get("STAT_sharded_carry_syncs") == 1
+    assert model._sharded_dirty is False
+    out = net(paddle.to_tensor(x[:4]))
+    assert np.isfinite(out.numpy()).all()
+
+
+def test_sharded_fit_with_dp_indivisible_tail(clean_mesh, tail_flag):
+    """The buffered feeder must not crash placing a raw tail batch whose
+    rows don't divide dp (jax.device_put hard-fails on uneven shards):
+    batch_placement leaves such leaves unplaced, fit pads them to the
+    full (divisible) batch, and the step lays them out."""
+    paddle.set_flags({"FLAGS_train_tail_bucketing": True})
+    rng = np.random.RandomState(11)
+    x = rng.randn(68, 8).astype("float32")  # bs 16 -> 4 full + 4-row tail
+    y = rng.randint(0, 4, 68).astype("int64")
+    model, net = _fleet_model(seed=11)
+    loader = DataLoader(TensorDataset([x, y]), batch_size=16,
+                        shuffle=False)  # buffered feeder engaged
+    model.fit(loader, epochs=1, verbose=0)
+    assert np.isfinite(net[0].weight.numpy()).all()
+    out = model.predict(TensorDataset([x]), batch_size=16,
+                        stack_outputs=True, verbose=0)
+    assert out.shape[0] == 68
+
+
+def test_no_tail_dataset_keeps_the_maskless_step(tail_flag):
+    """Datasets whose epochs cannot produce a partial batch must keep
+    the exact pre-bucketing step (no mask in the signature): the masked
+    reduction is only paid where a tail can actually occur."""
+    paddle.set_flags({"FLAGS_train_tail_bucketing": True})
+    x, y = _toy(64)  # bs 16 -> 4 full batches, no tail possible
+    model, _ = _toy_model()
+    stat_reset("STAT_tail_pad_batches")
+    model.fit(TensorDataset([x, y]), batch_size=16, epochs=1, verbose=0,
+              shuffle=False)
+    assert stat_get("STAT_tail_pad_batches") == 0
+    # the compiled step's signature carried no mask
+    ((_, _, _, mask_sig),) = list(model._train_step_cache.keys())
+    assert mask_sig is None
+
+
+def test_sharded_fit_with_buffered_feeder_skips_step_puts(clean_mesh):
+    """The fit loop's DeviceFeeder carries the fleet batch placement, so
+    the steady-state sharded step does zero input re-placements (the
+    once-per-epoch padded tail and its mask are the only puts)."""
+    rng = np.random.RandomState(9)
+    x = rng.randn(64, 8).astype("float32")  # bs 16 -> 4 full batches
+    y = rng.randint(0, 4, 64).astype("int64")
+    model, net = _fleet_model(seed=9)
+    loader = DataLoader(TensorDataset([x, y]), batch_size=16,
+                        shuffle=False)  # use_buffer_reader defaults on
+    stat_reset("STAT_sharded_batch_puts")
+    model.fit(loader, epochs=1, verbose=0)
+    assert stat_get("STAT_sharded_batch_puts") == 0
+    assert np.isfinite(net[0].weight.numpy()).all()
