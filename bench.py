@@ -880,7 +880,29 @@ def _with_retries(fn, attempts=3, cooldown_s=20):
     raise last
 
 
-def main(mode="train", backend=None):
+def main(mode="train", backend=None, metrics_port=None, trace=None):
+    """Run one bench mode, optionally observable from outside: a live
+    /metrics//stats//trace HTTP surface while the bench runs, and a
+    chrome trace of the whole run written on exit."""
+    prof = None
+    if metrics_port is not None:
+        from paddle_tpu.profiler import exporter
+        srv = exporter.start_metrics_server(int(metrics_port))
+        if srv is not None:
+            sys.stderr.write(f"metrics server: {srv.url}/metrics "
+                             f"(also /stats, /trace)\n")
+    if trace:
+        from paddle_tpu import profiler as prof
+        prof.start_profiler()
+    try:
+        _run_mode(mode=mode, backend=backend)
+    finally:
+        if prof is not None:
+            prof.stop_profiler(profile_path=trace)
+            sys.stderr.write(f"chrome trace: {trace}\n")
+
+
+def _run_mode(mode="train", backend=None):
     headline = {"serving": "serving_engine_qps_64_submitters",
                 "input": "input_pipeline_sharded_buffered_steps_per_sec"}\
         .get(mode, _HEADLINE)
@@ -1034,5 +1056,15 @@ if __name__ == "__main__":
                          "scrub the env; a pinned backend that fails to "
                          "init fails FAST (one attempt) instead of the "
                          "full retry loop")
+    ap.add_argument("--metrics-port", type=int, default=None,
+                    help="serve /metrics (Prometheus), /stats (JSON) and "
+                         "/trace (chrome trace) on 127.0.0.1:<port> while "
+                         "the bench runs (0 = ephemeral port, printed on "
+                         "stderr)")
+    ap.add_argument("--trace", default=None, metavar="PATH",
+                    help="write a chrome://tracing file of the whole run "
+                         "(per-thread tracks: fit loop, DeviceFeeder, "
+                         "serving collector/lanes, plus counter tracks)")
     args = ap.parse_args()
-    main(mode=args.mode, backend=args.backend)
+    main(mode=args.mode, backend=args.backend,
+         metrics_port=args.metrics_port, trace=args.trace)

@@ -102,6 +102,35 @@ register_flag("FLAGS_serving_request_timeout_ms", 30000.0,
               "enforced while queued AND again at completion — a request "
               "that expired while its batch was on-device fails with "
               "ExecutionTimeoutError, never a late result (0 disables)")
+register_flag("FLAGS_trace_ring_size", 16384,
+              "profiler.tracer: per-thread trace event ring capacity; the "
+              "ring overwrites its oldest events instead of growing, so "
+              "trace memory stays bounded under serving soak runs")
+register_flag("FLAGS_flight_recorder", True,
+              "always-on bounded crash context: RecordEvent scopes keep "
+              "recording into the per-thread rings even with the profiler "
+              "stopped, and the hardened failure paths (serving lane "
+              "death, poisoned-batch retry, poisoned donated carry, "
+              "DataLoader worker crash) dump a postmortem JSON artifact "
+              "(profiler/flight_recorder.py)")
+register_flag("FLAGS_flight_recorder_events", 512,
+              "how many trailing trace events a flight-recorder dump "
+              "includes (the tail of the merged per-thread rings)")
+register_flag("FLAGS_flight_recorder_dir", "",
+              "directory for flight-recorder dump files; '' = "
+              "<tempdir>/paddle_tpu_flightrec")
+register_flag("FLAGS_flight_recorder_interval_s", 2.0,
+              "period of the flight recorder's background counter "
+              "sampler (the periodic monitor snapshots that give a dump "
+              "its recent-counters timeline); 0 disables the sampler")
+register_flag("FLAGS_flight_recorder_max_dumps", 16,
+              "most dump files kept per process; the oldest is pruned "
+              "so a crash-looping failure path cannot fill the disk")
+register_flag("FLAGS_metrics_port", 0,
+              "profiler.exporter.MetricsServer port: serve /metrics "
+              "(Prometheus text), /stats (JSON incl. engine lanes) and "
+              "/trace (chrome trace) on 127.0.0.1; 0 = off; engines "
+              "also accept InferenceEngine(metrics_port=)")
 
 
 def set_flags(flags: Dict[str, Any]) -> None:

@@ -17,7 +17,7 @@ from typing import Dict
 __all__ = ["StatValue", "stat_add", "stat_sub", "stat_reset", "stat_get",
            "all_stats", "stat_time", "STAT_ADD", "STAT_SUB", "STAT_RESET",
            "StatHistogram", "histogram", "all_histograms",
-           "reset_all_stats"]
+           "registered_histograms", "reset_all_stats"]
 
 
 class StatValue:
@@ -119,6 +119,22 @@ class StatHistogram:
     def sum(self) -> float:
         return self._sum
 
+    def buckets(self):
+        """Cumulative histogram as `[(upper_bound, cumulative_count)]`,
+        ending with `(inf, count)` — exactly the shape a Prometheus
+        `_bucket{le=...}` series wants (log-spaced bounds map one-to-one
+        onto `le` labels; see profiler/exporter.py)."""
+        with self._lock:
+            counts = list(self._counts)
+        out = []
+        cum = 0
+        for i, c in enumerate(counts):
+            cum += c
+            le = (self._MIN * self._BASE ** i if i <= self._NBUCKETS
+                  else float("inf"))
+            out.append((le, cum))
+        return out
+
     def mean(self) -> float:
         return self._sum / self._count if self._count else 0.0
 
@@ -161,10 +177,21 @@ class _Registry:
         return h
 
     def snapshot(self) -> Dict[str, int]:
-        return {n: s.get() for n, s in sorted(self._stats.items())}
+        # one consistent pass: the registry lock freezes the NAME SET so
+        # a concurrent get-or-create can't resize the dict mid-iteration
+        # (values are single atomic int reads and need no per-stat lock)
+        with self._lock:
+            items = sorted(self._stats.items())
+        return {n: s.get() for n, s in items}
 
     def snapshot_hists(self) -> Dict[str, Dict[str, float]]:
-        return {n: h.snapshot() for n, h in sorted(self._hists.items())}
+        with self._lock:
+            items = sorted(self._hists.items())
+        return {n: h.snapshot() for n, h in items}
+
+    def histograms(self) -> Dict[str, StatHistogram]:
+        with self._lock:
+            return dict(self._hists)
 
     def reset_all(self) -> None:
         with self._lock:
@@ -217,6 +244,12 @@ def histogram(name: str) -> StatHistogram:
 def all_histograms() -> Dict[str, Dict[str, float]]:
     """Snapshot {name: {count, mean, p50, p99, max}} of every histogram."""
     return _registry.snapshot_hists()
+
+
+def registered_histograms() -> Dict[str, StatHistogram]:
+    """The live histogram objects (the Prometheus exporter renders
+    `buckets()`/`sum`/`count` directly rather than via snapshots)."""
+    return _registry.histograms()
 
 
 @contextlib.contextmanager

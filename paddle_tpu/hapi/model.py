@@ -81,6 +81,7 @@ from ..framework.tensor import Tensor
 from ..io import DataLoader, Dataset
 from ..io.device_loader import DeviceFeeder
 from ..metric import Metric
+from ..profiler import RecordEvent, flight_recorder
 from . import callbacks as cbks_mod
 
 __all__ = ["Model"]
@@ -352,7 +353,7 @@ class Model:
         if validate:
             try:
                 jax.block_until_ready(jax.tree_util.tree_leaves(carry))
-            except Exception:
+            except Exception as e:
                 # device-side failure only (XLA runtime errors are
                 # Exception subclasses): drop the poisoned carry.
                 # KeyboardInterrupt/SystemExit propagate with the carry
@@ -360,6 +361,12 @@ class Model:
                 # _sync_carry() still writes it back.
                 self._train_carry = None
                 self._opt_state = None  # rode the same poisoned step
+                # the raised error says WHAT failed; the flight record
+                # keeps the step/feeder timeline + counters around WHEN
+                flight_recorder.dump("poisoned_carry", {
+                    "error": repr(e),
+                    "donate": bool(flag("FLAGS_train_step_donate")),
+                    "train_steps": stat_get("STAT_train_steps")})
                 return
         for n, t in get_params(self.network).items():
             t._value = carry["params"][n]
@@ -383,7 +390,7 @@ class Model:
         if validate:
             try:
                 jax.block_until_ready(jax.tree_util.tree_leaves(state))
-            except Exception:
+            except Exception as e:
                 # poisoned: never write failed arrays into the Tensors.
                 # With donation off the Tensors are still healthy, so a
                 # rebuilt step can restart from them; with donation on
@@ -394,6 +401,10 @@ class Model:
                 if not getattr(self, "_sharded_donate", True) and \
                         hasattr(self, "_sharded_step"):
                     del self._sharded_step
+                flight_recorder.dump("poisoned_sharded_carry", {
+                    "error": repr(e),
+                    "donate": getattr(self, "_sharded_donate", True),
+                    "train_steps": stat_get("STAT_train_steps")})
                 return
         from ..parallel.spmd import write_back
         write_back(self.network, state)
@@ -781,6 +792,7 @@ class Model:
         logs = {}  # stays bound for on_end even with epochs=0
         feed = self._buffered(loader)
         self._in_fit = True  # keep the carry live; write back at epoch ends
+        flight_recorder.touch()  # periodic counter snapshots while training
         try:
             for epoch in range(epochs):
                 if hasattr(loader, "batch_sampler") and hasattr(
@@ -810,8 +822,13 @@ class Model:
                         nreal < len(mask)
                     c0 = (stat_get("STAT_train_step_compiles") if padded
                           else 0)
-                    loss, metrics = self.train_batch(ins, lbs,
-                                                     loss_mask=mask)
+                    # the fit loop's own track in the chrome trace: step
+                    # scopes on the main thread next to the feeder/lane
+                    # threads (dispatch wall time; device time is in the
+                    # jax.profiler trace)
+                    with RecordEvent("fit::train_step"):
+                        loss, metrics = self.train_batch(ins, lbs,
+                                                         loss_mask=mask)
                     if padded and self._dist_ctx is None and \
                             stat_get("STAT_train_step_compiles") == c0:
                         # the padded tail rode an executable some full

@@ -43,6 +43,7 @@ import numpy as np
 
 from ..framework.monitor import STAT_ADD
 from ..framework.tensor import Tensor
+from ..profiler import flight_recorder
 from .dataset import Dataset, IterableDataset
 from .sampler import BatchSampler
 
@@ -389,6 +390,7 @@ class DataLoader:
         shutdown sweeps those names only, never one name per batch."""
         ctx = mp.get_context("fork" if "fork" in mp.get_all_start_methods()
                              else "spawn")
+        flight_recorder.touch()  # crash context for worker-death raises
         nw = self.num_workers
         task_q = ctx.Queue()
         result_q = ctx.Queue()
@@ -484,16 +486,30 @@ class DataLoader:
                     except queue.Empty:
                         dead = [p.pid for p in procs if not p.is_alive()]
                         if dead:
+                            flight_recorder.dump(
+                                "dataloader_worker_crash",
+                                {"dead_pids": dead, "batch": want,
+                                 "num_workers": nw, "sent": sent,
+                                 "total": total})
                             raise RuntimeError(
                                 f"DataLoader worker(s) {dead} died while "
                                 f"batch {want} was outstanding") from None
                         if deadline and time.monotonic() > deadline:
+                            flight_recorder.dump(
+                                "dataloader_timeout",
+                                {"timeout_s": self.timeout, "batch": want,
+                                 "num_workers": nw})
                             raise RuntimeError(
                                 f"DataLoader timed out after "
                                 f"{self.timeout}s waiting for batch "
                                 f"{want}") from None
                         continue
                     if status == "err":
+                        flight_recorder.dump(
+                            "dataloader_worker_error",
+                            {"batch": int(seq), "num_workers": nw,
+                             "error": payload.splitlines()[-1]
+                             if payload else ""})
                         raise RuntimeError(
                             "DataLoader worker raised:\n" + payload)
                     pending[seq] = payload
@@ -537,7 +553,8 @@ class DataLoader:
                     results[seq] = out
                     results_lock.notify_all()
 
-        threads = [threading.Thread(target=worker, args=(i,), daemon=True)
+        threads = [threading.Thread(target=worker, args=(i,), daemon=True,
+                                    name=f"paddle_tpu-loader-w{i}")
                    for i in range(nw)]
         for t in threads:
             t.start()

@@ -44,6 +44,7 @@ import numpy as np
 
 from ..framework.monitor import STAT_ADD
 from ..framework.tensor import Tensor
+from ..profiler import RecordEvent
 
 __all__ = ["DeviceFeeder"]
 
@@ -114,10 +115,12 @@ class DeviceFeeder:
             try:
                 while not stop.is_set():
                     try:
-                        batch = next(it)
+                        with RecordEvent("feeder::fetch"):
+                            batch = next(it)
                     except StopIteration:
                         break
-                    item = _device_put_tree(batch, self.device)
+                    with RecordEvent("feeder::stage"):
+                        item = _device_put_tree(batch, self.device)
                     # bounded put that stays responsive to consumer exit
                     while not stop.is_set():
                         try:

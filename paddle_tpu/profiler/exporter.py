@@ -1,0 +1,240 @@
+"""Live metrics export surface (reference `platform/monitor.h`
+StatRegistry::publish → here rendered straight to Prometheus text, plus
+a tiny stdlib HTTP server so the process is observable from OUTSIDE —
+curl, a Prometheus scraper, or a dashboard — instead of only via
+in-process `all_stats()` calls).
+
+Endpoints (`MetricsServer`, 127.0.0.1, daemon threads, zero deps):
+
+- `/metrics` — Prometheus text: every monitor counter (`counter`, or
+  `gauge` for up-down stats like queue depth) and every
+  `StatHistogram` as a real `histogram` — the log-spaced buckets map
+  one-to-one onto cumulative `_bucket{le=...}` lines (zero-delta runs
+  coalesced), plus `_sum`/`_count`.
+- `/stats` — JSON: counters, histogram snapshots, every registered
+  `InferenceEngine.stats()` (lanes, buckets, occupancy), trace-ring and
+  flight-recorder state.
+- `/trace` — the current chrome trace (same payload
+  `export_chrome_tracing` writes), so a live timeline is one curl away.
+
+Wire-up: `InferenceEngine(metrics_port=)` / `FLAGS_metrics_port`, or
+`start_metrics_server(port)` directly (port 0 binds an ephemeral port —
+read it back from `.port`).
+"""
+from __future__ import annotations
+
+import json
+import re
+import threading
+import weakref
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Optional
+
+from ..framework import monitor
+from ..framework.flags import flag
+from . import flight_recorder, tracer
+
+__all__ = ["render_prometheus", "MetricsServer", "start_metrics_server",
+           "register_engine", "unregister_engine", "stats_payload"]
+
+_PREFIX = "paddle_tpu_"
+# up-down stats: current level, not a monotone total → Prometheus gauge
+_GAUGES = {"STAT_serving_queue_depth"}
+
+
+def _metric_name(name: str) -> str:
+    return _PREFIX + re.sub(r"[^a-zA-Z0-9_]", "_", name).lower()
+
+
+def _fmt(v: float) -> str:
+    return "+Inf" if v == float("inf") else f"{v:.6g}"
+
+
+def render_prometheus() -> str:
+    """Prometheus exposition text of every registered counter and
+    histogram (reference StatRegistry publish, Prometheus-shaped)."""
+    lines = []
+    for name, v in monitor.all_stats().items():
+        m = _metric_name(name)
+        typ = "gauge" if name in _GAUGES else "counter"
+        lines.append(f"# TYPE {m} {typ}")
+        lines.append(f"{m} {v}")
+    for name, h in sorted(monitor.registered_histograms().items()):
+        m = _metric_name(name)
+        buckets = h.buckets()          # one consistent cumulative pass
+        count = buckets[-1][1]
+        lines.append(f"# TYPE {m} histogram")
+        # sparse `le` sets are valid Prometheus, but histogram_quantile
+        # interpolates linearly across whatever gap it sees — so a run of
+        # equal cumulative counts must keep its LAST bucket (the tight
+        # lower bound of the next occupied bucket), or quantiles read up
+        # to the full run width low. Emit every change point plus the
+        # bucket immediately before it.
+        prev = None
+        last_idx = -1
+        for i, (le, cum) in enumerate(buckets[:-1]):
+            if cum != prev:
+                if i - 1 > last_idx:
+                    ple, pcum = buckets[i - 1]
+                    lines.append(f'{m}_bucket{{le="{_fmt(ple)}"}} {pcum}')
+                lines.append(f'{m}_bucket{{le="{_fmt(le)}"}} {cum}')
+                prev = cum
+                last_idx = i
+        if count != prev and last_idx < len(buckets) - 2:
+            ple, pcum = buckets[-2]
+            lines.append(f'{m}_bucket{{le="{_fmt(ple)}"}} {pcum}')
+        lines.append(f'{m}_bucket{{le="+Inf"}} {count}')
+        lines.append(f"{m}_sum {h.sum:.6g}")
+        lines.append(f"{m}_count {count}")
+    for name, v in sorted(tracer.ring_stats().items()):
+        m = f"{_PREFIX}trace_{name}"
+        lines.append(f"# TYPE {m} gauge")
+        lines.append(f"{m} {v}")
+    return "\n".join(lines) + "\n"
+
+
+# -- engine registry (the `/stats` "engines" section) ----------------------
+
+_engines_lock = threading.Lock()
+_engines = {}  # engine name -> weakref
+
+
+def register_engine(engine) -> None:
+    with _engines_lock:
+        _engines[engine.name] = weakref.ref(engine)
+
+
+def unregister_engine(engine) -> None:
+    with _engines_lock:
+        ref = _engines.get(engine.name)
+        if ref is not None and ref() in (engine, None):
+            del _engines[engine.name]
+
+
+def _engines_snapshot() -> dict:
+    with _engines_lock:
+        items = list(_engines.items())
+    out = {}
+    for name, ref in items:
+        eng = ref()
+        if eng is None:
+            with _engines_lock:
+                if _engines.get(name) is ref:
+                    del _engines[name]
+            continue
+        try:
+            out[name] = eng.stats()
+        except Exception as e:  # a dying engine must not break the page
+            out[name] = {"error": repr(e)}
+    return out
+
+
+def stats_payload() -> dict:
+    return {"stats": monitor.all_stats(),
+            "histograms": monitor.all_histograms(),
+            "engines": _engines_snapshot(),
+            "trace": tracer.ring_stats(),
+            "flight_recorder": {"enabled": flight_recorder.enabled(),
+                                "dumps": flight_recorder.last_dumps()}}
+
+
+# -- HTTP surface ----------------------------------------------------------
+
+class _Handler(BaseHTTPRequestHandler):
+    server_version = "paddle_tpu-metrics"
+
+    def log_message(self, *args):  # no per-scrape stderr chatter
+        pass
+
+    def do_GET(self):
+        monitor.stat_add("STAT_metrics_requests")
+        path = self.path.split("?", 1)[0]
+        try:
+            if path in ("/", "/metrics"):
+                body = render_prometheus().encode()
+                ctype = "text/plain; version=0.0.4; charset=utf-8"
+            elif path == "/stats":
+                body = json.dumps(stats_payload(), default=str).encode()
+                ctype = "application/json"
+            elif path == "/trace":
+                tracer.sample_counters()
+                body = json.dumps(tracer.chrome_trace(),
+                                  default=str).encode()
+                ctype = "application/json"
+            else:
+                self.send_error(404, "unknown endpoint "
+                                     "(have /metrics /stats /trace)")
+                return
+        except Exception as e:  # noqa: BLE001 — a scrape never kills us
+            self.send_error(500, repr(e))
+            return
+        self.send_response(200)
+        self.send_header("Content-Type", ctype)
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+
+class MetricsServer:
+    """Threaded stdlib HTTP server bound to 127.0.0.1; `port=0` binds an
+    ephemeral port (read `.port` back). Serves until `close()`."""
+
+    def __init__(self, port: int = 0, host: str = "127.0.0.1"):
+        self._httpd = ThreadingHTTPServer((host, int(port)), _Handler)
+        self._httpd.daemon_threads = True
+        self._closed = False
+        self.host = host
+        self.port = int(self._httpd.server_address[1])
+        self._thread = threading.Thread(
+            target=self._httpd.serve_forever, daemon=True,
+            name=f"paddle_tpu-metrics-{self.port}")
+        self._thread.start()
+        flight_recorder.touch()  # metrics users want the sampler running
+
+    @property
+    def url(self) -> str:
+        return f"http://{self.host}:{self.port}"
+
+    def close(self):
+        if self._closed:  # idempotent: engine shutdown + caller may race
+            return
+        self._closed = True
+        with _servers_lock:
+            for k, v in list(_servers.items()):
+                if v is self:
+                    del _servers[k]
+        self._httpd.shutdown()
+        self._httpd.server_close()
+        self._thread.join(timeout=5)
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+        return False
+
+
+_servers_lock = threading.Lock()
+_servers = {}  # requested port -> MetricsServer
+
+
+def start_metrics_server(port: Optional[int] = None) -> \
+        Optional[MetricsServer]:
+    """Start (or return the already-running) metrics server. `port=None`
+    resolves `FLAGS_metrics_port`, where 0 means OFF (returns None);
+    an explicit `port=0` binds an ephemeral port. Idempotent per
+    requested port — every engine pointing at the same port shares one
+    server."""
+    from_flag = port is None
+    port = int(flag("FLAGS_metrics_port")) if port is None else int(port)
+    if from_flag and port == 0:
+        return None
+    with _servers_lock:
+        srv = _servers.get(port)
+        if srv is not None:
+            return srv
+        srv = MetricsServer(port)
+        if port != 0:  # ephemeral requests are never shared
+            _servers[port] = srv
+        return srv

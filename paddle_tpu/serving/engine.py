@@ -34,7 +34,7 @@ from ..framework import monitor
 from ..framework.errors import (ExecutionTimeoutError, InvalidArgumentError,
                                 UnavailableError)
 from ..framework.flags import flag
-from ..profiler import RecordEvent
+from ..profiler import RecordEvent, exporter, flight_recorder
 
 __all__ = ["EngineConfig", "InferenceEngine"]
 
@@ -225,6 +225,10 @@ class _Lane:
             if len(batch) == 1:
                 return [(batch, rows, bucket, None, e)]
             monitor.stat_add("STAT_serving_batch_retries")
+            flight_recorder.dump("serving_poisoned_batch", {
+                "engine": eng.name, "lane": self.index, "stage": "dispatch",
+                "bucket": bucket, "rows": rows, "requests": len(batch),
+                "error": repr(e)})
             return [u for req in batch for u in self._units_for([req])]
 
     def warm(self, shapes):
@@ -305,6 +309,10 @@ class _Lane:
             # error lands only on the offending future and the lane
             # keeps serving everyone else
             monitor.stat_add("STAT_serving_batch_retries")
+            flight_recorder.dump("serving_poisoned_batch", {
+                "engine": eng.name, "lane": self.index,
+                "stage": "complete", "bucket": bucket, "rows": rows,
+                "requests": len(reqs), "error": repr(err)})
             for req in reqs:
                 if not self._expired(req, _now_ms()):
                     for u in self._units_for([req]):
@@ -451,6 +459,18 @@ class _Lane:
             dropped += self._drain_pending()
         if dropped:
             self._dec_inflight(dropped)
+        if first:
+            # postmortem artifact AFTER every stranded future is failed:
+            # the dump is file IO and must never delay a waiting caller.
+            # Its event tail carries this lane's last dispatch/complete
+            # scopes — the context the raised UnavailableError lacks.
+            flight_recorder.dump("serving_lane_death", {
+                "engine": eng.name, "lane": self.index,
+                "device": str(self.device) if self.device is not None
+                else None, "thread": threading.current_thread().name,
+                "error": repr(exc), "dropped_batches": dropped,
+                "lane_batches_completed": self.batches,
+                "lane_rows_completed": self.rows})
 
 
 class InferenceEngine:
@@ -498,7 +518,7 @@ class InferenceEngine:
 
     def __init__(self, model, config: Optional[EngineConfig] = None,
                  input_spec=None, name: str = "serving", devices=None,
-                 **overrides):
+                 metrics_port: Optional[int] = None, **overrides):
         if config is None:
             config = EngineConfig(**overrides)
         elif overrides:
@@ -535,6 +555,24 @@ class InferenceEngine:
                                            name=f"{name}-collector",
                                            daemon=True)
         self._collector.start()
+        # observability surfaces: flight-recorder periodic sampler, the
+        # /stats engine registry, and (opt-in via metrics_port= or
+        # FLAGS_metrics_port) the shared HTTP metrics server
+        flight_recorder.touch()
+        exporter.register_engine(self)
+        # an explicit port 0 binds an ephemeral, never-shared server —
+        # this engine owns it and must close it on shutdown
+        self._owns_metrics_server = (metrics_port is not None
+                                     and int(metrics_port) == 0)
+        self.metrics_server = None
+        try:
+            self.metrics_server = exporter.start_metrics_server(
+                metrics_port)
+        except Exception:
+            # the lanes + collector are already running; a port-bind
+            # failure must not leak them with no handle to stop them
+            self.shutdown(drain=False, timeout_s=5)
+            raise
 
     # -- model / lane plumbing ---------------------------------------------
 
@@ -833,7 +871,12 @@ class InferenceEngine:
                 if batch is None:
                     return  # closed + drained
                 if batch:
-                    self._route(batch)
+                    # the collector's own trace track: scope spans the
+                    # routing decision INCLUDING any wait for lane
+                    # capacity (visible backpressure in the timeline)
+                    with RecordEvent(
+                            f"serving::route[n={len(batch)}]"):
+                        self._route(batch)
                 batch = None
         except BaseException as e:  # noqa: BLE001 — never hang submitters
             # fail BOTH the already-claimed batch and everything still
@@ -851,6 +894,9 @@ class InferenceEngine:
                         f"{self.name}: collector died: {e!r}"))
                 except Exception:
                     pass
+            flight_recorder.dump("serving_collector_death", {
+                "engine": self.name, "error": repr(e),
+                "stranded_requests": len(stranded)})
             if not isinstance(e, UnavailableError):
                 raise
         finally:
@@ -956,6 +1002,12 @@ class InferenceEngine:
                              else max(0.0, deadline - time.monotonic()))
         for lane in self._lanes:
             lane.join(deadline)
+        # a flag/fixed-port HTTP server is shared across engines and
+        # stays up; an ephemeral one (explicit metrics_port=0) is this
+        # engine's own and would otherwise leak its socket + thread
+        exporter.unregister_engine(self)
+        if self._owns_metrics_server and self.metrics_server is not None:
+            self.metrics_server.close()
 
     def __enter__(self):
         return self

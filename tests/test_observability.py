@@ -1,0 +1,457 @@
+"""Unified runtime observability (ISSUE 5): thread-aware tracer with
+bounded per-thread rings, quiet profiler summary, crash flight recorder
+on every hardened failure path, and the Prometheus/JSON/chrome-trace
+export surface — plus the check_stats metrics-drift lint.
+"""
+import importlib.util
+import io
+import json
+import os
+import threading
+import time
+import urllib.request
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+from paddle_tpu import serving
+from paddle_tpu import profiler
+from paddle_tpu.framework import monitor
+from paddle_tpu.profiler import exporter, flight_recorder, tracer
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture
+def flightdir(tmp_path):
+    """Route flight-recorder dumps into an isolated tmp dir."""
+    prev = paddle.get_flags(["FLAGS_flight_recorder_dir",
+                             "FLAGS_flight_recorder"])
+    paddle.set_flags({"FLAGS_flight_recorder_dir": str(tmp_path),
+                      "FLAGS_flight_recorder": True})
+    yield tmp_path
+    paddle.set_flags(prev)
+
+
+def _wait_for_dump(tmp_path, reason, timeout=10.0):
+    """Dumps are written by the *dying* thread after futures resolve —
+    poll briefly instead of racing it."""
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        hits = sorted(tmp_path.glob(f"flightrec-*-{reason}.json"))
+        if hits:
+            return hits[-1]
+        time.sleep(0.05)
+    raise AssertionError(f"no {reason} flight-recorder dump in {tmp_path}")
+
+
+def _toy_model(dim=8, classes=3, lr=0.01):
+    net = nn.Sequential(nn.Linear(dim, 16), nn.ReLU(),
+                        nn.Linear(16, classes))
+    model = paddle.Model(net)
+    model.prepare(paddle.optimizer.Adam(lr, parameters=net.parameters()),
+                  nn.CrossEntropyLoss())
+    model._dist_ctx = None  # pin the single-process hot loop
+    return model, net
+
+
+# ---------------------------------------------------------------------------
+# tentpole 1: thread-aware bounded trace store
+# ---------------------------------------------------------------------------
+
+def test_tracer_cross_thread_events_not_dropped():
+    """Regression for the old `_State(threading.local)` store: events
+    recorded on worker threads were silently invisible (per-thread
+    `enabled` defaulted off) and the shared list was unlocked. Every
+    thread's events must land, exactly once."""
+    profiler.start_profiler()
+    n_threads, per_thread = 8, 2000
+
+    def worker(i):
+        ev = profiler.RecordEvent(f"obs_race_t{i}")
+        for _ in range(per_thread):
+            with ev:
+                pass
+
+    threads = [threading.Thread(target=worker, args=(i,),
+                                name=f"obs-race-{i}")
+               for i in range(n_threads)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    rows = dict(profiler.stop_profiler())
+    for i in range(n_threads):
+        assert rows[f"obs_race_t{i}"][0] == per_thread
+
+
+def test_trace_ring_bound_holds_under_100k_events():
+    prev = paddle.get_flags(["FLAGS_trace_ring_size"])
+    paddle.set_flags({"FLAGS_trace_ring_size": 1024})
+    try:
+        profiler.start_profiler()
+        n_threads, per_thread = 4, 25_000
+
+        def worker(i):
+            # fresh threads get fresh rings sized by the current flag
+            for k in range(per_thread):
+                t = time.perf_counter()
+                tracer.record_complete(f"obs_bound_t{i}", t, t)
+
+        threads = [threading.Thread(target=worker, args=(i,),
+                                    name=f"obs-bound-{i}")
+                   for i in range(n_threads)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        rows = dict(profiler.stop_profiler())
+        total = sum(rows[f"obs_bound_t{i}"][0] for i in range(n_threads))
+        # 100k events in, memory stays at <= ring_size per thread
+        assert total <= n_threads * 1024
+        assert total >= n_threads  # the tail survived
+        st = tracer.ring_stats()
+        assert st["overwritten"] >= 100_000 - total
+    finally:
+        paddle.set_flags(prev)
+
+
+def test_stop_profiler_is_quiet_and_summary_routes(capsys):
+    profiler.start_profiler()
+    with profiler.RecordEvent("obs_quiet_op"):
+        pass
+    rows = profiler.stop_profiler()
+    assert capsys.readouterr().out == ""  # library users stay quiet
+    assert any(name == "obs_quiet_op" for name, _ in rows)
+    buf = io.StringIO()
+    text = profiler.summary(rows, file=buf)
+    assert "obs_quiet_op" in buf.getvalue()
+    assert "Calls" in text
+    # the context manager is quiet too
+    with profiler.profiler():
+        with profiler.RecordEvent("obs_ctx_op"):
+            pass
+    assert capsys.readouterr().out == ""
+
+
+def test_profiler_step_emits_step_scopes():
+    p = profiler.Profiler()
+    p.start()
+    for _ in range(3):
+        monitor.stat_add("STAT_train_steps")
+        p.step()
+    p.stop()
+    names = [n for n, _, _ in tracer.events(since=0)]
+    assert "ProfilerStep#0" in names and "ProfilerStep#2" in names
+
+
+def test_chrome_trace_fit_plus_serving_is_multitrack(tmp_path):
+    """Acceptance: one chrome trace from a fit + multi-request serving
+    run renders >=3 distinct named thread tracks (fit main loop, device
+    feeder, serving collector/lanes) and >=2 counter tracks."""
+    profiler.start_profiler()
+    # -- training: DeviceFeeder thread + fit::train_step on main thread
+    x = np.random.RandomState(0).randn(64, 8).astype("float32")
+    y = np.random.RandomState(1).randint(0, 3, 64).astype("int64")
+    model, _ = _toy_model()
+    model.fit(paddle.io.TensorDataset([x, y]), batch_size=16, epochs=1,
+              verbose=0)
+    # -- serving: collector + lane dispatcher/completer threads
+    eng = serving.InferenceEngine(
+        lambda arrays: [np.asarray(arrays[0]) * 2.0],
+        input_spec=[([None, 4], "float32")], name="obs_trace",
+        max_batch_size=8, batch_buckets=(1, 8), max_batch_delay_ms=1.0)
+    try:
+        futs = [eng.submit(np.full((1, 4), float(i), "float32"))
+                for i in range(6)]
+        for f in futs:
+            f.result(timeout=30)
+    finally:
+        eng.shutdown()
+    path = str(tmp_path / "trace.json")
+    profiler.export_chrome_tracing(path)
+    profiler.stop_profiler()
+    data = json.load(open(path))
+    evs = data["traceEvents"]
+    tracks = {e["args"]["name"] for e in evs
+              if e.get("ph") == "M" and e.get("name") == "thread_name"}
+    assert "paddle_tpu-device-feeder" in tracks
+    assert "obs_trace-collector" in tracks
+    assert "obs_trace-lane0-dispatch" in tracks
+    assert len(tracks) >= 3
+    names = {e["name"] for e in evs if e.get("ph") == "X"}
+    assert "fit::train_step" in names
+    assert any(n.startswith("serving::lane0::dispatch") for n in names)
+    # distinct tids per track — threads do not share a lane
+    tids = {e["tid"] for e in evs if e.get("ph") == "X"}
+    assert len(tids) >= 3
+    counters = {e["name"] for e in evs if e.get("ph") == "C"}
+    assert len(counters) >= 2
+    assert "STAT_train_steps" in counters
+
+
+# ---------------------------------------------------------------------------
+# tentpole 2: crash flight recorder
+# ---------------------------------------------------------------------------
+
+class _LaneKiller(BaseException):
+    pass
+
+
+def test_flight_recorder_dump_on_lane_death(flightdir):
+    def replica(arrays):
+        a = np.asarray(arrays[0])
+        if (a == 666.0).any():
+            raise _LaneKiller("chip wedged")
+        return [a * 2.0]
+
+    eng = serving.InferenceEngine(
+        replica, input_spec=[([None, 4], "float32")], name="obs_death",
+        max_batch_size=1, batch_buckets=(1,), max_batch_delay_ms=0.0)
+    try:
+        eng.submit(np.full((1, 4), 1.0, "float32")).result(timeout=30)
+        f = eng.submit(np.full((1, 4), 666.0, "float32"))
+        with pytest.raises(Exception):
+            f.result(timeout=30)
+    finally:
+        eng.shutdown()
+    dump = _wait_for_dump(flightdir, "serving_lane_death")
+    rec = json.load(open(dump))
+    assert rec["reason"] == "serving_lane_death"
+    assert rec["extra"]["engine"] == "obs_death"
+    assert rec["extra"]["lane"] == 0
+    assert "_LaneKiller" in rec["extra"]["error"]
+    # the tail carries the lane's last dispatch/complete scopes
+    tail_names = [e["name"] for e in rec["events"]]
+    assert any(n.startswith("serving::lane0::dispatch")
+               for n in tail_names)
+    assert any(n.startswith("serving::lane0::complete")
+               for n in tail_names)
+    # and a consistent counter snapshot from the moment of death
+    assert rec["stats"].get("STAT_serving_lane_deaths", 0) >= 1
+
+
+def test_flight_recorder_dump_on_poisoned_batch(flightdir):
+    def replica(arrays):
+        a = np.asarray(arrays[0])
+        if (a == 13.0).any():
+            raise RuntimeError("poisoned request")
+        return [a * 2.0]
+
+    eng = serving.InferenceEngine(
+        replica, input_spec=[([None, 4], "float32")], name="obs_poison",
+        max_batch_size=8, batch_buckets=(8,), max_batch_delay_ms=50.0)
+    try:
+        good = eng.submit(np.full((2, 4), 1.0, "float32"))
+        bad = eng.submit(np.full((1, 4), 13.0, "float32"))
+        assert np.allclose(good.result(timeout=30)[0], 2.0)
+        with pytest.raises(RuntimeError, match="poisoned"):
+            bad.result(timeout=30)
+    finally:
+        eng.shutdown()
+    dump = _wait_for_dump(flightdir, "serving_poisoned_batch")
+    rec = json.load(open(dump))
+    assert rec["extra"]["engine"] == "obs_poison"
+    assert rec["extra"]["requests"] >= 2
+
+
+def test_flight_recorder_dump_on_poisoned_carry(flightdir):
+    import jax
+    import jax.numpy as jnp
+    model, net = _toy_model()
+    dead = jnp.ones((2, 2))
+    dead.delete()  # block_until_ready now raises — the async-failure shape
+    model._train_carry = {"params": {"w": dead}, "buffers": {},
+                          "opt_state": {}}
+    model._sync_carry(validate=True)
+    assert model._train_carry is None  # poisoned carry dropped, not synced
+    dump = _wait_for_dump(flightdir, "poisoned_carry", timeout=5.0)
+    rec = json.load(open(dump))
+    assert rec["reason"] == "poisoned_carry"
+    assert "error" in rec["extra"]
+    assert "stats" in rec and "events" in rec
+
+
+class _CrashAt7:
+    """Top-level (picklable) dataset whose item 7 raises in the worker."""
+
+    def __len__(self):
+        return 16
+
+    def __getitem__(self, i):
+        if i == 7:
+            raise ValueError("synthetic worker failure at item 7")
+        return np.full((4,), float(i), "float32")
+
+
+@pytest.mark.skipif(os.environ.get("PADDLE_TPU_TEST_ON_CHIP") == "1",
+                    reason="mp workers assume the CPU test mesh")
+def test_flight_recorder_dump_on_dataloader_worker_error(flightdir):
+    loader = paddle.io.DataLoader(_CrashAt7(), batch_size=4,
+                                  num_workers=2, shuffle=False)
+    with pytest.raises(RuntimeError, match="worker raised"):
+        for _ in loader:
+            pass
+    dump = _wait_for_dump(flightdir, "dataloader_worker_error")
+    rec = json.load(open(dump))
+    assert "synthetic worker failure" in rec["extra"]["error"]
+
+
+def test_flight_recorder_prunes_to_max_dumps(flightdir):
+    prev = paddle.get_flags(["FLAGS_flight_recorder_max_dumps"])
+    paddle.set_flags({"FLAGS_flight_recorder_max_dumps": 3})
+    try:
+        for i in range(6):
+            assert flight_recorder.dump("prune_test", {"i": i})
+        files = sorted(flightdir.glob("flightrec-*-prune_test.json"))
+        assert len(files) == 3
+        # newest survive
+        assert json.load(open(files[-1]))["extra"]["i"] == 5
+    finally:
+        paddle.set_flags(prev)
+
+
+def test_flight_recorder_off_records_nothing(flightdir):
+    prev = paddle.get_flags(["FLAGS_flight_recorder"])
+    paddle.set_flags({"FLAGS_flight_recorder": False})
+    try:
+        assert flight_recorder.dump("disabled_test") is None
+        assert not list(flightdir.glob("*disabled_test*"))
+    finally:
+        paddle.set_flags(prev)
+
+
+# ---------------------------------------------------------------------------
+# tentpole 3: export surface
+# ---------------------------------------------------------------------------
+
+def _parse_prometheus(text):
+    """Minimal exposition-format validation; returns {metric: value} for
+    samples and the set of histogram series names."""
+    samples = {}
+    for line in text.strip().splitlines():
+        if line.startswith("#"):
+            assert line.startswith("# TYPE ") or line.startswith("# HELP ")
+            continue
+        name_part, value = line.rsplit(" ", 1)
+        float(value)  # every sample value parses as a number
+        samples[name_part] = float(value)
+    return samples
+
+
+def test_metrics_endpoint_serves_prometheus_and_stats_and_trace():
+    monitor.stat_add("STAT_train_steps", 0)  # ensure at least one counter
+    eng = serving.InferenceEngine(
+        lambda arrays: [np.asarray(arrays[0]) + 1.0],
+        input_spec=[([None, 4], "float32")], name="obs_metrics",
+        max_batch_size=4, batch_buckets=(4,), max_batch_delay_ms=0.5,
+        metrics_port=0)  # 0 = ephemeral port, server started by the engine
+    try:
+        assert eng.metrics_server is not None
+        for i in range(5):
+            eng.run(np.full((1, 4), float(i), "float32"), timeout_ms=30000)
+        base = eng.metrics_server.url
+        text = urllib.request.urlopen(base + "/metrics",
+                                      timeout=10).read().decode()
+        samples = _parse_prometheus(text)
+        # every registered counter is present under the sanitized name
+        for name in monitor.all_stats():
+            assert f"paddle_tpu_{name.lower()}" in samples, name
+        # the serving latency histogram renders as a real histogram
+        h = "paddle_tpu_obs_metrics_request_ms"
+        buckets = {k: v for k, v in samples.items()
+                   if k.startswith(h + "_bucket")}
+        assert buckets and f'{h}_bucket{{le="+Inf"}}' in buckets
+        assert samples[h + "_count"] == 5
+        assert samples[h + "_sum"] > 0
+        # cumulative monotone
+        vals = [v for _, v in sorted(buckets.items())]
+        inf = buckets[f'{h}_bucket{{le="+Inf"}}']
+        assert all(v <= inf for v in vals)
+        # /stats carries the live engine lanes
+        st = json.load(urllib.request.urlopen(base + "/stats", timeout=10))
+        assert st["engines"]["obs_metrics"]["lanes"][0]["alive"] is True
+        assert "STAT_serving_requests" in st["stats"]
+        # /trace is a valid chrome trace with named threads
+        tr = json.load(urllib.request.urlopen(base + "/trace", timeout=10))
+        tracks = {e["args"]["name"] for e in tr["traceEvents"]
+                  if e.get("ph") == "M" and e.get("name") == "thread_name"}
+        assert any("obs_metrics" in t for t in tracks)
+        # unknown endpoint 404s instead of crashing the server
+        with pytest.raises(urllib.error.HTTPError):
+            urllib.request.urlopen(base + "/nope", timeout=10)
+    finally:
+        srv = eng.metrics_server
+        eng.shutdown()
+        if srv is not None:
+            srv.close()
+    # shutdown unregisters the engine from /stats
+    assert "obs_metrics" not in exporter.stats_payload()["engines"]
+
+
+def test_metrics_port_flag_zero_means_off():
+    assert exporter.start_metrics_server(None) is None  # flag default 0
+    eng = serving.InferenceEngine(
+        lambda arrays: [np.asarray(arrays[0])],
+        input_spec=[([None, 2], "float32")], name="obs_noport",
+        max_batch_size=1, batch_buckets=(1,), max_batch_delay_ms=0.0)
+    try:
+        assert eng.metrics_server is None
+    finally:
+        eng.shutdown()
+
+
+def test_histogram_buckets_and_accessors():
+    h = monitor.StatHistogram("obs_bkt")
+    for v in (0.5, 2.0, 2.1, 50.0, 900.0):
+        h.observe(v)
+    bks = h.buckets()
+    assert bks[-1] == (float("inf"), 5)
+    les = [le for le, _ in bks]
+    cums = [c for _, c in bks]
+    assert les == sorted(les) and cums == sorted(cums)  # cumulative
+    assert h.count == 5
+    assert h.sum == pytest.approx(954.6)
+    # every observation lands at-or-below its bucket's upper bound
+    assert min(c for le, c in bks if le >= 0.5) >= 1
+
+
+def test_all_stats_name_set_is_consistent_under_churn():
+    stop = threading.Event()
+
+    def churn():
+        i = 0
+        while not stop.is_set():
+            monitor.stat_add(f"STAT_obs_churn_{i % 37}")
+            i += 1
+
+    t = threading.Thread(target=churn, name="obs-churn")
+    t.start()
+    try:
+        for _ in range(200):
+            snap = monitor.all_stats()  # must never raise mid-resize
+            assert isinstance(snap, dict)
+    finally:
+        stop.set()
+        t.join()
+
+
+# ---------------------------------------------------------------------------
+# CI lint: the metrics surface cannot silently drift
+# ---------------------------------------------------------------------------
+
+def test_check_stats_lint():
+    spec = importlib.util.spec_from_file_location(
+        "check_stats", os.path.join(ROOT, "tools", "check_stats.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    names = mod.collect_names()
+    assert "STAT_serving_requests" in names       # scanner sees plain calls
+    assert "STAT_serving_lane<index>_batches" in names  # ... and f-strings
+    assert "<name>_request_ms" in names           # ... and histograms
+    missing = mod.undocumented()
+    assert missing == [], (
+        "metric names bumped in paddle_tpu/ but not documented in "
+        f"COVERAGE.md 'Metrics inventory': {[n for n, _ in missing]}")

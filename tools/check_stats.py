@@ -1,0 +1,96 @@
+#!/usr/bin/env python
+"""Lint: every STAT counter / histogram name bumped anywhere in
+`paddle_tpu/` must be documented in COVERAGE.md ("Metrics inventory"
+section), so the metrics surface cannot silently drift — a new counter
+lands together with its one-line contract, the same way the reference
+keeps `monitor.h` registrations reviewable in one table.
+
+Scans for literal (including f-string) first arguments of
+STAT_ADD/STAT_SUB/stat_add/stat_sub/stat_time/stat_get/... and
+monitor.histogram(...). F-string placeholders are normalized to a
+`<token>` wildcard built from the expression's last identifier —
+`f"STAT_serving_lane{self.index}_batches"` must be documented as
+`STAT_serving_lane<index>_batches`.
+
+Run directly (exit 1 + the undocumented list on drift) or through the
+tier-1 test `tests/test_observability.py::test_check_stats_lint`.
+"""
+from __future__ import annotations
+
+import os
+import re
+import sys
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+PKG = os.path.join(ROOT, "paddle_tpu")
+COVERAGE = os.path.join(ROOT, "COVERAGE.md")
+
+# monitor.py defines the registry; its docstrings/macro aliases are not
+# metric registrations
+_SKIP_FILES = {os.path.join(PKG, "framework", "monitor.py")}
+
+_CALL = re.compile(
+    r'(?:\b(?:STAT_ADD|STAT_SUB|STAT_RESET|stat_add|stat_sub|stat_reset|'
+    r'stat_get|stat_time)|\bhistogram)\s*\(\s*(f?)"([^"]+)"')
+_PLACEHOLDER = re.compile(r"\{([^{}]*)\}")
+
+
+def _normalize(literal: str, is_fstring: bool) -> str:
+    if not is_fstring:
+        return literal
+
+    def repl(m):
+        idents = re.findall(r"[A-Za-z_][A-Za-z0-9_]*", m.group(1))
+        return f"<{idents[-1]}>" if idents else "<v>"
+
+    return _PLACEHOLDER.sub(repl, literal)
+
+
+def collect_names():
+    """{normalized_name: [file:line, ...]} for every literal metric name
+    registered/bumped under paddle_tpu/."""
+    names = {}
+    for dirpath, _, files in os.walk(PKG):
+        for fn in files:
+            if not fn.endswith(".py"):
+                continue
+            path = os.path.join(dirpath, fn)
+            if path in _SKIP_FILES:
+                continue
+            with open(path, encoding="utf-8") as f:
+                for lineno, line in enumerate(f, 1):
+                    for m in _CALL.finditer(line):
+                        name = _normalize(m.group(2), bool(m.group(1)))
+                        rel = os.path.relpath(path, ROOT)
+                        names.setdefault(name, []).append(
+                            f"{rel}:{lineno}")
+    return names
+
+
+def undocumented():
+    """[(name, sites)] of metric names missing from COVERAGE.md."""
+    with open(COVERAGE, encoding="utf-8") as f:
+        text = f.read()
+    return sorted((name, sites) for name, sites in collect_names().items()
+                  if name not in text)
+
+
+def main() -> int:
+    missing = undocumented()
+    if not missing:
+        n = len(collect_names())
+        print(f"check_stats: OK — {n} metric names, all documented "
+              f"in COVERAGE.md")
+        return 0
+    print("check_stats: metric names bumped in paddle_tpu/ but missing "
+          "from COVERAGE.md:", file=sys.stderr)
+    for name, sites in missing:
+        print(f"  {name}  ({', '.join(sites[:3])}"
+              f"{', ...' if len(sites) > 3 else ''})", file=sys.stderr)
+    print("add each to the 'Metrics inventory' table in COVERAGE.md "
+          "(f-string placeholders normalize to <token>)", file=sys.stderr)
+    return 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())
