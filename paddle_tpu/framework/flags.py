@@ -47,6 +47,25 @@ register_flag("FLAGS_flash_attention_min_seq", 512,
               "shortest query length dispatched to the Pallas flash kernel; "
               "below this XLA's fused dense attention wins (measured "
               "crossover on v5e; see tools/perf_attr.py)")
+register_flag("FLAGS_flash_block_q", 512,
+              "preferred q tile for the flash/splash attention kernels "
+              "(multiple of 128; the on-chip sweep — "
+              "tools/perf_flash_sweep.py / perf_splash_sweep.py, v5e, "
+              "S=2048, bf16 — picked 512). Kernels fall back to the "
+              "largest of 128/256/512/this that divides the sequence")
+register_flag("FLAGS_flash_block_kv", 512,
+              "preferred kv tile for the flash/splash attention kernels "
+              "(multiple of 128; same sweep as FLAGS_flash_block_q)")
+register_flag("FLAGS_use_splash_attention", True,
+              "use the Pallas segment-aware splash-attention kernel for "
+              "scaled_dot_product_attention calls that carry segment_ids "
+              "(sequence packing); off routes packed batches through the "
+              "dense segment-masked fallback")
+register_flag("FLAGS_splash_attention_min_seq", 512,
+              "shortest packed-row length dispatched to the splash kernel; "
+              "below this the dense segment-masked attention wins (same "
+              "crossover assumption as FLAGS_flash_attention_min_seq until "
+              "swept on-chip — tools/perf_splash_sweep.py)")
 register_flag("FLAGS_train_step_donate", True,
               "donate the (params, buffers, opt_state) carry into the jitted "
               "train step so XLA updates parameters in place instead of "

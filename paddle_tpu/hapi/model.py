@@ -54,6 +54,16 @@ Training hot-loop contract (the zero-copy / async-dispatch design):
   rows by mean/sum; otherwise the model falls back to the unpadded step
   once and warns). eval_batch/predict_batch share the same padding so
   their per-exact-shape jit caches stop growing one entry per tail shape.
+* Sequence packing (io.packing.PackingCollator as the loader's
+  collate_fn, marked by `emits_token_mask`): batches arrive as
+  fixed-shape packs whose last leaf is a [rows, max_tokens] token
+  validity mask. fit/evaluate pop it and fold it into the loss as a
+  TOKEN mask — per-token losses normalize by real tokens only — while
+  the network masks attention per segment
+  (F.scaled_dot_product_attention(segment_ids=...) → splash kernel).
+  The row-mask tail machinery is bypassed: a short tail is just a pack
+  with more masked tokens, so one-compile-per-epoch carries over and a
+  batch is never double-masked.
 
 Monitor counters (framework/monitor.py): STAT_train_steps,
 STAT_train_step_compiles (one per input-shape key), STAT_train_step_ns
@@ -123,10 +133,15 @@ def _pad_leaf(x, rows, target):
 
 
 def _real_rows(mask):
-    """(padded_rows, real-row index array) for a row mask. fit's own
-    masks are ones-prefixes, but loss_mask is a public train_batch/
-    eval_batch parameter and may have holes."""
+    """(padded_rows, real-row index array) for a loss mask. fit's own
+    row masks are ones-prefixes, but loss_mask is a public train_batch/
+    eval_batch parameter and may have holes. A token-level mask
+    [rows, T] (packing) counts a row as real when ANY of its tokens is
+    real — metrics then see whole packed rows, pad positions included
+    (per-token metric masking is the packing contract's caveat)."""
     m = np.asarray(mask)
+    if m.ndim > 1:
+        m = (m.reshape(m.shape[0], -1) > 0).any(axis=1)
     return int(m.shape[0]), np.flatnonzero(m)
 
 
@@ -227,18 +242,28 @@ class Model:
         raise TypeError("loss must be callable")
 
     def _masked_loss(self, outputs, labels, mask):
-        """User loss folded with the tail row mask: padded rows get zero
+        """User loss folded with a validity mask.
+
+        A 1-D mask [rows] is the tail row mask: padded rows get zero
         weight and the mean divides by the real-row count, so the scalar
         equals the loss of the unpadded batch (for losses that reduce
-        rows by mean/sum). Losses with a `reduction` attribute are traced
-        with reduction='none' to expose per-row values; a loss that only
-        yields a scalar raises _TailMaskError at trace time and the
-        caller falls back to the unpadded step.
+        rows by mean/sum). A 2-D mask [rows, T] is a TOKEN mask (the
+        packing collator's last leaf): the loss must expose per-token
+        values [rows, T(, ...)], padded tokens get zero weight and the
+        mean divides by the REAL-TOKEN count — per-token losses
+        normalize by real tokens only, which is the packing contract.
 
-        CAVEAT: a loss whose mean has a data-dependent denominator (e.g.
-        cross_entropy with ignore_index labels present) is reduced here
-        as a mean of per-row means, which weights rows uniformly instead
-        of by valid-element count.
+        Losses with a `reduction` attribute are traced with
+        reduction='none' to expose per-element values; a loss that only
+        yields a scalar raises _TailMaskError at trace time and the
+        caller falls back.
+
+        CAVEAT (row masks only): a loss whose mean has a data-dependent
+        denominator (e.g. cross_entropy with ignore_index labels
+        present) is reduced here as a mean of per-row means, which
+        weights rows uniformly instead of by valid-element count. Token
+        masks don't have the problem — the denominator IS the
+        valid-token count.
         """
         m = mask._value if isinstance(mask, Tensor) else mask
         red = getattr(self._loss, "reduction", None)
@@ -253,6 +278,26 @@ class Model:
         lv_raw = (lv._value if isinstance(lv, Tensor) else lv)
         lv_raw = lv_raw.astype("float32")
         rows = int(m.shape[0])
+        if m.ndim == 2:
+            T = int(m.shape[1])
+            if lv_raw.ndim < 2 or tuple(lv_raw.shape[:2]) != (rows, T):
+                raise _TailMaskError(
+                    f"loss produced shape "
+                    f"{tuple(getattr(lv_raw, 'shape', ()))} — not "
+                    f"per-token over the ({rows}, {T}) pack, so the "
+                    "token mask cannot be folded in; packed training "
+                    "needs a per-token-maskable loss (e.g. "
+                    "CrossEntropyLoss over [rows, T, C] logits)")
+            per_tok = lv_raw.reshape((rows, T, -1))
+            per_tok = (per_tok.sum(axis=2) if red == "sum"
+                       else per_tok.mean(axis=2))
+            # where, not multiply: a non-finite pad-token value must not
+            # poison the sum through NaN * 0
+            per_tok = jnp.where(m > 0, per_tok, jnp.zeros_like(per_tok))
+            if red == "sum":
+                return jnp.sum(per_tok)
+            return jnp.sum(per_tok) / jnp.maximum(
+                jnp.sum(m.astype("float32")), 1.0)
         if lv_raw.ndim < 1 or lv_raw.shape[0] != rows:
             raise _TailMaskError(
                 f"loss produced shape {tuple(getattr(lv_raw, 'shape', ()))}"
@@ -427,34 +472,61 @@ class Model:
                 {n: t._value for n, t in get_buffers(self.network).items()})
 
     def _placed_mask(self, loss_mask):
-        """Device-resident row mask, cached per exact mask pattern.
+        """Device-resident loss mask, cached per exact ROW-mask pattern.
 
-        fit passes the same handful of masks every epoch (all-ones per
-        full batch, one tail pattern); caching their placement keeps the
-        hot loop free of per-step host->device mask uploads — and on the
-        fleet path the dp-sharded placement lets the step's pre-placed
-        fast path skip the mask too. Keyed by the exact byte pattern:
-        train_batch's loss_mask parameter is public, and two masks with
-        the same population count need not select the same rows."""
+        fit passes the same handful of row masks every epoch (all-ones
+        per full batch, one tail pattern); caching their placement keeps
+        the hot loop free of per-step host->device mask uploads — and on
+        the fleet path the dp-sharded placement lets the step's
+        pre-placed fast path skip the mask too. Keyed by the exact byte
+        pattern: train_batch's loss_mask parameter is public, and two
+        masks with the same population count need not select the same
+        rows. Token-level masks [rows, T] (packing) differ on every
+        batch — they are placed but NOT cached (a byte-keyed cache
+        would grow one entry per batch forever); they ride to the
+        device like any other batch leaf, and one that is ALREADY a
+        device array (the DeviceFeeder staged it with the rest of the
+        pack) passes straight through instead of a device→host→device
+        round trip in the hot loop."""
+        mv = loss_mask._value if isinstance(loss_mask, Tensor) else loss_mask
+        if isinstance(mv, jax.Array) and getattr(mv, "ndim", 0) > 1:
+            return mv if mv.dtype == jnp.float32 \
+                else mv.astype(jnp.float32)
         m = np.ascontiguousarray(np.asarray(loss_mask, "float32"))
         sharded = self._dist_ctx is not None
-        key = (m.tobytes(), sharded)
-        hit = self._mask_cache.get(key)
-        if hit is not None:
-            return hit
+        key = None
+        if m.ndim == 1:
+            key = (m.tobytes(), sharded)
+            hit = self._mask_cache.get(key)
+            if hit is not None:
+                return hit
         arr = jnp.asarray(m, "float32")
         if sharded:
             from ..parallel.mesh import get_mesh
-            from ..parallel.spmd import batch_sharding
+            from ..parallel.spmd import batch_placement
             mesh = get_mesh()
             if mesh is not None:
-                arr = jax.device_put(arr, batch_sharding(1, mesh))
-        self._mask_cache[key] = arr
+                # batch_placement leaves a row count that does not
+                # divide dp unsharded instead of hard-failing device_put
+                sh = batch_placement(mesh)(m)
+                if sh is not None:
+                    arr = jax.device_put(arr, sh)
+        if key is not None:
+            self._mask_cache[key] = arr
         return arr
+
+    @staticmethod
+    def _is_token_mask(loss_mask):
+        m = loss_mask._value if isinstance(loss_mask, Tensor) else loss_mask
+        return m is not None and getattr(m, "ndim", 1) > 1
 
     def _mask_fallback(self, inputs, labels, loss_mask):
         """A loss that cannot fold the tail row mask: warn once, pin the
-        model to unpadded tails, and rerun this batch on its real rows."""
+        model to unpadded tails, and rerun this batch on its real rows.
+
+        Row masks only — a TOKEN mask (packing) has no unpadded shape to
+        fall back to (the pack IS the batch), so its _TailMaskError
+        propagates: packed training requires a per-token-maskable loss."""
         if getattr(self, "_tail_maskable", True):
             self._tail_maskable = False
             warnings.warn(
@@ -504,6 +576,8 @@ class Model:
             if self._train_step_cache.pop(key, None) is not None:
                 STAT_SUB("STAT_train_step_compiles")
             self._global_step = step_no - 1
+            if self._is_token_mask(loss_mask):
+                raise  # packing: no unpadded shape to fall back to
             ins, lbs = self._mask_fallback(inputs, labels, loss_mask)
             return self.train_batch(ins, lbs, update=update)
         except BaseException:
@@ -529,8 +603,13 @@ class Model:
             # across steps.
             self._sync_carry()
         outs = jax.tree_util.tree_leaves(out)
-        if loss_mask is not None and self._metrics:
-            # metrics must never see the masked-out rows
+        if loss_mask is not None and self._metrics and \
+                not self._is_token_mask(loss_mask):
+            # metrics must never see the masked-out rows. Token masks
+            # (packing) skip this: metrics see whole packed rows by
+            # contract (pad positions included — README caveat), and a
+            # per-batch _real_rows would force a device->host copy of a
+            # feeder-staged mask in the hot loop
             rows, idx = _real_rows(loss_mask)
             if len(idx) < rows:
                 outs = _select_rows(outs, rows, idx)
@@ -582,6 +661,8 @@ class Model:
                 new_state, lv = self._sharded_step(
                     state, tuple(ins), tuple(lbs))
         except _TailMaskError:
+            if self._is_token_mask(loss_mask):
+                raise  # packing: no unpadded shape to fall back to
             ins, lbs = self._mask_fallback(ins, lbs[:-1], loss_mask)
             return self._train_batch_sharded(ins, lbs)
         except BaseException:
@@ -640,10 +721,14 @@ class Model:
             lv, out = fn(pv, bv, rng, tuple(inputs), tuple(labels), mask)
         except _TailMaskError:
             self._eval_step_cache.pop(key, None)
+            if self._is_token_mask(loss_mask):
+                raise  # packing: no unpadded shape to fall back to
             ins, lbs = self._mask_fallback(inputs, labels, loss_mask)
             return self.eval_batch(ins, lbs)
         outs = jax.tree_util.tree_leaves(out)
-        if loss_mask is not None:
+        if loss_mask is not None and not self._is_token_mask(loss_mask):
+            # token masks skip row filtering — same contract and hot-loop
+            # reasoning as train_batch above
             rows, idx = _real_rows(loss_mask)
             if len(idx) < rows:
                 outs = _select_rows(outs, rows, idx)
@@ -713,6 +798,35 @@ class Model:
                     placement = None
             return DeviceFeeder(loader, device=placement)
         return loader
+
+    def _token_masked(self, loader):
+        """True when the loader's collator is a packing collator
+        (io.packing.PackingCollator or anything with emits_token_mask):
+        every batch's LAST leaf is a [rows, max_tokens] token validity
+        mask that fit/evaluate pop off the labels and fold into the loss
+        as a token-level mask. Packs are always full-shape — a short
+        tail is just a pack with more masked tokens — so the row-mask
+        tail machinery (_tail_target/_pad_tail) is bypassed entirely:
+        one compiled step per epoch, and never BOTH masks on one batch.
+
+        The model must be constructed with explicit `inputs=` specs so
+        _split_batch knows how many leading pack leaves (tokens,
+        segment_ids, position_ids, ...) feed the network."""
+        cf = getattr(loader, "collate_fn", None)
+        return bool(getattr(cf, "emits_token_mask", False))
+
+    def _pop_token_mask(self, lbs):
+        """Split the collator-emitted token mask off the label leaves.
+        The mask stays whatever the feeder made it (host numpy or an
+        already-placed device array) — never forced through the host
+        here."""
+        if not lbs:
+            raise ValueError(
+                "packing collator batches must carry at least the token "
+                "mask after the input leaves — construct the Model with "
+                "inputs= specs matching the pack layout")
+        tm = lbs[-1]
+        return lbs[:-1], (tm._value if isinstance(tm, Tensor) else tm)
 
     def _tail_target(self, loader, need_mask=True):
         """The loader's batch size when its epochs can actually produce a
@@ -807,19 +921,25 @@ class Model:
                 # loss, so every batch of the epoch shares ONE compiled
                 # step (the mask rides the signature even on full
                 # batches; epochs that cannot produce a tail skip the
-                # mask entirely and keep the plain step)
-                pad_to = self._tail_target(loader)
+                # mask entirely and keep the plain step). A packing
+                # collator replaces all of this with its own token mask:
+                # packs are already fixed-shape, so the tail machinery
+                # must stay OFF (no row padding, no double-masking).
+                token_masked = self._token_masked(loader)
+                pad_to = None if token_masked else self._tail_target(loader)
                 for step, batch in enumerate(feed):
                     cbks.on_batch_begin("train", step, logs)
                     ins, lbs = self._split_batch(batch)
                     mask, nreal = None, None
-                    if pad_to and self._tail_maskable:
+                    if token_masked:
+                        lbs, mask = self._pop_token_mask(lbs)
+                    elif pad_to and self._tail_maskable:
                         # _tail_maskable re-checked per batch: a
                         # mid-epoch fallback stops the masked attempts
                         ins, lbs, mask, nreal = self._pad_tail(
                             ins, lbs, pad_to)
-                    padded = mask is not None and nreal is not None and \
-                        nreal < len(mask)
+                    padded = not token_masked and mask is not None and \
+                        nreal is not None and nreal < len(mask)
                     c0 = (stat_get("STAT_train_step_compiles") if padded
                           else 0)
                     # the fit loop's own track in the chrome trace: step
@@ -909,18 +1029,37 @@ class Model:
         for m in self._metrics:
             m.reset()
         losses = []
-        pad_to = self._tail_target(loader)
+        weights = []
+        token_masked = self._token_masked(loader)
+        pad_to = None if token_masked else self._tail_target(loader)
         for batch in self._buffered(loader):
             ins, lbs = self._split_batch(batch)
             mask = None
-            if pad_to and self._tail_maskable:
+            if token_masked:
+                lbs, mask = self._pop_token_mask(lbs)
+                # each pack's loss is already real-token-normalized;
+                # weight packs by their real-token count so the pass
+                # mean is the TRUE per-token mean over the dataset (a
+                # near-empty tail pack must not count like a full one).
+                # A device-resident mask's count stays a deferred
+                # handle — it rides the same single stacked transfer
+                # as the losses below instead of a per-batch sync
+                mv = mask._value if isinstance(mask, Tensor) else mask
+                weights.append(DeferredScalar(jnp.sum(mv))
+                               if isinstance(mv, jax.Array)
+                               else float(np.asarray(mv).sum()))
+            elif pad_to and self._tail_maskable:
                 ins, lbs, mask, _ = self._pad_tail(ins, lbs, pad_to)
             lv, _ = self.eval_batch(ins, lbs, loss_mask=mask)
             losses.append(lv)
         # one device->host sync for the whole pass: every per-batch handle
         # rides a single stacked transfer (framework.deferred)
-        vals = materialize_many(losses)
-        logs = {"loss": float(np.mean(vals)) if vals else 0.0}
+        vals = materialize_many(losses + weights)
+        vals, weights = vals[:len(losses)], vals[len(losses):]
+        if token_masked and vals and sum(weights) > 0:
+            logs = {"loss": float(np.average(vals, weights=weights))}
+        else:
+            logs = {"loss": float(np.mean(vals)) if vals else 0.0}
         for m in self._metrics:
             names = m.name() if isinstance(m.name(), list) else [m.name()]
             vals = m.accumulate()
@@ -934,7 +1073,11 @@ class Model:
         loader = self._as_loader(test_data, batch_size, False, num_workers,
                                  False)
         outputs = []
-        pad_to = self._tail_target(loader, need_mask=False)
+        # packing collators emit fixed-shape packs whose row count is
+        # unrelated to the loader's sequences-per-pack batch_size — row
+        # padding would corrupt them (and is never needed)
+        pad_to = None if self._token_masked(loader) else \
+            self._tail_target(loader, need_mask=False)
         for batch in self._buffered(loader):
             ins, _ = self._split_batch(batch)
             nreal = None

@@ -15,11 +15,13 @@ from .sampler import (BatchSampler, DistributedBatchSampler, RandomSampler,
                       Sampler, SequenceSampler, WeightedRandomSampler)
 from .dataloader import DataLoader, default_collate_fn, get_worker_info
 from .device_loader import DeviceFeeder
+from .packing import PackingCollator, suggest_rows
 
 __all__ = [
     "Dataset", "IterableDataset", "TensorDataset", "ComposeDataset",
     "ChainDataset", "ConcatDataset", "Subset", "random_split", "Sampler",
     "SequenceSampler", "RandomSampler", "WeightedRandomSampler",
     "BatchSampler", "DistributedBatchSampler", "DataLoader", "DeviceFeeder",
-    "default_collate_fn", "get_worker_info",
+    "PackingCollator", "suggest_rows", "default_collate_fn",
+    "get_worker_info",
 ]

@@ -519,8 +519,16 @@ class DataLoader:
                 deadline = (time.monotonic() + self.timeout
                             if self.timeout else None)
                 STAT_ADD("STAT_dataloader_batches")
-                yield _to_tensors(_shm_decode_ring(
-                    pending.pop(want), slot_q, ring_cache, uid))
+                decoded = _shm_decode_ring(pending.pop(want), slot_q,
+                                           ring_cache, uid)
+                if getattr(self.collate_fn, "emits_token_mask", False):
+                    # collate ran in a WORKER process — its pack
+                    # counters landed in the worker's registry copy;
+                    # re-derive them here so the parent's monitor sees
+                    # fill/throughput (packing.note_parent_pack_stats)
+                    from .packing import note_parent_pack_stats
+                    note_parent_pack_stats(decoded)
+                yield _to_tensors(decoded)
         finally:
             shutdown()
 

@@ -30,23 +30,39 @@ import numpy as np
 __all__ = ["flash_attention", "flash_attention_raw", "STATS"]
 
 _BLOCK_MIN = 128        # alignment the kernels require of S_q / S_kv
-_BLOCK_Q = 512          # preferred tile sizes: the on-chip sweep
-_BLOCK_K = 512          # (tools/perf_flash_sweep.py, v5e, S=2048, bf16)
-                        # picked 512/512; with native-dtype MXU dots the
-                        # GPT seq-2048 bench runs 1.47x dense (bench.py)
 _NEG_INF = -1e30
 
 
+def _block_pref(flag_name):
+    """Preferred tile size from a FLAGS_flash_block_* flag. The defaults
+    (512/512) are the on-chip sweep result (tools/perf_flash_sweep.py,
+    v5e, S=2048, bf16); with native-dtype MXU dots the GPT seq-2048
+    bench runs 1.47x dense (bench.py). The splash path rides the same
+    flags (tools/perf_splash_sweep.py re-runs the sweep for it)."""
+    from ..framework.flags import flag
+    pref = int(flag(flag_name))
+    if pref < _BLOCK_MIN or pref % _BLOCK_MIN != 0:
+        raise ValueError(
+            f"{flag_name}={pref}: attention tile sizes must be positive "
+            f"multiples of {_BLOCK_MIN}")
+    return pref
+
+
 def _pick_blocks(Sq, Sk):
-    """Largest preferred tile that divides the sequence lengths."""
+    """Largest preferred tile that divides the sequence lengths, capped
+    by the FLAGS_flash_block_q / FLAGS_flash_block_kv preferences."""
     for s in (Sq, Sk):
         if s % _BLOCK_MIN != 0:
             raise ValueError(
                 f"flash: sequence length {s} must be a multiple of "
                 f"{_BLOCK_MIN} (pad the sequence or route through dense "
                 f"attention via flash_supported)")
-    bq = max(b for b in (128, 256, _BLOCK_Q) if Sq % b == 0 and b <= Sq)
-    bk = max(b for b in (128, 256, _BLOCK_K) if Sk % b == 0 and b <= Sk)
+    prefq = _block_pref("FLAGS_flash_block_q")
+    prefk = _block_pref("FLAGS_flash_block_kv")
+    bq = max(b for b in sorted({128, 256, 512, prefq})
+             if Sq % b == 0 and b <= Sq and b <= prefq)
+    bk = max(b for b in sorted({128, 256, 512, prefk})
+             if Sk % b == 0 and b <= Sk and b <= prefk)
     return bq, bk
 
 from ..framework.monitor import stat_add as _stat_add, stat_get as _stat_get
@@ -392,30 +408,25 @@ def _flash_bwd_call(q, k, v, bias, seed, out, lse, g, causal, scale,
             dv.reshape(B, H, Sk, D))
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(5, 6, 7))
-def flash_attention_raw(q, k, v, bias, seed, causal, scale, dropout_p):
-    """Flash attention with O(S·D) memory in fwd AND bwd.
-
-    q/k/v: [B, H, S, D]; bias: additive key-padding mask [B, S] (zeros
-    for no mask); seed: int32 scalar driving in-kernel dropout; causal/
-    scale/dropout_p are static. bias and seed are non-differentiable.
-    """
-    out, _ = _flash_fwd_rule(q, k, v, bias, seed, causal, scale, dropout_p)
+@functools.partial(jax.custom_vjp, nondiff_argnums=(5, 6, 7, 8, 9))
+def _flash_raw_blocked(q, k, v, bias, seed, causal, scale, dropout_p,
+                       block_q, block_k):
+    out, _ = _flash_fwd_rule(q, k, v, bias, seed, causal, scale,
+                             dropout_p, block_q, block_k)
     return out
 
 
-def _flash_fwd_rule(q, k, v, bias, seed, causal, scale, dropout_p):
-    bq, bk = _pick_blocks(q.shape[2], k.shape[2])
+def _flash_fwd_rule(q, k, v, bias, seed, causal, scale, dropout_p,
+                    block_q, block_k):
     out, lse = _flash_call(q, k, v, bias, seed, causal, scale, dropout_p,
-                           bq, bk)
+                           block_q, block_k)
     return out, (q, k, v, bias, seed, out, lse)
 
 
-def _flash_bwd_rule(causal, scale, dropout_p, res, g):
+def _flash_bwd_rule(causal, scale, dropout_p, block_q, block_k, res, g):
     q, k, v, bias, seed, out, lse = res
-    bq, bk = _pick_blocks(q.shape[2], k.shape[2])
     dq, dk, dv = _flash_bwd_call(q, k, v, bias, seed, out, lse, g, causal,
-                                 scale, dropout_p, bq, bk)
+                                 scale, dropout_p, block_q, block_k)
     dbias = jnp.zeros(bias.shape, jax.dtypes.float0) \
         if not jnp.issubdtype(bias.dtype, jnp.floating) \
         else jnp.zeros_like(bias)
@@ -423,7 +434,26 @@ def _flash_bwd_rule(causal, scale, dropout_p, res, g):
     return dq, dk, dv, dbias, dseed
 
 
-flash_attention_raw.defvjp(_flash_fwd_rule, _flash_bwd_rule)
+_flash_raw_blocked.defvjp(_flash_fwd_rule, _flash_bwd_rule)
+
+
+def flash_attention_raw(q, k, v, bias, seed, causal, scale, dropout_p):
+    """Flash attention with O(S·D) memory in fwd AND bwd.
+
+    q/k/v: [B, H, S, D]; bias: additive key-padding mask [B, S] (zeros
+    for no mask); seed: int32 scalar driving in-kernel dropout; causal/
+    scale/dropout_p are static. bias and seed are non-differentiable.
+
+    Tile sizes are snapshotted HERE and threaded through the custom_vjp
+    as static args: the in-kernel dropout keep mask is reseeded per
+    (bh, q_block, k_block) tile, so a FLAGS_flash_block_* change
+    between an eager forward and its later backward must not let the
+    two passes pick different tiles (the replayed masks would silently
+    diverge and corrupt gradients).
+    """
+    bq, bk = _pick_blocks(q.shape[2], k.shape[2])
+    return _flash_raw_blocked(q, k, v, bias, seed, causal, scale,
+                              dropout_p, bq, bk)
 
 
 def flash_supported(q_shape, k_shape=None, v_shape=None, mask=None,

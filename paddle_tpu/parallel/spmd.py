@@ -33,7 +33,8 @@ from ..framework.tensor import Tensor
 from .mesh import get_mesh
 
 __all__ = ["param_sharding", "zero_sharding", "batch_sharding",
-           "batch_placement", "make_sharded_train_step", "shard_params"]
+           "batch_placement", "make_sharded_train_step", "shard_params",
+           "sharded_splash_attention"]
 
 
 def _spec_of(param) -> PartitionSpec:
@@ -282,6 +283,69 @@ def make_sharded_train_step(layer, optimizer, loss_fn: Callable,
     step.jitted = jit_step
     step.state_sharding = state_sharding
     return step, state
+
+
+def sharded_splash_attention(mesh=None, causal=False, scale=None,
+                             dropout_p=0.0, dp_axis="dp"):
+    """shard_map-wrapped splash attention for packed batches on a mesh.
+
+    GSPMD cannot partition a pallas_call — under plain pjit the kernel
+    would be gathered onto every device — so the kernel is wrapped in
+    `shard_map` with the batch axis split over `dp_axis` and segment ids
+    riding the same split (the SNIPPETS [1]/[3] pattern): each shard
+    runs the kernel on its local rows only, which is exactly right
+    because packing never creates cross-row attention.
+
+    Returns f(q, k, v, q_seg, kv_seg, seed=None) with q/k/v
+    [B, H, S, D] and segment ids [B, S] (B divisible by the dp degree).
+    `scale` defaults to 1/sqrt(D) at call time. With dropout_p > 0 a
+    fresh int32 seed is drawn per call from the framework RNG stream
+    (pass `seed` explicitly for reproducible replay) — the seed is a
+    traced argument, NOT baked into the jit, so every step gets a new
+    keep mask.
+    """
+    from jax.experimental.shard_map import shard_map
+
+    from ..framework import random as frandom
+    from ..ops.splash_ops import splash_attention_raw
+    mesh = mesh or get_mesh()
+    if mesh is None:
+        raise RuntimeError("sharded_splash_attention needs a live mesh "
+                           "(parallel.mesh.set_mesh / fleet.init)")
+    dp = dp_axis if dp_axis in mesh.axis_names and \
+        mesh.shape[dp_axis] > 1 else None
+    qkv_spec = PartitionSpec(dp, None, None, None)
+    seg_spec = PartitionSpec(dp, None)
+
+    def call(q, k, v, q_seg, kv_seg, seed):
+        sc = scale if scale is not None else 1.0 / (q.shape[-1] ** 0.5)
+        if dp is not None and dropout_p > 0.0:
+            # the kernel keys its keep mask on SHARD-LOCAL grid indices
+            # (pl.program_id over the local batch*heads), so a replicated
+            # seed would hand every dp shard the identical dropout
+            # pattern — fold the shard index in for independent draws
+            seed = seed + jax.lax.axis_index(dp)
+        return splash_attention_raw(q, k, v, q_seg, kv_seg, seed, causal,
+                                    sc, dropout_p)
+
+    jitted = jax.jit(shard_map(
+        call, mesh=mesh,
+        in_specs=(qkv_spec, qkv_spec, qkv_spec, seg_spec, seg_spec,
+                  PartitionSpec()),
+        out_specs=qkv_spec, check_rep=False))
+
+    def f(q, k, v, q_seg, kv_seg, seed=None):
+        if seed is None:
+            if dropout_p > 0.0:
+                seed = jax.random.randint(
+                    frandom.get_rng_key(), (), 0,
+                    np.int32(2 ** 31 - 1), dtype=jnp.int32)
+            else:
+                seed = jnp.zeros((), jnp.int32)
+        return jitted(q, k, v, q_seg, kv_seg,
+                      jnp.asarray(seed, jnp.int32))
+
+    return f
 
 
 def write_back(layer, state):

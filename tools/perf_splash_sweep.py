@@ -1,0 +1,110 @@
+"""Splash-attention tile sweep: re-run the flash block sweep for the
+segment-aware (packed) kernel on a real chip.
+
+Same on-device iteration-chaining methodology as perf_flash_sweep.py
+(one RPC dispatch, CHAIN data-dependent repeats inside the jit). The
+workload is a PACKED row: a realistic long-tail segment layout, so the
+measurement includes the block-skip win, not just the mask overhead.
+Feed the winner back through FLAGS_flash_block_q / FLAGS_flash_block_kv
+— the splash path reads the same flags as flash (ops/pallas_ops.py
+_pick_blocks). Run on-chip; interpret mode measures the interpreter.
+"""
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from paddle_tpu.ops import splash_ops as SP
+
+B, H, S, D = 4, 12, 2048, 64
+CAUSAL = True
+SCALE = 1.0 / (D ** 0.5)
+CHAIN = 16
+MEAN_SEG = 340          # ~6 segments per packed 2048-row (long-tail-ish)
+
+
+def _sync(out):
+    leaves = jax.tree_util.tree_leaves(out)
+    return float(jnp.sum(leaves[0].astype(jnp.float32).ravel()[:8]))
+
+
+def time_chained(one_step, q, k, v, reps=3):
+    def chained(q, k, v):
+        def body(_, qq):
+            return one_step(qq, k, v)
+        return jax.lax.fori_loop(0, CHAIN, body, q)
+    fn = jax.jit(chained)
+    _sync(fn(q, k, v))
+    best = float("inf")
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        _sync(fn(q, k, v))
+        best = min(best, time.perf_counter() - t0)
+    return best / CHAIN * 1e3
+
+
+def packed_segments(rng):
+    """Non-decreasing segment ids for one packed row: exponential
+    segment lengths clipped to the row."""
+    seg = np.zeros((B, S), np.int32)
+    for b in range(B):
+        o = sid = 0
+        while o < S:
+            L = max(16, int(rng.exponential(MEAN_SEG)))
+            seg[b, o:o + L] = sid
+            o += L
+            sid += 1
+    return jnp.asarray(seg)
+
+
+def main():
+    rng = np.random.RandomState(0)
+    q = jnp.asarray(rng.randn(B, H, S, D), jnp.bfloat16)
+    k = jnp.asarray(rng.randn(B, H, S, D), jnp.bfloat16)
+    v = jnp.asarray(rng.randn(B, H, S, D), jnp.bfloat16)
+    seg = packed_segments(rng)
+    seed = jnp.zeros((), jnp.int32)
+
+    def dense_step(q, k, v):
+        return SP.sdpa_segment_reference(q, k, v, seg, seg, CAUSAL, SCALE)
+    t = time_chained(dense_step, q, k, v)
+    print(f"dense segment-masked fwd:   {t:8.3f} ms")
+
+    for bq, bk in [(128, 128), (256, 256), (256, 512), (512, 512),
+                   (512, 1024), (1024, 1024)]:
+        def fstep(qq, k, v, bq=bq, bk=bk):
+            out, _ = SP._splash_call(qq, k, v, seg, seg, seed, CAUSAL,
+                                     SCALE, 0.0, bq, bk)
+            return out
+        try:
+            t = time_chained(fstep, q, k, v)
+        except Exception as e:  # noqa: BLE001
+            print(f"splash bq={bq:4d} bk={bk:4d}: FAILED {str(e)[:100]}")
+            continue
+
+        # splash_ops imported _pick_blocks by name — patch at its use site
+        orig_sp = SP._pick_blocks
+        SP._pick_blocks = lambda Sq, Sk, bq=bq, bk=bk: (bq, bk)
+
+        def gstep(qq, k, v):
+            g = jax.grad(lambda q_: SP.splash_attention_raw(
+                q_, k, v, seg, seg, seed, CAUSAL, SCALE, 0.0).astype(
+                    jnp.float32).sum())(qq)
+            return g.astype(qq.dtype)
+        try:
+            tg = time_chained(gstep, q, k, v)
+        except Exception:  # noqa: BLE001
+            tg = float("nan")
+        finally:
+            SP._pick_blocks = orig_sp
+        print(f"splash bq={bq:4d} bk={bk:4d}: fwd {t:8.3f} ms   "
+              f"dq-grad step {tg:8.3f} ms")
+
+
+if __name__ == "__main__":
+    main()
