@@ -145,6 +145,30 @@ register_flag("FLAGS_flight_recorder_interval_s", 2.0,
 register_flag("FLAGS_flight_recorder_max_dumps", 16,
               "most dump files kept per process; the oldest is pruned "
               "so a crash-looping failure path cannot fill the disk")
+register_flag("FLAGS_serving_spans", True,
+              "per-request latency attribution: submit() assigns a span "
+              "that stamps every pipeline phase (queued/claimed/padded/"
+              "dispatched/device_done/sliced/resolved), feeding the "
+              "serving_queue_ms/pad_ms/device_ms/resolve_ms histograms, "
+              "chrome-trace flow events linking submit to its lane's "
+              "dispatch/complete scopes, and the engine.stats() phase "
+              "breakdown; off removes the per-request accounting from "
+              "the hot path (profiler/spans.py)")
+register_flag("FLAGS_device_telemetry_interval_s", 5.0,
+              "period of the lazy device-telemetry sampler "
+              "(profiler/device_telemetry.py): per-device live HBM "
+              "bytes, cumulative compile-ms ledger, estimated train-step "
+              "FLOPs/MFU gauges — started by engines, Model.fit and the "
+              "MetricsServer; 0 disables telemetry (the sampler idles "
+              "and the per-compile cost-analysis retrace is skipped, so "
+              "untelemetered training pays nothing; explicit sample() "
+              "calls still refresh memory/compile gauges). Runtime "
+              "set_flags toggling works in both directions")
+register_flag("FLAGS_device_peak_flops", 0.0,
+              "per-device peak FLOP/s used for the MFU gauge; 0 = look "
+              "up the device kind in the built-in table (TPU v2-v5p "
+              "bf16 peaks) — unknown kinds (CPU test hosts) simply "
+              "don't export MFU")
 register_flag("FLAGS_metrics_port", 0,
               "profiler.exporter.MetricsServer port: serve /metrics "
               "(Prometheus text), /stats (JSON incl. engine lanes) and "

@@ -15,20 +15,22 @@ import time
 from typing import Dict
 
 __all__ = ["StatValue", "stat_add", "stat_sub", "stat_reset", "stat_get",
-           "all_stats", "stat_time", "STAT_ADD", "STAT_SUB", "STAT_RESET",
-           "StatHistogram", "histogram", "all_histograms",
-           "registered_histograms", "reset_all_stats"]
+           "stat_set", "all_stats", "stat_time", "STAT_ADD", "STAT_SUB",
+           "STAT_RESET", "StatHistogram", "histogram", "all_histograms",
+           "registered_histograms", "reset_all_stats", "drain_deltas",
+           "merge_deltas"]
 
 
 class StatValue:
     """One named counter (reference monitor.h:44)."""
 
-    __slots__ = ("name", "_v", "_lock")
+    __slots__ = ("name", "_v", "_lock", "gauge")
 
     def __init__(self, name: str):
         self.name = name
         self._v = 0
         self._lock = threading.Lock()
+        self.gauge = False  # set() flips it: a level, not a running total
 
     def increase(self, n: int = 1) -> int:
         with self._lock:
@@ -42,6 +44,26 @@ class StatValue:
         with self._lock:
             self._v = 0
             return 0
+
+    def set(self, v: int) -> int:
+        """Overwrite with an absolute level — gauge semantics (device
+        telemetry: live HBM bytes, MFU) as opposed to the counters'
+        monotone increase. Marks the stat as a gauge, which excludes it
+        from the cross-process delta relay (summing levels across
+        processes is meaningless)."""
+        with self._lock:
+            self._v = int(v)
+            self.gauge = True
+            return self._v
+
+    def drain(self) -> int:
+        """Atomically read-and-zero (the cross-process delta relay: a
+        DataLoader worker ships everything accumulated since its last
+        ship, exactly once)."""
+        with self._lock:
+            v = self._v
+            self._v = 0
+            return v
 
     def get(self) -> int:
         return self._v
@@ -135,6 +157,36 @@ class StatHistogram:
             out.append((le, cum))
         return out
 
+    def drain_raw(self):
+        """Atomically snapshot-and-reset the raw state as a compact
+        picklable blob `(sparse_counts, count, sum, min, max)` — the
+        DataLoader worker side of the cross-process relay. Sparse: most
+        of the 242 log buckets are empty for any one shipping window."""
+        with self._lock:
+            if self._count == 0:
+                return None
+            blob = ({i: c for i, c in enumerate(self._counts) if c},
+                    self._count, self._sum, self._min, self._max)
+            self._counts = [0] * (self._NBUCKETS + 2)
+            self._count = 0
+            self._sum = 0.0
+            self._min = float("inf")
+            self._max = float("-inf")
+            return blob
+
+    def merge_raw(self, sparse_counts, count, total, mn, mx) -> None:
+        """Fold another histogram's raw state into this one (the parent
+        side of the relay). Buckets are fixed and identical in every
+        process, so the merge is exact — not a re-observation through
+        snapshots, which would quantize twice."""
+        with self._lock:
+            for i, c in sparse_counts.items():
+                self._counts[int(i)] += int(c)
+            self._count += int(count)
+            self._sum += float(total)
+            self._min = min(self._min, float(mn))
+            self._max = max(self._max, float(mx))
+
     def mean(self) -> float:
         return self._sum / self._count if self._count else 0.0
 
@@ -220,6 +272,53 @@ def stat_reset(name: str) -> int:
 
 def stat_get(name: str) -> int:
     return _registry.get(name).get()
+
+
+def stat_set(name: str, v: int) -> int:
+    """Set an absolute gauge level (device telemetry samplers)."""
+    return _registry.get(name).set(v)
+
+
+def drain_deltas():
+    """Atomically drain every counter and histogram into one picklable
+    delta blob (None when nothing was touched). The multiprocess
+    DataLoader worker calls this per shipped batch so ANY stat bumped in
+    the worker process — packing counters, user collate_fn counters,
+    histograms — reaches the trainer's registry instead of dying with
+    the fork's private copy. Gauges (anything touched via `stat_set`)
+    are levels, not totals: they stay process-local and are neither
+    drained nor merged — summing a worker's level into the parent would
+    corrupt both sides."""
+    with _registry._lock:
+        stats = list(_registry._stats.values())
+        hists = list(_registry._hists.items())
+    out_s = {}
+    for s in stats:
+        if s.gauge:
+            continue
+        v = s.drain()
+        if v:
+            out_s[s.name] = v
+    out_h = {}
+    for n, h in hists:
+        blob = h.drain_raw()
+        if blob is not None:
+            out_h[n] = blob
+    if not out_s and not out_h:
+        return None
+    return {"stats": out_s, "hists": out_h}
+
+
+def merge_deltas(delta) -> None:
+    """Fold a `drain_deltas()` blob from another process into this
+    registry (additive for counters, exact bucket-merge for
+    histograms)."""
+    if not delta:
+        return
+    for n, v in delta.get("stats", {}).items():
+        _registry.get(n).increase(v)
+    for n, blob in delta.get("hists", {}).items():
+        _registry.get_hist(n).merge_raw(*blob)
 
 
 def all_stats() -> Dict[str, int]:

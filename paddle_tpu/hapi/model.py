@@ -91,7 +91,7 @@ from ..framework.tensor import Tensor
 from ..io import DataLoader, Dataset
 from ..io.device_loader import DeviceFeeder
 from ..metric import Metric
-from ..profiler import RecordEvent, flight_recorder
+from ..profiler import RecordEvent, device_telemetry, flight_recorder
 from . import callbacks as cbks_mod
 
 __all__ = ["Model"]
@@ -595,6 +595,22 @@ class Model:
             raise
         self._train_carry = new_carry
         STAT_ADD("STAT_train_steps")
+        if device_telemetry.active() and \
+                key not in getattr(self, "_flops_noted_keys", ()):
+            # estimated per-step FLOPs for the MFU gauge — HLO cost
+            # analysis on the lowered module, no second backend compile;
+            # new_carry shares the (possibly donated) carry's avals.
+            # Keyed on the compile-cache key and gated on the sampler
+            # being live, so telemetry enabled mid-training still gets
+            # FLOPs on the next step while inactive processes never pay
+            # the retrace.
+            if not hasattr(self, "_flops_noted_keys"):
+                self._flops_noted_keys = set()
+            self._flops_noted_keys.add(key)
+            device_telemetry.note_train_step_lowering(
+                fn, (new_carry, rng, jnp.asarray(step_no, "int32"),
+                     jnp.asarray(self._optimizer.get_lr(), "float32"),
+                     tuple(inputs), tuple(labels), mask))
         if not self._in_fit:
             # public custom-loop contract: a standalone train_batch call
             # writes updated params back to the network's Tensors (cheap
@@ -907,6 +923,7 @@ class Model:
         feed = self._buffered(loader)
         self._in_fit = True  # keep the carry live; write back at epoch ends
         flight_recorder.touch()  # periodic counter snapshots while training
+        device_telemetry.touch()  # HBM/compile/MFU gauges while training
         try:
             for epoch in range(epochs):
                 if hasattr(loader, "batch_sampler") and hasattr(

@@ -27,6 +27,14 @@ Counters (framework/monitor.py, parent side): STAT_shm_slots_reused —
 batches served from an already-mapped slot segment (steady state);
 STAT_shm_slot_segments — parent-side segment (re)maps: ring size + any
 regrows, constant across an arbitrarily long epoch.
+
+Cross-process stat relay: a worker's STAT_ADDs land in its fork's
+private registry copy, invisible to the trainer. Each worker therefore
+zeroes its copy at start and ships `monitor.drain_deltas()` (counters +
+raw histogram buckets, read-and-zero) alongside every result; the
+parent `merge_deltas()`s them at hand-out. ANY stat a collate_fn or
+dataset bumps in a worker — packing fill ratios, user counters,
+histograms — appears in the parent's /metrics, exactly once.
 """
 from __future__ import annotations
 
@@ -276,14 +284,21 @@ def _mp_worker_loop(dataset, collate_fn, worker_init_fn, wid, nw,
                     task_q, result_q, slot_q, use_shm, uid):
     """Target of one DataLoader worker process (numpy-only; never touches
     the accelerator)."""
+    from ..framework import monitor
     _worker_info.info = WorkerInfo(wid, nw, dataset)
+    # the fork inherited the parent's counter values; zero this process's
+    # private registry copy so every shipped delta is purely work done
+    # HERE — the generic cross-process stat relay (any STAT_*/histogram a
+    # collate_fn or dataset touches in a worker reaches the trainer's
+    # /metrics, not just the packing counters PR 6 special-cased)
+    monitor.reset_all_stats()
     ring_cache = {}  # slot -> (gen, SharedMemory) — this worker's mappings
     rc = 0
     if worker_init_fn:
         try:
             worker_init_fn(wid)
         except Exception:
-            result_q.put((-1, "err", _traceback.format_exc()))
+            result_q.put((-1, "err", _traceback.format_exc(), None))
             rc = 1
     while not rc:
         item = task_q.get()
@@ -298,9 +313,12 @@ def _mp_worker_loop(dataset, collate_fn, worker_init_fn, wid, nw,
                     continue
             else:
                 payload = (out, None, 0, 0, [])
-            result_q.put((seq, "ok", payload))
+            # drain-and-ship rides the result handoff: read-and-zero, so
+            # each delta merges into the parent exactly once
+            result_q.put((seq, "ok", payload, monitor.drain_deltas()))
         except Exception:
-            result_q.put((seq, "err", _traceback.format_exc()))
+            result_q.put((seq, "err", _traceback.format_exc(),
+                          monitor.drain_deltas()))
     for _, shm in ring_cache.values():
         try:
             shm.close()
@@ -479,10 +497,17 @@ class DataLoader:
             # liveness is polled so a dead worker still fails fast
             deadline = (time.monotonic() + self.timeout
                         if self.timeout else None)
+            from ..framework import monitor
             for want in range(total):
                 while want not in pending:
                     try:
-                        seq, status, payload = result_q.get(timeout=5)
+                        seq, status, payload, deltas = result_q.get(
+                            timeout=5)
+                        if deltas:
+                            # fold worker-side counters/histograms into
+                            # THIS process's registry (error ships too:
+                            # work done before the failure stays counted)
+                            monitor.merge_deltas(deltas)
                     except queue.Empty:
                         dead = [p.pid for p in procs if not p.is_alive()]
                         if dead:
@@ -521,13 +546,6 @@ class DataLoader:
                 STAT_ADD("STAT_dataloader_batches")
                 decoded = _shm_decode_ring(pending.pop(want), slot_q,
                                            ring_cache, uid)
-                if getattr(self.collate_fn, "emits_token_mask", False):
-                    # collate ran in a WORKER process — its pack
-                    # counters landed in the worker's registry copy;
-                    # re-derive them here so the parent's monitor sees
-                    # fill/throughput (packing.note_parent_pack_stats)
-                    from .packing import note_parent_pack_stats
-                    note_parent_pack_stats(decoded)
                 yield _to_tensors(decoded)
         finally:
             shutdown()

@@ -61,36 +61,17 @@ __all__ = ["PackingCollator", "suggest_rows"]
 
 
 def _note_pack(tokens, slots):
-    """Pack-level counter emission — one source for the collator (in
-    -process/thread workers) and the multiprocess parent re-derivation."""
+    """Pack-level counter emission. With num_workers > 0 these land in
+    the WORKER's registry copy and reach the trainer through the
+    DataLoader's generic cross-process stat relay
+    (`monitor.drain_deltas()` shipped with every batch) — including the
+    per-sequence drop/truncation counters the old mask-leaf
+    re-derivation could not reconstruct."""
     STAT_ADD("STAT_packing_packs")
     STAT_ADD("STAT_packing_tokens", tokens)
     STAT_ADD("STAT_packing_slots", slots)
     STAT_ADD("STAT_packing_fill_ratio_pct",
              int(round(100.0 * tokens / max(slots, 1))))
-
-
-def note_parent_pack_stats(batch):
-    """Re-derive the pack-level counters in the PARENT for the
-    multiprocess DataLoader path: with num_workers > 0 the collate runs
-    in a worker process, so the collator's own STAT_ADDs land in the
-    worker's copy of the registry and the training process would read
-    zeros. The token-mask leaf carries everything pack-level.
-    Drop/truncation counters and the drop warning are per-sequence and
-    cannot be reconstructed from the batch — they stay visible only
-    with in-process (num_workers=0) or thread workers."""
-    if not isinstance(batch, (tuple, list)) or len(batch) < 4:
-        return
-    m = np.asarray(batch[-1])
-    if m.ndim != 2:
-        return
-    _note_pack(int(m.sum()), int(m.size))
-    # position ids restart at 0 per segment, so (pos == 0 AND real)
-    # marks exactly one token per placed sequence
-    pos = np.asarray(batch[2])
-    if pos.shape == m.shape:
-        STAT_ADD("STAT_packing_sequences",
-                 int(((pos == 0) & (m > 0)).sum()))
 
 
 def suggest_rows(lengths, batch_size, max_tokens, headroom=1.1):

@@ -270,6 +270,8 @@ def make_sharded_train_step(layer, optimizer, loss_fn: Callable,
     if dgc:
         state["dgc"] = dgc_state
 
+    cost_noted = set()  # batch signatures whose FLOPs were estimated
+
     def step(state, inputs, labels, lr=None, rng=None):
         inputs = tuple(_place_batch(x, mesh, dp_axis, sp_axis)
                        for x in inputs)
@@ -278,7 +280,26 @@ def make_sharded_train_step(layer, optimizer, loss_fn: Callable,
         lr = jnp.asarray(optimizer.get_lr() if lr is None else lr,
                          "float32")
         rng = rng if rng is not None else frandom.get_rng_key()
-        return jit_step(state, inputs, labels, lr, rng)
+        out = jit_step(state, inputs, labels, lr, rng)
+        # per-step FLOPs for the MFU gauge, scaled by mesh size (the
+        # cost analysis sees the global program; peak = per-device peak
+        # x participating devices). Keyed per batch signature — jit_step
+        # recompiles when batch shapes change and the gauge must track
+        # the CURRENT step's cost, not the first-ever one — and gated on
+        # the sampler being live, so telemetry enabled mid-training
+        # still gets FLOPs while inactive processes never pay the
+        # retrace. New state shares the donated input's avals so
+        # lowering never touches consumed buffers.
+        key = tuple((tuple(x.shape), str(x.dtype))
+                    for x in inputs + labels)
+        if key not in cost_noted:
+            from ..profiler import device_telemetry
+            if device_telemetry.active():
+                cost_noted.add(key)
+                device_telemetry.note_train_step_lowering(
+                    jit_step, (out[0], inputs, labels, lr, rng),
+                    n_devices=int(mesh.devices.size))
+        return out
 
     step.jitted = jit_step
     step.state_sharding = state_sharding

@@ -7,15 +7,24 @@ in-process `all_stats()` calls).
 Endpoints (`MetricsServer`, 127.0.0.1, daemon threads, zero deps):
 
 - `/metrics` — Prometheus text: every monitor counter (`counter`, or
-  `gauge` for up-down stats like queue depth) and every
-  `StatHistogram` as a real `histogram` — the log-spaced buckets map
-  one-to-one onto cumulative `_bucket{le=...}` lines (zero-delta runs
-  coalesced), plus `_sum`/`_count`.
+  `gauge` for up-down/level stats — queue depth, device telemetry) and
+  every `StatHistogram` as a real `histogram` — the log-spaced buckets
+  map one-to-one onto cumulative `_bucket{le=...}` lines (zero-delta
+  runs coalesced), plus `_sum`/`_count`. A scrape refreshes the device
+  telemetry gauges so HBM/MFU are never interval-stale.
 - `/stats` — JSON: counters, histogram snapshots, every registered
-  `InferenceEngine.stats()` (lanes, buckets, occupancy), trace-ring and
-  flight-recorder state.
+  `InferenceEngine.stats()` (lanes, buckets, occupancy, phase
+  breakdown), device-telemetry snapshot, trace-ring state, and the
+  flight recorder's last-dump summaries (reason, timestamp, path) so
+  operators see recent postmortems without filesystem access.
 - `/trace` — the current chrome trace (same payload
   `export_chrome_tracing` writes), so a live timeline is one curl away.
+- `/healthz` — liveness: 200 whenever the process can answer.
+- `/readyz` — readiness: 200 iff ≥1 registered engine is warmed up,
+  has a live lane, is not draining, and its queue is below the
+  rejection threshold; 503 otherwise, always with per-engine/per-lane
+  JSON detail. This is the surface the router tier load-balances and
+  drains against.
 
 Wire-up: `InferenceEngine(metrics_port=)` / `FLAGS_metrics_port`, or
 `start_metrics_server(port)` directly (port 0 binds an ephemeral port —
@@ -24,6 +33,7 @@ read it back from `.port`).
 from __future__ import annotations
 
 import json
+import os
 import re
 import threading
 import weakref
@@ -32,14 +42,22 @@ from typing import Optional
 
 from ..framework import monitor
 from ..framework.flags import flag
-from . import flight_recorder, tracer
+from . import device_telemetry, flight_recorder, tracer
 
 __all__ = ["render_prometheus", "MetricsServer", "start_metrics_server",
-           "register_engine", "unregister_engine", "stats_payload"]
+           "register_engine", "unregister_engine", "stats_payload",
+           "readiness_payload"]
 
 _PREFIX = "paddle_tpu_"
 # up-down stats: current level, not a monotone total → Prometheus gauge
-_GAUGES = {"STAT_serving_queue_depth"}
+_GAUGES = {"STAT_serving_queue_depth", "STAT_train_step_flops",
+           "STAT_train_mfu_bp"}
+# device-telemetry levels set via stat_set (per-device ids vary)
+_GAUGE_SUFFIXES = ("_hbm_bytes_in_use", "_hbm_bytes_limit")
+
+
+def _is_gauge(name: str) -> bool:
+    return name in _GAUGES or name.endswith(_GAUGE_SUFFIXES)
 
 
 def _metric_name(name: str) -> str:
@@ -53,10 +71,14 @@ def _fmt(v: float) -> str:
 def render_prometheus() -> str:
     """Prometheus exposition text of every registered counter and
     histogram (reference StatRegistry publish, Prometheus-shaped)."""
+    try:  # refresh HBM/MFU gauges at scrape time (no-op off-accelerator)
+        device_telemetry.sample()
+    except Exception:
+        pass
     lines = []
     for name, v in monitor.all_stats().items():
         m = _metric_name(name)
-        typ = "gauge" if name in _GAUGES else "counter"
+        typ = "gauge" if _is_gauge(name) else "counter"
         lines.append(f"# TYPE {m} {typ}")
         lines.append(f"{m} {v}")
     for name, h in sorted(monitor.registered_histograms().items()):
@@ -133,9 +155,34 @@ def stats_payload() -> dict:
     return {"stats": monitor.all_stats(),
             "histograms": monitor.all_histograms(),
             "engines": _engines_snapshot(),
+            "device_telemetry": device_telemetry.snapshot(),
             "trace": tracer.ring_stats(),
             "flight_recorder": {"enabled": flight_recorder.enabled(),
-                                "dumps": flight_recorder.last_dumps()}}
+                                "dumps": flight_recorder.dump_records()}}
+
+
+def readiness_payload() -> dict:
+    """`(ready, detail)` shape for `/readyz`: the process is ready iff
+    at least one registered engine can take traffic right now — warmed
+    up, ≥1 live lane, not draining, queue below the rejection
+    threshold. Per-engine/per-lane detail always included so a router
+    can tell "warming up" from "draining" from "overloaded"."""
+    with _engines_lock:
+        items = list(_engines.items())
+    engines = {}
+    for name, ref in items:
+        eng = ref()
+        if eng is None:
+            continue
+        try:
+            engines[name] = eng.health()
+        except Exception as e:  # a dying engine reads as not-ready
+            engines[name] = {"ready": False, "reason": repr(e)}
+    ready = any(h.get("ready") for h in engines.values())
+    out = {"ready": ready, "engines": engines}
+    if not engines:
+        out["reason"] = "no engines registered"
+    return out
 
 
 # -- HTTP surface ----------------------------------------------------------
@@ -149,6 +196,7 @@ class _Handler(BaseHTTPRequestHandler):
     def do_GET(self):
         monitor.stat_add("STAT_metrics_requests")
         path = self.path.split("?", 1)[0]
+        status = 200
         try:
             if path in ("/", "/metrics"):
                 body = render_prometheus().encode()
@@ -161,14 +209,23 @@ class _Handler(BaseHTTPRequestHandler):
                 body = json.dumps(tracer.chrome_trace(),
                                   default=str).encode()
                 ctype = "application/json"
+            elif path == "/healthz":
+                body = json.dumps({"status": "ok",
+                                   "pid": os.getpid()}).encode()
+                ctype = "application/json"
+            elif path == "/readyz":
+                payload = readiness_payload()
+                status = 200 if payload["ready"] else 503
+                body = json.dumps(payload, default=str).encode()
+                ctype = "application/json"
             else:
-                self.send_error(404, "unknown endpoint "
-                                     "(have /metrics /stats /trace)")
+                self.send_error(404, "unknown endpoint (have /metrics "
+                                     "/stats /trace /healthz /readyz)")
                 return
         except Exception as e:  # noqa: BLE001 — a scrape never kills us
             self.send_error(500, repr(e))
             return
-        self.send_response(200)
+        self.send_response(status)
         self.send_header("Content-Type", ctype)
         self.send_header("Content-Length", str(len(body)))
         self.end_headers()
@@ -189,7 +246,8 @@ class MetricsServer:
             target=self._httpd.serve_forever, daemon=True,
             name=f"paddle_tpu-metrics-{self.port}")
         self._thread.start()
-        flight_recorder.touch()  # metrics users want the sampler running
+        flight_recorder.touch()   # metrics users want the samplers running
+        device_telemetry.touch()
 
     @property
     def url(self) -> str:

@@ -33,10 +33,11 @@ from typing import Optional
 from ..framework.flags import flag
 from . import tracer
 
-__all__ = ["enabled", "dump", "touch", "dump_dir", "last_dumps"]
+__all__ = ["enabled", "dump", "touch", "dump_dir", "last_dumps",
+           "dump_records"]
 
 _lock = threading.Lock()
-_dumps = []            # dump paths written by this process, oldest first
+_dumps = []            # {"path","reason","wall_time"} records, oldest first
 _seq = [0]
 _sampler = [None]      # the lazy background counter-sampler thread
 
@@ -55,7 +56,15 @@ def dump_dir() -> str:
 def last_dumps():
     """Paths of the dumps written by this process, oldest first."""
     with _lock:
-        return list(_dumps)
+        return [r["path"] for r in _dumps]
+
+
+def dump_records():
+    """`{path, reason, wall_time}` summaries of this process's dumps,
+    oldest first — the `/stats` postmortem index, so an operator sees
+    recent failures without filesystem access."""
+    with _lock:
+        return [dict(r) for r in _dumps]
 
 
 def _sampler_loop():
@@ -125,12 +134,13 @@ def dump(reason: str, extra: Optional[dict] = None) -> Optional[str]:
                 d, f"flightrec-{os.getpid()}-{_seq[0]:03d}-{reason}.json")
             with open(path, "w") as f:
                 json.dump(record, f, default=str)
-            _dumps.append(path)
+            _dumps.append({"path": path, "reason": reason,
+                           "wall_time": record["wall_time"]})
             keep = max(1, int(flag("FLAGS_flight_recorder_max_dumps")))
             while len(_dumps) > keep:
                 old = _dumps.pop(0)
                 try:
-                    os.remove(old)
+                    os.remove(old["path"])
                 except OSError:
                     pass
         monitor.stat_add("STAT_flight_recorder_dumps")

@@ -29,7 +29,10 @@ from typing import Dict, List, Optional, Tuple
 
 from ..framework.flags import flag
 
-# event: (name, ph, t0, t1) — ph "X" = complete scope, "i" = instant.
+# event: (name, ph, t0, t1) — ph "X" = complete scope, "i" = instant,
+# "s#<id>"/"t#<id>"/"f#<id>" = chrome flow start/step/finish carrying the
+# flow id (per-request spans link a submit scope to its lane's
+# dispatch/complete scopes across threads).
 _Event = Tuple[str, str, float, float]
 
 _MAX_RINGS = 512        # bound on remembered threads (oldest evicted)
@@ -139,6 +142,18 @@ def instant(name: str, t: Optional[float] = None) -> None:
     if _active():
         t = time.perf_counter() if t is None else t
         _my_ring().append((name, "i", t, t))
+
+
+def flow(name: str, ph: str, flow_id: int, t: Optional[float] = None) -> None:
+    """One chrome flow event on the calling thread: ph "s" (start), "t"
+    (step) or "f" (finish). Events with the same id render as arrows
+    linking the enclosing slices across threads — emit INSIDE the scope
+    the arrow should attach to."""
+    if ph not in ("s", "t", "f"):
+        raise ValueError(f"flow ph must be s/t/f, got {ph!r}")
+    if _active():
+        t = time.perf_counter() if t is None else t
+        _my_ring().append((name, f"{ph}#{int(flow_id)}", t, t))
 
 
 def sample_counters(names=None) -> None:
@@ -268,6 +283,14 @@ def chrome_trace(since: Optional[float] = None) -> dict:
                 trace.append({"name": name, "ph": "X", "pid": pid,
                               "tid": r.track, "ts": t0 * 1e6,
                               "dur": (t1 - t0) * 1e6})
+            elif "#" in ph:
+                p, fid = ph.split("#", 1)
+                ev = {"name": name, "cat": "serving", "ph": p,
+                      "id": int(fid), "pid": pid, "tid": r.track,
+                      "ts": t0 * 1e6}
+                if p == "f":
+                    ev["bp"] = "e"  # bind to enclosing slice's end
+                trace.append(ev)
             else:
                 trace.append({"name": name, "ph": "i", "s": "t",
                               "pid": pid, "tid": r.track, "ts": t0 * 1e6})

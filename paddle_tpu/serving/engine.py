@@ -34,7 +34,8 @@ from ..framework import monitor
 from ..framework.errors import (ExecutionTimeoutError, InvalidArgumentError,
                                 UnavailableError)
 from ..framework.flags import flag
-from ..profiler import RecordEvent, exporter, flight_recorder
+from ..profiler import (RecordEvent, device_telemetry, exporter,
+                        flight_recorder, spans)
 
 __all__ = ["EngineConfig", "InferenceEngine"]
 
@@ -94,14 +95,17 @@ class EngineConfig:
 
 
 class _Request:
-    __slots__ = ("arrays", "rows", "future", "deadline_ms", "t_enqueue_ms")
+    __slots__ = ("arrays", "rows", "future", "deadline_ms", "t_enqueue_ms",
+                 "span")
 
-    def __init__(self, arrays, rows, future, deadline_ms, t_enqueue_ms):
+    def __init__(self, arrays, rows, future, deadline_ms, t_enqueue_ms,
+                 span=None):
         self.arrays = arrays
         self.rows = rows
         self.future = future
         self.deadline_ms = deadline_ms
         self.t_enqueue_ms = t_enqueue_ms
+        self.span = span  # per-request phase clock (None when spans off)
 
 
 class _Lane:
@@ -154,18 +158,28 @@ class _Lane:
 
     # -- execution ---------------------------------------------------------
 
-    def _execute_async(self, arrays, rows: int, bucket: int):
+    def _execute_async(self, arrays, rows: int, bucket: int, reqs=None):
         """Pad to the bucket and enqueue the device call; returns
         device-resident output leaves WITHOUT a host sync (the completer
         blocks on them). Compile accounting is exact per replica: jit
-        traces are synchronous even under async dispatch."""
+        traces are synchronous even under async dispatch. `reqs` (live
+        requests riding this dispatch, None during warmup) get their
+        span phase stamps and flow-step events here."""
         if rows < bucket:
             arrays = [np.concatenate(
                 [a, np.zeros((bucket - rows,) + a.shape[1:], a.dtype)])
                 for a in arrays]
+        if reqs:
+            t_pad = time.perf_counter()
+            for r in reqs:
+                if r.span is not None:
+                    r.span.lane = self.index
+                    r.span.bucket = bucket
+                    r.span.stamp("padded", t_pad)
         with self._run_lock:
             c0 = (self.predictor.compile_count
                   if self.predictor is not None else None)
+            t_run0 = time.perf_counter()
             with RecordEvent(
                     f"serving::lane{self.index}::dispatch[b={bucket}]"):
                 if self.device is not None and self.predictor is None:
@@ -176,10 +190,21 @@ class _Lane:
                         out = self.runner(list(arrays))
                 else:
                     out = self.runner(list(arrays))
+                if reqs:
+                    # flow steps INSIDE the dispatch scope so the arrows
+                    # attach to this lane's dispatch slice
+                    for r in reqs:
+                        if r.span is not None:
+                            r.span.flow("t")
+            t_run1 = time.perf_counter()
             import jax
             leaves = jax.tree_util.tree_leaves(out)
             d = (self.predictor.compile_count - c0
                  if c0 is not None else None)
+        if reqs:
+            for r in reqs:
+                if r.span is not None:
+                    r.span.stamp("dispatched", t_run1)
         eng = self.engine
         with eng._stats_lock:
             # setdefault: unsliceable models run ad-hoc exact-size "buckets"
@@ -196,6 +221,13 @@ class _Lane:
                 st["compiles"] += d
         if d:
             monitor.stat_add("STAT_serving_bucket_compiles", d)
+            # the dispatch wall of a compiling call is compile-dominated:
+            # feed the cumulative per-(device, bucket) compile ledger
+            dev_key = (getattr(self.device, "id", None)
+                       if self.device is not None else None)
+            device_telemetry.note_compile(
+                f"d{dev_key}" if dev_key is not None else f"lane{self.index}",
+                bucket, t_run1 - t_run0)
         return leaves
 
     def _units_for(self, batch: List[_Request]):
@@ -219,7 +251,7 @@ class _Lane:
             concat = [batch[0].arrays[i] if len(batch) == 1 else
                       np.concatenate([r.arrays[i] for r in batch])
                       for i in range(nin)]
-            leaves = self._execute_async(concat, rows, bucket)
+            leaves = self._execute_async(concat, rows, bucket, reqs=batch)
             return [(batch, rows, bucket, leaves, None)]
         except Exception as e:  # noqa: BLE001
             if len(batch) == 1:
@@ -295,6 +327,11 @@ class _Lane:
                     # THE host sync: under async dispatch a device-side
                     # failure (nan trap, OOM) surfaces here, not at dispatch
                     outs = [np.asarray(leaf) for leaf in leaves]
+                    t_sync = time.perf_counter()
+                    for req in reqs:
+                        if req.span is not None:
+                            req.span.stamp("device_done", t_sync)
+                            req.span.flow("f")  # arrow ends in this scope
             except Exception as e:  # noqa: BLE001
                 err = e
         if err is not None:
@@ -357,13 +394,19 @@ class _Lane:
                    if (getattr(o, "ndim", 0) >= 1 and o.shape[0] == bucket)
                    else o for o in outs]
             off += req.rows
+            if req.span is not None:
+                req.span.stamp("sliced")
             eng._hist.observe(t_done - req.t_enqueue_ms)
             if self._expired(req, t_done):
-                continue
+                continue  # abandoned span: phase hists mean DELIVERED work
             try:
                 req.future.set_result(res)
             except Exception:  # racing caller-side cancel
                 pass
+            else:
+                if req.span is not None:
+                    req.span.stamp("resolved")
+                    req.span.finish()
 
     def _complete_loop(self):
         units = None
@@ -399,9 +442,11 @@ class _Lane:
             except Exception:
                 pass
 
-    def _drain_pending(self) -> int:
+    def _drain_pending(self, span_sink=None) -> int:
         """Fail every dispatched-but-uncompleted unit; returns how many
-        routed batches were dropped (for in-flight accounting)."""
+        routed batches were dropped (for in-flight accounting).
+        `span_sink` collects the failed requests for the postmortem's
+        in-flight span list."""
         dropped = 0
         while True:
             try:
@@ -413,6 +458,8 @@ class _Lane:
             dropped += 1
             for u in units:
                 self._fail_reqs(u[0], self.death_cause)
+                if span_sink is not None:
+                    span_sink.extend(u[0])
 
     def _die(self, exc: BaseException, current_batch,
              current_reqs: Optional[list] = None):
@@ -445,32 +492,40 @@ class _Lane:
             monitor.stat_add("STAT_serving_lane_deaths")
             monitor.stat_add(f"STAT_serving_lane{self.index}_deaths")
         dropped = 0
+        died_reqs = []  # everything this death failed, for span postmortem
         if current_batch is not None:
             self._fail_reqs(current_batch, exc)
+            died_reqs.extend(current_batch)
             dropped += 1
         if current_reqs:
             self._fail_reqs(current_reqs, exc)
+            died_reqs.extend(current_reqs)
             dropped += 1
         for b in stranded_batches:
             self._fail_reqs(b, exc)
+            died_reqs.extend(b)
             dropped += 1
         if current_reqs is not None:
             # completer is the dying thread: nobody will consume `pending`
-            dropped += self._drain_pending()
+            dropped += self._drain_pending(span_sink=died_reqs)
         if dropped:
             self._dec_inflight(dropped)
         if first:
             # postmortem artifact AFTER every stranded future is failed:
             # the dump is file IO and must never delay a waiting caller.
             # Its event tail carries this lane's last dispatch/complete
-            # scopes — the context the raised UnavailableError lacks.
+            # scopes — the context the raised UnavailableError lacks —
+            # and the in-flight spans say exactly which phase each
+            # stranded request died in.
             flight_recorder.dump("serving_lane_death", {
                 "engine": eng.name, "lane": self.index,
                 "device": str(self.device) if self.device is not None
                 else None, "thread": threading.current_thread().name,
                 "error": repr(exc), "dropped_batches": dropped,
                 "lane_batches_completed": self.batches,
-                "lane_rows_completed": self.rows})
+                "lane_rows_completed": self.rows,
+                "inflight_spans": [r.span.to_dict() for r in died_reqs
+                                   if r.span is not None][:64]})
 
 
 class InferenceEngine:
@@ -547,19 +602,23 @@ class InferenceEngine:
                               for b in self._cfg.batch_buckets}
         self._hist = monitor.histogram(f"{name}_request_ms")
         self._inflight_hist = monitor.histogram(f"{name}_inflight_depth")
+        # observability surfaces BEFORE warmup: registering early means
+        # /readyz reports this engine as warming up (ready:false with
+        # warmup_complete:false) instead of not existing — the signal a
+        # router needs to hold traffic during a rolling restart
+        self._warmed = False
+        flight_recorder.touch()
+        device_telemetry.touch()
+        exporter.register_engine(self)
         if self._cfg.warmup:
             self._warmup()
+        self._warmed = True
         for lane in self._lanes:
             lane.start()
         self._collector = threading.Thread(target=self._collector_loop,
                                            name=f"{name}-collector",
                                            daemon=True)
         self._collector.start()
-        # observability surfaces: flight-recorder periodic sampler, the
-        # /stats engine registry, and (opt-in via metrics_port= or
-        # FLAGS_metrics_port) the shared HTTP metrics server
-        flight_recorder.touch()
-        exporter.register_engine(self)
         # an explicit port 0 binds an ephemeral, never-shared server —
         # this engine owns it and must close it on shutdown
         self._owns_metrics_server = (metrics_port is not None
@@ -737,6 +796,11 @@ class InferenceEngine:
                         f"{self._cfg.max_queue_depth} reached "
                         f"({len(self._queue)} pending); shed load or "
                         f"raise FLAGS_serving_max_queue_depth")
+                # span AFTER the admission checks: a rejected submit must
+                # not leave an orphan flow-start polluting the bounded
+                # trace ring. "queued" stamps here — queue time starts at
+                # admission — and the flow arrow leaves this submit scope
+                req.span = spans.start(self.name)
                 self._queue.append(req)
                 monitor.stat_add("STAT_serving_queue_depth")
                 self._cv.notify_all()
@@ -780,6 +844,8 @@ class InferenceEngine:
         monitor.stat_sub("STAT_serving_queue_depth")
         if not req.future.set_running_or_notify_cancel():
             return None
+        if req.span is not None:
+            req.span.stamp("claimed")
         return req
 
     def _collect(self) -> Optional[List[_Request]]:
@@ -975,7 +1041,39 @@ class InferenceEngine:
             "mean_occupancy": round(served / slots, 4) if slots else 0.0,
             "latency_ms": self._hist.snapshot(),
             "inflight_depth": self._inflight_hist.snapshot(),
+            # per-phase attribution (process-global across engines, like
+            # every STAT counter; per-engine e2e is latency_ms above)
+            "phases": spans.phase_snapshot(),
         }
+
+    def health(self) -> dict:
+        """Readiness verdict for `/readyz`: can this engine take traffic
+        RIGHT NOW? Ready = warmup done, not draining/closed, ≥1 live
+        lane, intake queue below the rejection threshold. Always carries
+        per-lane detail so a router can drain or route around a sick
+        replica instead of just dropping it."""
+        with self._cv:
+            depth = len(self._queue)
+            draining = self._closed
+            lanes = [{"index": l.index, "alive": l.alive,
+                      "inflight": l.inflight} for l in self._lanes]
+        live = sum(1 for l in lanes if l["alive"])
+        limit = self._cfg.max_queue_depth
+        warmed = self._warmed
+        if draining:
+            reason = "draining"
+        elif not warmed:
+            reason = "warming up"
+        elif live == 0:
+            reason = "no live lanes"
+        elif depth >= limit:
+            reason = "queue at rejection threshold"
+        else:
+            reason = "ok"
+        return {"ready": reason == "ok", "reason": reason,
+                "warmup_complete": warmed, "draining": draining,
+                "live_lanes": live, "queue_depth": depth,
+                "queue_limit": limit, "lanes": lanes}
 
     def shutdown(self, drain: bool = True, timeout_s: Optional[float] = None):
         """Stop intake; by default the collector routes every queued

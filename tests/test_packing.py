@@ -319,9 +319,9 @@ def test_masked_loss_row_mask_still_works():
 
 def test_mp_loader_parent_sees_pack_counters():
     """num_workers>0 runs collate in WORKER processes, whose STAT_ADDs
-    land in the worker's registry copy — the parent re-derives the
-    pack-level counters from the mask leaf at hand-out
-    (io.packing.note_parent_pack_stats), so monitoring keeps working."""
+    land in the worker's registry copy — the generic cross-process stat
+    relay (workers ship monitor.drain_deltas() with every batch; the
+    parent merges at hand-out) keeps monitoring working."""
     seqs = _seqs(12, seed=20)
     coll = PackingCollator(T, 4)
     loader = DataLoader(SeqData(seqs), batch_size=6, shuffle=False,

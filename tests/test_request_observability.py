@@ -1,0 +1,493 @@
+"""Request-level latency attribution, device telemetry, cross-process
+metrics, and health endpoints (ISSUE 7): per-request spans whose phase
+durations reconcile with end-to-end latency, chrome-trace flow events
+linking submit to lane scopes across threads, the worker→parent stat
+relay, `/healthz`/`/readyz`, the flight-dump summaries in `/stats`, the
+offline latency report, and the bidirectional check_stats lint.
+"""
+import importlib.util
+import io
+import json
+import os
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import profiler, serving
+from paddle_tpu.framework import monitor
+from paddle_tpu.io import DataLoader
+from paddle_tpu.profiler import (device_telemetry, exporter,
+                                 flight_recorder, spans, tracer)
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+PHASE_HISTS = ("serving_queue_ms", "serving_pad_ms", "serving_device_ms",
+               "serving_resolve_ms")
+
+
+def _echo(arrays):
+    return [np.asarray(arrays[0]) * 2.0]
+
+
+def _reqspans(engine_name):
+    """Parse this process's reqspan trace instants for one engine into
+    [{rid, lane, bucket, q, p, d, r, e}] (ms)."""
+    out = []
+    for name, ph, *_ in tracer.events(since=0, with_threads=True):
+        if ph != "i" or not name.startswith("reqspan:"):
+            continue
+        head, vals = name.rsplit(":", 1)
+        _, rid, eng, lane, bucket = head.split(":")
+        if eng != engine_name:
+            continue
+        rec = {"rid": int(rid), "lane": lane, "bucket": bucket}
+        for kv in vals.split(","):
+            k, v = kv.split("=")
+            rec[k] = float(v)
+        out.append(rec)
+    return out
+
+
+# ---------------------------------------------------------------------------
+# tentpole 1: per-request spans
+# ---------------------------------------------------------------------------
+
+def test_span_phases_reconcile_with_end_to_end():
+    """Acceptance: for each completed request the four phase durations
+    sum to the measured end-to-end latency within bounded slack, and the
+    per-phase histograms land in /metrics."""
+    before = {h: monitor.histogram(h).count for h in PHASE_HISTS}
+    eng = serving.InferenceEngine(
+        _echo, input_spec=[([None, 4], "float32")], name="obs7_phases",
+        max_batch_size=8, batch_buckets=(1, 8), max_batch_delay_ms=1.0)
+    walls = []
+    try:
+        for i in range(8):
+            t0 = time.perf_counter()
+            r = eng.run(np.full((1, 4), float(i), "float32"),
+                        timeout_ms=30000)
+            walls.append((time.perf_counter() - t0) * 1000.0)
+            assert np.allclose(r[0], 2.0 * i)
+    finally:
+        eng.shutdown()
+    recs = _reqspans("obs7_phases")
+    assert len(recs) >= 8
+    for rec in recs:
+        total = rec["q"] + rec["p"] + rec["d"] + rec["r"]
+        # the stamps are consecutive boundaries of one clock, so the sum
+        # telescopes to the span's own e2e (up to the 3-decimal-ms
+        # rounding of the trace encoding)
+        assert total == pytest.approx(rec["e"], abs=5e-3)
+        assert all(rec[k] >= 0 for k in "qpdr")
+    # ... and the span e2e reconciles with the caller-observed wall
+    # (wall includes submit validation + future wakeup on top)
+    med_e = sorted(r["e"] for r in recs)[len(recs) // 2]
+    med_wall = sorted(walls)[len(walls) // 2]
+    assert med_e <= med_wall + 1.0
+    assert med_wall - med_e < 250.0  # bounded slack
+    for h in PHASE_HISTS:
+        assert monitor.histogram(h).count >= before[h] + 8
+    # engine.stats() carries the phase breakdown
+    text = exporter.render_prometheus()
+    for h in PHASE_HISTS:
+        assert f'paddle_tpu_{h}_bucket{{le="+Inf"}}' in text
+
+
+def test_spans_flag_off_disables_accounting():
+    prev = paddle.get_flags(["FLAGS_serving_spans"])
+    paddle.set_flags({"FLAGS_serving_spans": False})
+    before = monitor.histogram("serving_queue_ms").count
+    try:
+        eng = serving.InferenceEngine(
+            _echo, input_spec=[([None, 4], "float32")], name="obs7_off",
+            max_batch_size=4, batch_buckets=(4,), max_batch_delay_ms=0.5)
+        try:
+            eng.run(np.ones((1, 4), "float32"), timeout_ms=30000)
+        finally:
+            eng.shutdown()
+    finally:
+        paddle.set_flags(prev)
+    assert monitor.histogram("serving_queue_ms").count == before
+    assert _reqspans("obs7_off") == []
+
+
+def test_multilane_trace_has_flow_events_and_lane_thread_names():
+    """Satellite: lane dispatcher/completer thread names and flow events
+    present in the chrome trace for a multi-lane engine; the flow start
+    (submit thread) and finish (completer thread) share an id across
+    different tids."""
+    eng = serving.InferenceEngine(
+        [_echo, _echo], input_spec=[([None, 4], "float32")],
+        name="obs7_flow", max_batch_size=2, batch_buckets=(2,),
+        max_batch_delay_ms=0.5)
+    try:
+        futs = [eng.submit(np.full((1, 4), float(i), "float32"),
+                           timeout_ms=30000) for i in range(8)]
+        for f in futs:
+            f.result(timeout=30)
+    finally:
+        eng.shutdown()
+    trace = tracer.chrome_trace()["traceEvents"]
+    tracks = {e["args"]["name"] for e in trace
+              if e.get("ph") == "M" and e.get("name") == "thread_name"}
+    for want in ("obs7_flow-collector",
+                 "obs7_flow-lane0-dispatch", "obs7_flow-lane0-complete",
+                 "obs7_flow-lane1-dispatch", "obs7_flow-lane1-complete"):
+        assert want in tracks, (want, tracks)
+    flows = [e for e in trace if e.get("ph") in ("s", "t", "f")
+             and e.get("cat") == "serving"]
+    starts = {e["id"]: e["tid"] for e in flows if e["ph"] == "s"}
+    finishes = {e["id"]: e["tid"] for e in flows if e["ph"] == "f"}
+    linked = set(starts) & set(finishes)
+    assert linked  # at least one request's arrow spans submit → complete
+    assert any(starts[i] != finishes[i] for i in linked)  # across threads
+    assert all(e["ph"] != "f" or e.get("bp") == "e" for e in flows)
+
+
+class _SpanKiller(BaseException):
+    pass
+
+
+def test_lane_death_dump_carries_inflight_spans(tmp_path):
+    prev = paddle.get_flags(["FLAGS_flight_recorder_dir",
+                             "FLAGS_flight_recorder"])
+    paddle.set_flags({"FLAGS_flight_recorder_dir": str(tmp_path),
+                      "FLAGS_flight_recorder": True})
+
+    def replica(arrays):
+        a = np.asarray(arrays[0])
+        if (a == 666.0).any():
+            raise _SpanKiller("wedged")
+        return [a]
+
+    try:
+        eng = serving.InferenceEngine(
+            replica, input_spec=[([None, 4], "float32")],
+            name="obs7_death", max_batch_size=1, batch_buckets=(1,),
+            max_batch_delay_ms=0.0)
+        try:
+            eng.run(np.ones((1, 4), "float32"), timeout_ms=30000)
+            with pytest.raises(Exception):
+                eng.submit(np.full((1, 4), 666.0, "float32")).result(
+                    timeout=30)
+        finally:
+            eng.shutdown()
+        deadline = time.monotonic() + 10
+        hits = []
+        while time.monotonic() < deadline and not hits:
+            hits = sorted(tmp_path.glob("*serving_lane_death.json"))
+            time.sleep(0.05)
+        assert hits, "no lane-death dump"
+        rec = json.load(open(hits[-1]))
+        spans_dumped = rec["extra"]["inflight_spans"]
+        assert spans_dumped, "dying lane's in-flight spans missing"
+        assert spans_dumped[0]["engine"] == "obs7_death"
+        # the poisoned request died after dispatch: its phase stamps show
+        # how far it got
+        assert "queued" in spans_dumped[0]["phases"]
+        assert spans_dumped[0]["age_ms"] >= 0
+    finally:
+        paddle.set_flags(prev)
+
+
+# ---------------------------------------------------------------------------
+# tentpole 2: device telemetry
+# ---------------------------------------------------------------------------
+
+def test_compile_ledger_fed_by_lane_compiles():
+    snap0 = device_telemetry.snapshot()["compile_seconds"]
+    eng = serving.InferenceEngine(
+        _echo, input_spec=[([None, 4], "float32")], name="obs7_ledger",
+        max_batch_size=4, batch_buckets=(4,), max_batch_delay_ms=0.5)
+    try:
+        eng.run(np.ones((2, 4), "float32"), timeout_ms=30000)
+    finally:
+        eng.shutdown()
+    snap = device_telemetry.snapshot()["compile_seconds"]
+    new = {k: v for k, v in snap.items() if v > snap0.get(k, 0)}
+    assert any(k.endswith("/b4") for k in new), (snap0, snap)
+    text = exporter.render_prometheus()
+    assert "paddle_tpu_stat_compile_ms_" in text
+
+
+def test_mfu_and_flops_gauges_from_train_step():
+    """Telemetry active + known peak → a fit exports estimated per-step
+    FLOPs and an MFU gauge; CPU memory_stats absence stays a no-op."""
+    prev = paddle.get_flags(["FLAGS_device_peak_flops"])
+    # absurdly small peak so even a toy net rounds to nonzero basis pts
+    paddle.set_flags({"FLAGS_device_peak_flops": 1.0})
+    device_telemetry.touch()  # sampler active → cost analysis enabled
+    assert device_telemetry.active()
+    try:
+        x = np.random.RandomState(0).randn(32, 8).astype("float32")
+        y = np.random.RandomState(1).randint(0, 3, 32).astype("int64")
+        import paddle_tpu.nn as nn
+        net = nn.Sequential(nn.Linear(8, 16), nn.ReLU(), nn.Linear(16, 3))
+        model = paddle.Model(net)
+        model.prepare(paddle.optimizer.Adam(0.01,
+                                            parameters=net.parameters()),
+                      nn.CrossEntropyLoss())
+        model._dist_ctx = None
+        device_telemetry.sample()  # baseline window anchor
+        model.train_batch([x], [y])
+        assert monitor.stat_get("STAT_train_step_flops") > 0
+        # the window anchor is process-global and shared with the 5s
+        # background sampler (started by earlier tests): any one sample
+        # of ours can lose the anchor race or observe a decayed idle
+        # window — but a loop of train→wait→sample must see a positive
+        # MFU window from SOME caller within a few iterations
+        mfu = 0
+        for _ in range(10):
+            model.train_batch([x], [y])
+            time.sleep(0.6)  # ≥ _MIN_MFU_WINDOW_S so the anchor advances
+            out = device_telemetry.sample()
+            mfu = max(mfu, out["mfu_bp"] or 0,
+                      monitor.stat_get("STAT_train_mfu_bp"))
+            if mfu > 0:
+                break
+        assert mfu > 0
+        text = exporter.render_prometheus()
+        assert "# TYPE paddle_tpu_stat_train_mfu_bp gauge" in text
+        assert "# TYPE paddle_tpu_stat_train_step_flops gauge" in text
+    finally:
+        paddle.set_flags(prev)
+
+
+def test_memory_stats_graceful_noop_off_accelerator():
+    out = device_telemetry.sample()  # CPU backend: no memory stats
+    assert isinstance(out["devices"], dict)  # empty, not an exception
+
+
+# ---------------------------------------------------------------------------
+# tentpole 3: cross-process stat relay
+# ---------------------------------------------------------------------------
+
+class _RelayData:
+    def __len__(self):
+        return 16
+
+    def __getitem__(self, i):
+        return np.full((4,), float(i), "float32")
+
+
+def _relay_collate(batch):
+    # runs in the WORKER process: both a counter and a histogram that
+    # exist nowhere in the parent until the relay merges them
+    monitor.stat_add("STAT_obs7_worker_only")
+    monitor.histogram("obs7_worker_ms").observe(1.5)
+    # gauges are levels: the relay must NOT sum them into the parent
+    monitor.stat_set("STAT_obs7_worker_gauge", 5)
+    return np.stack(batch)
+
+
+@pytest.mark.skipif(os.environ.get("PADDLE_TPU_TEST_ON_CHIP") == "1",
+                    reason="mp workers assume the CPU test mesh")
+def test_worker_incremented_stats_visible_in_parent():
+    c0 = monitor.stat_get("STAT_obs7_worker_only")
+    h0 = monitor.histogram("obs7_worker_ms").count
+    loader = DataLoader(_RelayData(), batch_size=4, num_workers=2,
+                        shuffle=False, collate_fn=_relay_collate)
+    batches = list(loader)
+    assert len(batches) == 4
+    assert monitor.stat_get("STAT_obs7_worker_only") - c0 == 4
+    assert monitor.histogram("obs7_worker_ms").count - h0 == 4
+    # a worker-set gauge stays process-local (4 batches would otherwise
+    # have summed 4x5=20 into a "level")
+    assert monitor.stat_get("STAT_obs7_worker_gauge") == 0
+    # /metrics sees a counter only ever incremented in a worker process
+    assert "paddle_tpu_stat_obs7_worker_only" in exporter.render_prometheus()
+
+
+# ---------------------------------------------------------------------------
+# tentpole 4: /healthz + /readyz
+# ---------------------------------------------------------------------------
+
+def _get(url):
+    """(status, json_body) — readyz speaks 503 with a JSON body."""
+    try:
+        with urllib.request.urlopen(url, timeout=10) as resp:
+            return resp.status, json.load(resp)
+    except urllib.error.HTTPError as e:
+        body = e.read().decode()
+        try:
+            return e.code, json.loads(body)
+        except ValueError:
+            return e.code, {}
+
+
+def test_healthz_and_readyz_lifecycle():
+    """/readyz flips not-ready (no engines / warming up) → ready →
+    draining → not-ready across an engine's lifecycle; /healthz stays
+    200 throughout."""
+    srv = exporter.MetricsServer(0)
+    name = "obs7_ready"
+    warm_gate = threading.Event()
+    hold_gate = threading.Event()
+    first = [True]
+
+    def runner(arrays):
+        a = np.asarray(arrays[0])
+        if first[0]:
+            first[0] = False
+            assert warm_gate.wait(timeout=30)  # warmup's bucket compile
+        if (a == 7.0).any():
+            assert hold_gate.wait(timeout=30)  # keeps shutdown draining
+        return [a]
+
+    try:
+        status, body = _get(srv.url + "/healthz")
+        assert status == 200 and body["status"] == "ok"
+        status, body = _get(srv.url + "/readyz")
+        assert status == 503 and body["ready"] is False
+        assert "no engines" in body.get("reason", "")
+
+        built = {}
+
+        def build():
+            built["eng"] = serving.InferenceEngine(
+                runner, input_spec=[([None, 2], "float32")], name=name,
+                max_batch_size=1, batch_buckets=(1,),
+                max_batch_delay_ms=0.0)
+
+        t = threading.Thread(target=build, daemon=True)
+        t.start()
+        # engine registers before warmup: readyz must say warming up
+        deadline = time.monotonic() + 10
+        seen_warming = False
+        while time.monotonic() < deadline:
+            status, body = _get(srv.url + "/readyz")
+            h = body.get("engines", {}).get(name)
+            if h is not None:
+                assert status == 503 and body["ready"] is False
+                assert h["warmup_complete"] is False
+                seen_warming = True
+                break
+            time.sleep(0.01)
+        assert seen_warming, "engine never appeared while warming"
+        warm_gate.set()
+        t.join(timeout=30)
+        eng = built["eng"]
+        deadline = time.monotonic() + 10
+        while time.monotonic() < deadline:
+            status, body = _get(srv.url + "/readyz")
+            if status == 200:
+                break
+            time.sleep(0.01)
+        assert status == 200 and body["ready"] is True
+        h = body["engines"][name]
+        assert h["warmup_complete"] and h["live_lanes"] == 1
+        assert h["lanes"][0]["alive"] is True
+
+        # draining: shutdown(drain=True) with a request still in flight
+        fut = eng.submit(np.full((1, 2), 7.0, "float32"), timeout_ms=0)
+        st = threading.Thread(target=eng.shutdown, daemon=True)
+        st.start()
+        deadline = time.monotonic() + 10
+        seen_draining = False
+        while time.monotonic() < deadline:
+            status, body = _get(srv.url + "/readyz")
+            h = body.get("engines", {}).get(name)
+            if h is not None and h.get("draining"):
+                assert status == 503 and h["ready"] is False
+                assert h["reason"] == "draining"
+                seen_draining = True
+                break
+            time.sleep(0.01)
+        assert seen_draining, "draining state never observed"
+        hold_gate.set()
+        st.join(timeout=30)
+        fut.result(timeout=30)  # drain completed the held request
+        # after shutdown the engine has left the registry
+        status, body = _get(srv.url + "/readyz")
+        assert status == 503 and name not in body.get("engines", {})
+    finally:
+        warm_gate.set()
+        hold_gate.set()
+        srv.close()
+
+
+# ---------------------------------------------------------------------------
+# satellites: /stats dump summaries, latency report, check_stats both ways
+# ---------------------------------------------------------------------------
+
+def test_stats_payload_carries_dump_summaries(tmp_path):
+    prev = paddle.get_flags(["FLAGS_flight_recorder_dir",
+                             "FLAGS_flight_recorder"])
+    paddle.set_flags({"FLAGS_flight_recorder_dir": str(tmp_path),
+                      "FLAGS_flight_recorder": True})
+    try:
+        path = flight_recorder.dump("obs7_summary", {"k": 1})
+        assert path
+        dumps = exporter.stats_payload()["flight_recorder"]["dumps"]
+        rec = dumps[-1]
+        assert rec["reason"] == "obs7_summary"
+        assert rec["path"] == path
+        assert rec["wall_time"] > 0
+        # back-compat path list still works
+        assert flight_recorder.last_dumps()[-1] == path
+    finally:
+        paddle.set_flags(prev)
+
+
+def _load_tool(name):
+    spec = importlib.util.spec_from_file_location(
+        name, os.path.join(ROOT, "tools", f"{name}.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def test_latency_report_from_exported_trace(tmp_path, capsys):
+    eng = serving.InferenceEngine(
+        _echo, input_spec=[([None, 4], "float32")], name="obs7_report",
+        max_batch_size=4, batch_buckets=(1, 4), max_batch_delay_ms=0.5)
+    try:
+        for i in range(12):
+            eng.run(np.full((1, 4), float(i), "float32"),
+                    timeout_ms=30000)
+    finally:
+        eng.shutdown()
+    path = str(tmp_path / "trace.json")
+    profiler.export_chrome_tracing(path)
+    mod = _load_tool("latency_report")
+    reqs = [r for r in mod.parse_trace(path)
+            if r["engine"] == "obs7_report"]
+    assert len(reqs) >= 12
+    rep = mod.report(reqs, top=3)
+    assert rep["requests"] == len(reqs)
+    for phase in ("queue", "pad", "device", "resolve", "e2e"):
+        s = rep["phases_ms"][phase]
+        assert s["p50"] <= s["p99"] <= s["max"] + 1e-9
+    assert len(rep["slowest"]) == 3
+    assert rep["slowest"][0]["e"] >= rep["slowest"][-1]["e"]
+    buf = io.StringIO()
+    mod.render(rep, file=buf)
+    out = buf.getvalue()
+    assert "e2e" in out and "slowest" in out
+    # CLI entry point end-to-end
+    assert mod.main([path, "--top", "2", "--engine", "obs7_report"]) == 0
+    assert "obs7_report" in capsys.readouterr().out
+
+
+def test_check_stats_lint_is_bidirectional(tmp_path):
+    mod = _load_tool("check_stats")
+    # the real repo is clean in BOTH directions
+    assert mod.undocumented() == []
+    assert mod.stale_documented() == []
+    # a doc row whose counter no longer exists anywhere is flagged ...
+    fake = tmp_path / "COVERAGE.md"
+    fake.write_text(
+        "### Metrics inventory\n\n| Name | Kind |\n|---|---|\n"
+        "| STAT_obs7_totally_gone | counter |\n"
+        "| STAT_serving_requests | counter |\n"
+        "| STAT_serving_lane<index>_batches | counter |\n"
+        "| STAT_splash_attention_fwd | counter |\n\n## next\n")
+    stale = mod.stale_documented(str(fake))
+    assert stale == ["STAT_obs7_totally_gone"]
+    # ... while literal names, f-string wildcards, and names registered
+    # through lookup tables (splash _keys) all count as live

@@ -1,19 +1,26 @@
 #!/usr/bin/env python
-"""Lint: every STAT counter / histogram name bumped anywhere in
+"""Bidirectional lint between the code's metric names and COVERAGE.md.
+
+Code → doc: every STAT counter / histogram name bumped anywhere in
 `paddle_tpu/` must be documented in COVERAGE.md ("Metrics inventory"
 section), so the metrics surface cannot silently drift — a new counter
 lands together with its one-line contract, the same way the reference
 keeps `monitor.h` registrations reviewable in one table.
 
+Doc → code: every row of that inventory table must still correspond to
+a name bumped in the code — a renamed or deleted counter must take its
+row with it, or the table rots into a catalogue of metrics dashboards
+can no longer scrape.
+
 Scans for literal (including f-string) first arguments of
-STAT_ADD/STAT_SUB/stat_add/stat_sub/stat_time/stat_get/... and
+STAT_ADD/STAT_SUB/stat_add/stat_sub/stat_set/stat_time/stat_get/... and
 monitor.histogram(...). F-string placeholders are normalized to a
 `<token>` wildcard built from the expression's last identifier —
 `f"STAT_serving_lane{self.index}_batches"` must be documented as
 `STAT_serving_lane<index>_batches`.
 
-Run directly (exit 1 + the undocumented list on drift) or through the
-tier-1 test `tests/test_observability.py::test_check_stats_lint`.
+Run directly (exit 1 + both drift lists) or through the tier-1 test
+`tests/test_observability.py::test_check_stats_lint`.
 """
 from __future__ import annotations
 
@@ -31,8 +38,9 @@ _SKIP_FILES = {os.path.join(PKG, "framework", "monitor.py")}
 
 _CALL = re.compile(
     r'(?:\b(?:STAT_ADD|STAT_SUB|STAT_RESET|stat_add|stat_sub|stat_reset|'
-    r'stat_get|stat_time)|\bhistogram)\s*\(\s*(f?)"([^"]+)"')
+    r'stat_get|stat_set|stat_time)|\bhistogram)\s*\(\s*(f?)"([^"]+)"')
 _PLACEHOLDER = re.compile(r"\{([^{}]*)\}")
+_DOC_ROW = re.compile(r"^\|\s*([^|]+?)\s*\|")
 
 
 def _normalize(literal: str, is_fstring: bool) -> str:
@@ -75,20 +83,94 @@ def undocumented():
                   if name not in text)
 
 
+def documented_names(coverage_path=None):
+    """Metric names listed in the COVERAGE.md 'Metrics inventory' table
+    (first cell of each data row, header/separator skipped)."""
+    with open(coverage_path or COVERAGE, encoding="utf-8") as f:
+        text = f.read()
+    try:
+        section = text.split("### Metrics inventory", 1)[1]
+    except IndexError:
+        return []
+    # the inventory runs until the next heading
+    for stop in ("\n## ", "\n### "):
+        idx = section.find(stop)
+        if idx != -1:
+            section = section[:idx]
+    names = []
+    for line in section.splitlines():
+        m = _DOC_ROW.match(line.strip())
+        if not m:
+            continue
+        name = m.group(1)
+        if name in ("Name",) or set(name) <= {"-", ":"}:
+            continue  # table header / separator
+        names.append(name)
+    return names
+
+
+def _source_blob():
+    parts = []
+    for dirpath, _, files in os.walk(PKG):
+        for fn in files:
+            if fn.endswith(".py"):
+                with open(os.path.join(dirpath, fn),
+                          encoding="utf-8") as f:
+                    parts.append(f.read())
+    return "\n".join(parts)
+
+
+def stale_documented(coverage_path=None):
+    """[name] of inventory rows whose metric no longer appears in the
+    code — the doc→code direction. A name missing from the call-site
+    scan gets a second chance against the raw source (some counters are
+    bumped through name tables, e.g. the splash kernel's _keys dict);
+    `<token>` wildcards match any f-string placeholder."""
+    live = set(collect_names())
+    blob = None
+    out = []
+    for name in documented_names(coverage_path):
+        if name in live:
+            continue
+        if blob is None:
+            blob = _source_blob()
+        if "<" in name:
+            pat = re.compile(r"\{[^{}]*\}".join(
+                re.escape(frag)
+                for frag in re.split(r"<[^>]*>", name)))
+            if pat.search(blob):
+                continue
+        elif name in blob:
+            continue
+        out.append(name)
+    return sorted(out)
+
+
 def main() -> int:
     missing = undocumented()
-    if not missing:
+    stale = stale_documented()
+    if not missing and not stale:
         n = len(collect_names())
-        print(f"check_stats: OK — {n} metric names, all documented "
-              f"in COVERAGE.md")
+        print(f"check_stats: OK — {n} metric names, all documented in "
+              f"COVERAGE.md and no stale inventory rows")
         return 0
-    print("check_stats: metric names bumped in paddle_tpu/ but missing "
-          "from COVERAGE.md:", file=sys.stderr)
-    for name, sites in missing:
-        print(f"  {name}  ({', '.join(sites[:3])}"
-              f"{', ...' if len(sites) > 3 else ''})", file=sys.stderr)
-    print("add each to the 'Metrics inventory' table in COVERAGE.md "
-          "(f-string placeholders normalize to <token>)", file=sys.stderr)
+    if missing:
+        print("check_stats: metric names bumped in paddle_tpu/ but "
+              "missing from COVERAGE.md:", file=sys.stderr)
+        for name, sites in missing:
+            print(f"  {name}  ({', '.join(sites[:3])}"
+                  f"{', ...' if len(sites) > 3 else ''})", file=sys.stderr)
+        print("add each to the 'Metrics inventory' table in COVERAGE.md "
+              "(f-string placeholders normalize to <token>)",
+              file=sys.stderr)
+    if stale:
+        print("check_stats: COVERAGE.md inventory rows whose metric no "
+              "longer exists in paddle_tpu/ (rename/delete took the "
+              "counter but left the doc):", file=sys.stderr)
+        for name in stale:
+            print(f"  {name}", file=sys.stderr)
+        print("remove each stale row (or restore the counter)",
+              file=sys.stderr)
     return 1
 
 

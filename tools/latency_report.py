@@ -1,0 +1,138 @@
+#!/usr/bin/env python
+"""Offline per-request latency attribution from an exported chrome trace.
+
+The serving engine's request spans (profiler/spans.py) drop one
+self-contained `reqspan:` instant into the trace per resolved request:
+
+    reqspan:<rid>:<engine>:lane<lane>:b<bucket>:q=…,p=…,d=…,r=…,e=…
+
+with the four phase durations (queue / pad / device / resolve) and the
+end-to-end latency in milliseconds. This tool reads a trace written by
+`profiler.export_chrome_tracing`, `/trace`, or `bench.py --trace`, and
+prints:
+
+- per-phase p50 / p99 / mean / max over every request in the trace,
+- the top-N slowest requests with their full phase breakdown — the
+  "why was THIS request slow" question `/metrics` histograms cannot
+  answer.
+
+Usage:  python tools/latency_report.py trace.json [--top 10]
+                                       [--engine NAME] [--json]
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import re
+import sys
+
+_REQSPAN = re.compile(
+    r"^reqspan:(?P<rid>\d+):(?P<engine>.*):lane(?P<lane>[^:]*):"
+    r"b(?P<bucket>[^:]*):"
+    r"q=(?P<q>[0-9.]+),p=(?P<p>[0-9.]+),d=(?P<d>[0-9.]+),"
+    r"r=(?P<r>[0-9.]+),e=(?P<e>[0-9.]+)$")
+
+PHASES = (("queue", "q"), ("pad", "p"), ("device", "d"), ("resolve", "r"))
+
+
+def parse_trace(path):
+    """[{rid, engine, lane, bucket, q, p, d, r, e, ts_us}] from the
+    trace's reqspan instants."""
+    with open(path, encoding="utf-8") as f:
+        data = json.load(f)
+    events = data.get("traceEvents", data if isinstance(data, list) else [])
+    out = []
+    for ev in events:
+        m = _REQSPAN.match(str(ev.get("name", "")))
+        if not m:
+            continue
+        g = m.groupdict()
+        out.append({"rid": int(g["rid"]), "engine": g["engine"],
+                    "lane": g["lane"], "bucket": g["bucket"],
+                    "q": float(g["q"]), "p": float(g["p"]),
+                    "d": float(g["d"]), "r": float(g["r"]),
+                    "e": float(g["e"]), "ts_us": ev.get("ts", 0.0)})
+    return out
+
+
+def _pctl(sorted_vals, p):
+    if not sorted_vals:
+        return 0.0
+    k = min(len(sorted_vals) - 1,
+            max(0, int(round(p / 100.0 * len(sorted_vals))) - 1))
+    return sorted_vals[k]
+
+
+def phase_stats(requests):
+    """{phase: {count, mean, p50, p99, max}} plus 'e2e'."""
+    out = {}
+    for label, key in PHASES + (("e2e", "e"),):
+        vals = sorted(req[key] for req in requests)
+        n = len(vals)
+        out[label] = {
+            "count": n,
+            "mean": round(sum(vals) / n, 3) if n else 0.0,
+            "p50": round(_pctl(vals, 50), 3),
+            "p99": round(_pctl(vals, 99), 3),
+            "max": round(vals[-1], 3) if n else 0.0,
+        }
+    return out
+
+
+def report(requests, top=10):
+    stats = phase_stats(requests)
+    slowest = sorted(requests, key=lambda r: -r["e"])[:top]
+    return {"requests": len(requests), "phases_ms": stats,
+            "slowest": slowest}
+
+
+def render(rep, file=sys.stdout):
+    print(f"{rep['requests']} request span(s)", file=file)
+    print(f"\n{'phase':<10}{'p50(ms)':>10}{'p99(ms)':>10}"
+          f"{'mean':>10}{'max':>10}", file=file)
+    for label, _ in PHASES + (("e2e", "e"),):
+        s = rep["phases_ms"][label]
+        print(f"{label:<10}{s['p50']:>10.3f}{s['p99']:>10.3f}"
+              f"{s['mean']:>10.3f}{s['max']:>10.3f}", file=file)
+    if rep["slowest"]:
+        print(f"\ntop {len(rep['slowest'])} slowest:", file=file)
+        print(f"{'rid':>8} {'engine':<16}{'lane':>5}{'bkt':>5}"
+              f"{'e2e(ms)':>10}{'queue':>9}{'pad':>9}{'device':>9}"
+              f"{'resolve':>9}", file=file)
+        for r in rep["slowest"]:
+            print(f"{r['rid']:>8} {r['engine']:<16}{r['lane']:>5}"
+                  f"{r['bucket']:>5}{r['e']:>10.3f}{r['q']:>9.3f}"
+                  f"{r['p']:>9.3f}{r['d']:>9.3f}{r['r']:>9.3f}",
+                  file=file)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("trace", help="chrome trace json "
+                    "(export_chrome_tracing / curl /trace / bench --trace)")
+    ap.add_argument("--top", type=int, default=10,
+                    help="how many slowest requests to list")
+    ap.add_argument("--engine", default=None,
+                    help="only requests of this engine name")
+    ap.add_argument("--json", action="store_true",
+                    help="emit the report as JSON instead of a table")
+    args = ap.parse_args(argv)
+    requests = parse_trace(args.trace)
+    if args.engine is not None:
+        requests = [r for r in requests if r["engine"] == args.engine]
+    if not requests:
+        print("no reqspan events found — was the trace exported from a "
+              "process serving with FLAGS_serving_spans on?",
+              file=sys.stderr)
+        return 1
+    rep = report(requests, top=args.top)
+    if args.json:
+        json.dump(rep, sys.stdout, indent=2)
+        print()
+    else:
+        render(rep)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
