@@ -66,6 +66,47 @@ register_flag("FLAGS_splash_attention_min_seq", 512,
               "below this the dense segment-masked attention wins (same "
               "crossover assumption as FLAGS_flash_attention_min_seq until "
               "swept on-chip — tools/perf_splash_sweep.py)")
+register_flag("FLAGS_use_paged_attention", True,
+              "decode-time cached attention over a paged KV cache: on the "
+              "TPU backend dispatch to the Pallas paged_attention kernel "
+              "(pages stay in place, sequential reads per page); off — or "
+              "any non-TPU backend — gathers the page table into a dense "
+              "[B,H,T,D] buffer and runs the same masked attention as "
+              "GPTModel.generate's fixed cache (the CPU/interpret parity "
+              "reference, ops/paged_ops.py)")
+register_flag("FLAGS_paged_page_size", 16,
+              "tokens per KV-cache page (serving.PagedKVCache); the TPU "
+              "paged_attention kernel wants a multiple of 8")
+register_flag("FLAGS_paged_num_pages", 512,
+              "total pages in the per-layer K/V pools (page 0 is a "
+              "reserved scratch page, so usable pages = this - 1); "
+              "pool HBM = 2·layers·heads·pages·page_size·head_dim·dtype")
+register_flag("FLAGS_paged_pages_per_seq", 0,
+              "page-table width (most pages one sequence may hold); 0 "
+              "derives ceil(max_position_embeddings / page_size) from "
+              "the served model")
+register_flag("FLAGS_paged_compute_block_pages", 4,
+              "pages_per_compute_block for the TPU paged_attention "
+              "kernel (kv tile = this * page_size)")
+register_flag("FLAGS_gen_max_slots", 8,
+              "serving.GenerationEngine: fixed decode-batch slot count — "
+              "the ONE compiled decode-step shape; live sequences join "
+              "and leave the running batch without recompiling")
+register_flag("FLAGS_gen_prefill_buckets", "16,64,256",
+              "serving.GenerationEngine: prompt-length buckets a prompt "
+              "is right-padded up to, so XLA compiles exactly one "
+              "prefill per bucket (clipped to max_position_embeddings)")
+register_flag("FLAGS_gen_max_new_tokens", 64,
+              "serving.GenerationEngine: default per-request new-token "
+              "budget (admission reserves worst-case pages for it)")
+register_flag("FLAGS_gen_max_queue_depth", 256,
+              "serving.GenerationEngine: pending-request bound; submits "
+              "beyond it fail fast with EngineOverloaded")
+register_flag("FLAGS_gen_request_timeout_ms", 30000.0,
+              "serving.GenerationEngine: default per-request deadline, "
+              "enforced while queued AND before every decode step — an "
+              "expired sequence is cancelled mid-decode, its pages freed, "
+              "only its own future fails (0 disables)")
 register_flag("FLAGS_train_step_donate", True,
               "donate the (params, buffers, opt_state) carry into the jitted "
               "train step so XLA updates parameters in place instead of "

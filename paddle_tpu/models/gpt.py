@@ -12,7 +12,133 @@ from ..nn import functional as F
 from ..nn import initializer as I
 from ..ops import creation, manipulation
 
-__all__ = ["GPTConfig", "GPTModel", "GPTForCausalLM", "MoEFeedForward"]
+__all__ = ["GPTConfig", "GPTModel", "GPTForCausalLM", "MoEFeedForward",
+           "gpt_prefill", "gpt_decode_step", "gpt_logits",
+           "dense_cache_write", "dense_cache_attend"]
+
+
+# -- shared decode math (generate() AND serving.GenerationEngine) -----------
+#
+# One anchored re-expression of the Layer forward, cache-layout-agnostic:
+# `gpt_prefill` runs the batched causal pass and RETURNS per-layer K/V
+# (the caller writes them into its cache — contiguous [L,B,H,T,D]
+# buffers for generate(), paged pools for the generation engine), and
+# `gpt_decode_step` advances one position through caller-supplied
+# `write_kv`/`attend` hooks. Keeping both consumers on these exact
+# expressions is what makes the engine's greedy decode bit-anchored to
+# tests/test_generate.py's full-forward oracle (within one compiled
+# shape; cross-shape is float tolerance, the standard XLA caveat).
+
+
+def _gen_ln(x, w, b):
+    m = jnp.mean(x, -1, keepdims=True)
+    v = jnp.var(x, -1, keepdims=True)
+    return (x - m) / jnp.sqrt(v + 1e-5) * w + b
+
+
+def gpt_logits(W, h):
+    """Final LN + tied LM head over hidden states `h` [..., E]."""
+    lnfw, lnfb = W["lnf"]
+    return _gen_ln(h, lnfw, lnfb) @ W["wte"].T
+
+
+def gpt_prefill(W, ids, *, num_heads, scale):
+    """One batched causal pass over the whole prompt — the MXU sees
+    [B,S,E] matmuls, not S tiny ones. Returns `(h, ks, vs)`: `h` [B,S,E]
+    post-blocks pre-ln_f hidden states (project the position you need
+    through `gpt_logits`), `ks`/`vs` [L,B,H,S,D] per-layer K/V for the
+    caller's cache. Right-padded prompts are safe: causal masking keeps
+    pad positions out of every real position's softmax (exact -1e30 →
+    0.0), so the last REAL position's logits are pad-invariant within
+    one compiled shape."""
+    import jax
+
+    B, S = ids.shape
+    H = num_heads
+    h = W["wte"][ids] + W["wpe"][jnp.arange(S)][None]
+    E = h.shape[-1]
+    D = E // H
+    ks, vs = [], []
+    for (l1w, l1b, wq, bq, wk, bk, wv, bv, wo, bo, l2w, l2b,
+         w1, b1, w2, b2) in W["blocks"]:
+        x = _gen_ln(h, l1w, l1b)
+
+        def heads(t):
+            return t.reshape(B, S, H, D).transpose(0, 2, 1, 3)
+        q = heads(x @ wq + bq)
+        k = heads(x @ wk + bk)
+        v = heads(x @ wv + bv)
+        ks.append(k)
+        vs.append(v)
+        s = jnp.einsum("bhqd,bhkd->bhqk", q, k) * scale
+        causal = jnp.tril(jnp.ones((S, S), bool))
+        s = jnp.where(causal, s, -1e30)
+        p = jax.nn.softmax(s, axis=-1)
+        o = jnp.einsum("bhqk,bhkd->bhqd", p, v)
+        o = o.transpose(0, 2, 1, 3).reshape(B, S, E)
+        h = h + (o @ wo + bo)
+        x2 = _gen_ln(h, l2w, l2b)
+        h = h + (jax.nn.gelu(x2 @ w1 + b1, approximate=False) @ w2 + b2)
+    return h, jnp.stack(ks), jnp.stack(vs)
+
+
+def gpt_decode_step(W, tok, pos, cache, write_kv, attend, *, num_heads,
+                    scale):
+    """Single-position forward against an abstract KV cache.
+
+    tok [B] int32; pos scalar or [B] int32 (THIS token's position —
+    written before attending, so attention covers t <= pos). The cache
+    is an opaque pytree threaded functionally through the hooks:
+
+        write_kv(cache, layer, k, v, pos) -> cache     (k/v [B, H, D])
+        attend(cache, layer, q, pos)      -> [B, H, D]
+
+    Returns (logits [B, V], cache)."""
+    import jax
+
+    B = tok.shape[0]
+    H = num_heads
+    h = W["wte"][tok] + W["wpe"][pos]
+    E = h.shape[-1]
+    D = E // H
+    for i, (l1w, l1b, wq, bq, wk, bk, wv, bv, wo, bo, l2w, l2b,
+            w1, b1, w2, b2) in enumerate(W["blocks"]):
+        x = _gen_ln(h, l1w, l1b)
+        q = (x @ wq + bq).reshape(B, H, D)
+        k = (x @ wk + bk).reshape(B, H, D)
+        v = (x @ wv + bv).reshape(B, H, D)
+        cache = write_kv(cache, i, k, v, pos)
+        o = attend(cache, i, q, pos).reshape(B, E)
+        h = h + (o @ wo + bo)
+        x2 = _gen_ln(h, l2w, l2b)
+        h = h + (jax.nn.gelu(x2 @ w1 + b1, approximate=False) @ w2 + b2)
+    return gpt_logits(W, h), cache
+
+
+def dense_cache_write(cache, layer, k, v, pos):
+    """Contiguous-buffer cache hook: cache = (kbufs, vbufs) with shape
+    [L,B,H,T,D], scalar `pos` (the whole batch decodes in lockstep —
+    generate()'s layout)."""
+    import jax
+
+    kb, vb = cache
+    kb = jax.lax.dynamic_update_slice(
+        kb, k[None, :, :, None, :], (layer, 0, 0, pos, 0))
+    vb = jax.lax.dynamic_update_slice(
+        vb, v[None, :, :, None, :], (layer, 0, 0, pos, 0))
+    return kb, vb
+
+
+def dense_cache_attend(scale):
+    """Attend hook over the contiguous cache (masked softmax over every
+    position <= pos; same expression the paged reference gathers into —
+    ops/paged_ops.cached_attention)."""
+    from ..ops.paged_ops import cached_attention
+
+    def attend(cache, layer, q, pos):
+        kb, vb = cache
+        return cached_attention(q, kb[layer], vb[layer], pos, scale)
+    return attend
 
 
 class GPTConfig:
@@ -221,42 +347,15 @@ class GPTForCausalLM(nn.Layer):
         return (_GPTEmbeddingStage(self.gpt), self.gpt.blocks,
                 _GPTHeadStage(self.gpt, lm=True))
 
-    def generate(self, input_ids, max_new_tokens=32, do_sample=False,
-                 top_k=None, temperature=1.0, seed=0):
-        """Autoregressive decoding with a fixed-size KV cache (reference
-        ecosystem: PaddleNLP GenerationMixin.generate/greedy_search).
-
-        TPU design: ONE jax.jit program — prefill is a single batched
-        [B,S,E] causal pass writing the whole prompt's K/V, decode is a
-        `lax.scan` over `max_new_tokens` steps; K/V live in
-        [L, B, H, T, D] buffers written in place with
-        dynamic_update_slice, so shapes are static for every step and
-        nothing retraces per token. Weights ride as jit ARGUMENTS
-        (value-fresh after training steps) and the compiled program is
-        memoized per static config. Eval-mode math (no dropout); the
-        decode math is anchored to the Layer forward by
-        tests/test_generate.py's full-forward oracle."""
-        import jax
-
+    def decode_weights(self):
+        """The decode-math weight pytree shared by `generate()` and
+        `serving.GenerationEngine`: raw jnp leaves (value-fresh after
+        training steps — they ride jitted programs as ARGUMENTS, never
+        baked constants)."""
         gpt = self.gpt
-        cfg = gpt.config
-        ids = jnp.asarray(
-            input_ids._value if isinstance(input_ids, Tensor)
-            else input_ids, jnp.int32)
-        B, S = ids.shape
-        T = S + int(max_new_tokens)
-        if T > cfg.max_position_embeddings:
-            raise ValueError(
-                f"{T} positions exceed max_position_embeddings="
-                f"{cfg.max_position_embeddings}")
-        if cfg.use_moe:
+        if gpt.config.use_moe:
             raise NotImplementedError("generate() with MoE blocks")
-        L, E = cfg.num_layers, cfg.hidden_size
-        H = cfg.num_heads
-        D = E // H
-        scale = 1.0 / D ** 0.5
-
-        weights = {
+        return {
             "wte": gpt.wte.weight._value, "wpe": gpt.wpe.weight._value,
             "lnf": (gpt.ln_f.weight._value, gpt.ln_f.bias._value),
             "blocks": [(
@@ -272,6 +371,41 @@ class GPTForCausalLM(nn.Layer):
                 for blk in gpt.blocks],
         }
 
+    def generate(self, input_ids, max_new_tokens=32, do_sample=False,
+                 top_k=None, temperature=1.0, seed=0):
+        """Autoregressive decoding with a fixed-size KV cache (reference
+        ecosystem: PaddleNLP GenerationMixin.generate/greedy_search).
+
+        TPU design: ONE jax.jit program — prefill is a single batched
+        [B,S,E] causal pass writing the whole prompt's K/V, decode is a
+        `lax.scan` over `max_new_tokens` steps; K/V live in
+        [L, B, H, T, D] buffers written in place with
+        dynamic_update_slice, so shapes are static for every step and
+        nothing retraces per token. Weights ride as jit ARGUMENTS
+        (value-fresh after training steps) and the compiled program is
+        memoized per static config. Eval-mode math (no dropout); the
+        decode math is the shared `gpt_prefill`/`gpt_decode_step`
+        internals (also serving.GenerationEngine's), anchored to the
+        Layer forward by tests/test_generate.py's full-forward oracle."""
+        import jax
+
+        gpt = self.gpt
+        cfg = gpt.config
+        ids = jnp.asarray(
+            input_ids._value if isinstance(input_ids, Tensor)
+            else input_ids, jnp.int32)
+        B, S = ids.shape
+        T = S + int(max_new_tokens)
+        if T > cfg.max_position_embeddings:
+            raise ValueError(
+                f"{T} positions exceed max_position_embeddings="
+                f"{cfg.max_position_embeddings}")
+        weights = self.decode_weights()
+        L, E = cfg.num_layers, cfg.hidden_size
+        H = cfg.num_heads
+        D = E // H
+        scale = 1.0 / D ** 0.5
+
         cfg_key = (B, S, int(max_new_tokens), bool(do_sample),
                    int(top_k or 0), float(temperature))
         cached = getattr(self, "_gen_jit_cache", None)
@@ -279,65 +413,7 @@ class GPTForCausalLM(nn.Layer):
             cached = self._gen_jit_cache = {}
         run = cached.get(cfg_key)
         if run is None:
-            def ln(x, w, b):
-                m = jnp.mean(x, -1, keepdims=True)
-                v = jnp.var(x, -1, keepdims=True)
-                return (x - m) / jnp.sqrt(v + 1e-5) * w + b
-
-            def one_pos(W, tok, pos, kbufs, vbufs):
-                """Single-position forward against the cache. tok [B]."""
-                h = W["wte"][tok] + W["wpe"][pos]
-                for i, (l1w, l1b, wq, bq, wk, bk, wv, bv, wo, bo, l2w,
-                        l2b, w1, b1, w2, b2) in enumerate(W["blocks"]):
-                    x = ln(h, l1w, l1b)
-                    q = (x @ wq + bq).reshape(B, H, D)
-                    k = (x @ wk + bk).reshape(B, H, D)
-                    v = (x @ wv + bv).reshape(B, H, D)
-                    kbufs = jax.lax.dynamic_update_slice(
-                        kbufs, k[None, :, :, None, :], (i, 0, 0, pos, 0))
-                    vbufs = jax.lax.dynamic_update_slice(
-                        vbufs, v[None, :, :, None, :], (i, 0, 0, pos, 0))
-                    s = jnp.einsum("bhd,bhtd->bht", q, kbufs[i]) * scale
-                    s = jnp.where(jnp.arange(T)[None, None, :] <= pos, s,
-                                  -1e30)
-                    p = jax.nn.softmax(s, axis=-1)
-                    o = jnp.einsum("bht,bhtd->bhd", p,
-                                   vbufs[i]).reshape(B, E)
-                    h = h + (o @ wo + bo)
-                    x2 = ln(h, l2w, l2b)
-                    h = h + (jax.nn.gelu(x2 @ w1 + b1,
-                                         approximate=False) @ w2 + b2)
-                lnfw, lnfb = W["lnf"]
-                return ln(h, lnfw, lnfb) @ W["wte"].T, kbufs, vbufs
-
-            def prefill(W, ids, kbufs, vbufs):
-                """One batched causal pass over the whole prompt — the
-                MXU sees [B,S,E] matmuls, not S tiny ones."""
-                h = W["wte"][ids] + W["wpe"][jnp.arange(S)][None]
-                for i, (l1w, l1b, wq, bq, wk, bk, wv, bv, wo, bo, l2w,
-                        l2b, w1, b1, w2, b2) in enumerate(W["blocks"]):
-                    x = ln(h, l1w, l1b)
-
-                    def heads(t):
-                        return t.reshape(B, S, H, D).transpose(0, 2, 1, 3)
-                    q = heads(x @ wq + bq)
-                    k = heads(x @ wk + bk)
-                    v = heads(x @ wv + bv)
-                    kbufs = kbufs.at[i, :, :, :S].set(k)
-                    vbufs = vbufs.at[i, :, :, :S].set(v)
-                    s = jnp.einsum("bhqd,bhkd->bhqk", q, k) * scale
-                    causal = jnp.tril(jnp.ones((S, S), bool))
-                    s = jnp.where(causal, s, -1e30)
-                    p = jax.nn.softmax(s, axis=-1)
-                    o = jnp.einsum("bhqk,bhkd->bhqd", p, v)
-                    o = o.transpose(0, 2, 1, 3).reshape(B, S, E)
-                    h = h + (o @ wo + bo)
-                    x2 = ln(h, l2w, l2b)
-                    h = h + (jax.nn.gelu(x2 @ w1 + b1,
-                                         approximate=False) @ w2 + b2)
-                lnfw, lnfb = W["lnf"]
-                logits = ln(h[:, -1], lnfw, lnfb) @ W["wte"].T
-                return logits, kbufs, vbufs
+            attend = dense_cache_attend(scale)
 
             def sample(logits, key):
                 if not do_sample:
@@ -351,13 +427,18 @@ class GPTForCausalLM(nn.Layer):
             def run_fn(W, ids, key):
                 kbufs = jnp.zeros((L, B, H, T, D), W["wte"].dtype)
                 vbufs = jnp.zeros_like(kbufs)
-                logits, kbufs, vbufs = prefill(W, ids, kbufs, vbufs)
+                h, ks, vs = gpt_prefill(W, ids, num_heads=H, scale=scale)
+                kbufs = kbufs.at[:, :, :, :S].set(ks)
+                vbufs = vbufs.at[:, :, :, :S].set(vs)
+                logits = gpt_logits(W, h[:, -1])
 
                 def dec(carry, _):
                     lg, pos, kb, vb, key = carry
                     key, sub = jax.random.split(key)
                     tok = sample(lg, sub)
-                    lg2, kb, vb = one_pos(W, tok, pos, kb, vb)
+                    lg2, (kb, vb) = gpt_decode_step(
+                        W, tok, pos, (kb, vb), dense_cache_write, attend,
+                        num_heads=H, scale=scale)
                     return (lg2, pos + 1, kb, vb, key), tok
                 _, toks = jax.lax.scan(
                     dec, (logits, jnp.asarray(S, jnp.int32), kbufs,

@@ -1,0 +1,131 @@
+"""Paged KV-cache attention: TPU Pallas kernel dispatch + dense reference.
+
+vLLM's PagedAttention insight, TPU-shaped: decode-time K/V lives in
+fixed-size **pages** inside preallocated per-layer pools
+(`[L, H, num_pages, page_size, D]`), and a per-sequence **page table**
+maps logical token positions to physical pages — so sequences of wildly
+different lengths share one pool with zero fragmentation beyond the last
+partial page, and admission control is exact page arithmetic
+(`serving/kv_cache.py`).
+
+Two attention implementations over that layout, one math:
+
+- **TPU** — `jax.experimental.pallas.ops.tpu.paged_attention` (the
+  primitive SNIPPETS.md [3] shards along KV heads): reads pages in
+  place, `lengths` masks per sequence. Flag-gated by
+  `FLAGS_use_paged_attention`; tile = `FLAGS_paged_compute_block_pages`
+  pages.
+- **reference** (CPU / interpret parity) — gather the page table into a
+  dense `[B, H, T, D]` buffer and run `cached_attention`, the EXACT
+  masked-softmax expression `GPTModel.generate`'s fixed cache uses, so
+  the generation engine's greedy decode is anchored to the same oracle
+  as `tests/test_generate.py` (positions beyond `pos` mask to -1e30 →
+  exp underflows to exactly 0.0, so page-tail junk and trash-page reads
+  contribute +0.0 and numerics match the contiguous cache bit-for-bit
+  within one compiled shape).
+
+Both paths are trace-time choices (python `if` under `jax.jit`), counted
+by `STAT_paged_attn_kernel` / `STAT_paged_attn_reference` — these count
+**traces**, not calls, mirroring the exact-compile accounting everywhere
+else in the serving stack.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..framework import monitor
+from ..framework.flags import flag
+
+__all__ = ["cached_attention", "paged_attention", "paged_gather",
+           "paged_write", "page_rows_for_positions"]
+
+
+def cached_attention(q, kb, vb, pos, scale):
+    """Masked attention of one-position queries over a dense cache.
+
+    q [B, H, D]; kb/vb [B, H, T, D]; pos scalar or [B] int (index of the
+    LAST valid cache position — attention covers t <= pos, exactly
+    `GPTModel.generate`'s decode mask). Returns [B, H, D]."""
+    s = jnp.einsum("bhd,bhtd->bht", q, kb) * scale
+    T = kb.shape[2]
+    limit = pos[:, None, None] if jnp.ndim(pos) else pos
+    s = jnp.where(jnp.arange(T)[None, None, :] <= limit, s, -1e30)
+    p = jax.nn.softmax(s, axis=-1)
+    return jnp.einsum("bht,bhtd->bhd", p, vb)
+
+
+def paged_gather(pages, page_table):
+    """Materialize page-table rows as a dense cache view.
+
+    pages [H, N, P, D] (one layer's pool); page_table [B, PP] int32.
+    Returns [B, H, PP*P, D] — logical token order regardless of physical
+    page placement."""
+    H, _, P, D = pages.shape
+    B, PP = page_table.shape
+    kb = jnp.take(pages, page_table, axis=1)     # [H, B, PP, P, D]
+    return jnp.moveaxis(kb, 1, 0).reshape(B, H, PP * P, D)
+
+
+def page_rows_for_positions(page_table, positions, page_size):
+    """(page_ids, offsets) physical coordinates for logical `positions`.
+
+    page_table [PP] or [B, PP]; positions [S] (with a [PP] table) or [B]
+    (with a [B, PP] table — one position per row)."""
+    if page_table.ndim == 1:
+        return page_table[positions // page_size], positions % page_size
+    B = page_table.shape[0]
+    return (page_table[jnp.arange(B), positions // page_size],
+            positions % page_size)
+
+
+def paged_write(pages, layer, page_ids, offsets, values):
+    """Scatter per-row K/V vectors into one layer of a paged pool.
+
+    pages [L, H, N, P, D]; page_ids/offsets [B]; values [B, H, D] (the
+    integer layer index joins the advanced block, which is then
+    non-contiguous, so numpy indexing moves the batch dim to the
+    front). `layer=None` writes all layers at once (prefill):
+    page_ids/offsets [S], values [L, H, S, D] (adjacent advanced block
+    stays in place)."""
+    if layer is None:
+        return pages.at[:, :, page_ids, offsets, :].set(values)
+    return pages.at[layer, :, page_ids, offsets, :].set(values)
+
+
+def _use_kernel() -> bool:
+    if not bool(flag("FLAGS_use_paged_attention")):
+        return False
+    try:
+        return jax.default_backend() == "tpu"
+    except Exception:  # backend not initialized yet
+        return False
+
+
+def paged_attention(q, k_pages, v_pages, page_table, pos, scale):
+    """One decode position of attention over a paged KV cache.
+
+    q [B, H, D]; k_pages/v_pages [H, N, P, D] (ONE layer's pool);
+    page_table [B, PP] int32; pos [B] int32 (last valid position, the
+    token just written). Returns [B, H, D].
+
+    TPU dispatches the Pallas kernel (pages read in place); everywhere
+    else the reference gathers to dense and reuses `cached_attention` —
+    the generate-anchored math."""
+    if _use_kernel():
+        monitor.stat_add("STAT_paged_attn_kernel")  # traces, not calls
+        from jax.experimental.pallas.ops.tpu.paged_attention import (
+            paged_attention as _kernel)
+        # the kernel takes no softmax-scale argument and applies none
+        # internally: fold ours into q before the qk product
+        out = _kernel(
+            q * scale, k_pages, v_pages,
+            lengths=(pos + 1).astype(jnp.int32),
+            page_indices=page_table.astype(jnp.int32),
+            pages_per_compute_block=int(
+                flag("FLAGS_paged_compute_block_pages")))
+        return out
+    monitor.stat_add("STAT_paged_attn_reference")  # traces, not calls
+    kb = paged_gather(k_pages, page_table)
+    vb = paged_gather(v_pages, page_table)
+    return cached_attention(q, kb, vb, pos, scale)

@@ -51,7 +51,8 @@ __all__ = ["render_prometheus", "MetricsServer", "start_metrics_server",
 _PREFIX = "paddle_tpu_"
 # up-down stats: current level, not a monotone total → Prometheus gauge
 _GAUGES = {"STAT_serving_queue_depth", "STAT_train_step_flops",
-           "STAT_train_mfu_bp"}
+           "STAT_train_mfu_bp", "STAT_kv_pages_inuse",
+           "STAT_gen_queue_depth"}
 # device-telemetry levels set via stat_set (per-device ids vary)
 _GAUGE_SUFFIXES = ("_hbm_bytes_in_use", "_hbm_bytes_limit")
 

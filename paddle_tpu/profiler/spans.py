@@ -53,7 +53,8 @@ from ..framework import monitor
 from ..framework.flags import flag
 from . import tracer
 
-__all__ = ["Span", "enabled", "start", "phase_snapshot", "PHASES"]
+__all__ = ["Span", "enabled", "start", "phase_snapshot", "PHASES",
+           "GenSpan", "start_gen", "GEN_PHASES"]
 
 PHASES = ("queued", "claimed", "padded", "dispatched", "device_done",
           "sliced", "resolved")
@@ -163,6 +164,99 @@ def start(engine: str) -> Optional[Span]:
     if not enabled():
         return None
     span = Span(engine)
+    span.stamp("queued")
+    span.flow("s")
+    return span
+
+
+# -- generation spans (continuous-batching token latency) -------------------
+#
+# A generative request's latency story is not the serving pipeline's
+# queue/pad/device/resolve: what operators tune against is **TTFT**
+# (time to first token — queue + prefill) and **TPOT** (time per output
+# token — the steady decode cadence). A GenSpan rides a
+# GenerationEngine request through submit → slot admission → prefill →
+# every decode step, and on resolve feeds two process-global histograms
+# that telescope into the existing end-to-end accounting:
+#
+#     ttft_ms + (n_tokens - 1) * tpot_ms  ==  queued → last_token
+#
+# with the engine's own `<name>_request_ms` histogram carrying the full
+# queued → resolved wall (the resolve tail is host bookkeeping). Each
+# resolved request also drops one self-contained `reqspan:` instant
+# (slot-flavored: `reqspan:<rid>:<engine>:slot<k>:n=<tok>:ttft=…,
+# tpot=…,e=…`) so `tools/latency_report.py` reconstructs TTFT/TPOT
+# p50/p99 and slowest-request offenders offline from an exported trace.
+
+GEN_PHASES = ("queued", "admitted", "prefilled", "first_token",
+              "last_token", "resolved")
+
+_gen_hists = None
+
+
+def _gen_phase_hists():
+    global _gen_hists
+    if _gen_hists is None:
+        with _hists_lock:
+            if _gen_hists is None:
+                # literal names: the check_stats lint reads these
+                _gen_hists = (monitor.histogram("ttft_ms"),
+                              monitor.histogram("tpot_ms"))
+    return _gen_hists
+
+
+class GenSpan:
+    """One generative request's token clock (single-writer: the engine's
+    step thread owns every stamp after `queued`)."""
+
+    __slots__ = ("rid", "engine", "slot", "stamps")
+
+    def __init__(self, engine: str):
+        self.rid = next(_next_id)
+        self.engine = engine
+        self.slot: Optional[int] = None
+        self.stamps = {}
+
+    def stamp(self, phase: str, t: Optional[float] = None) -> None:
+        self.stamps[phase] = time.perf_counter() if t is None else t
+
+    def flow(self, ph: str) -> None:
+        tracer.flow("gen_request", ph, self.rid)
+
+    def finish(self, n_tokens: int) -> None:
+        """Called once per DELIVERED request after `resolved` is
+        stamped: feed ttft_ms/tpot_ms and drop the reqspan instant."""
+        s = self.stamps
+        if "queued" not in s or "first_token" not in s:
+            return
+        ttft_h, tpot_h = _gen_phase_hists()
+        ttft = (s["first_token"] - s["queued"]) * 1000.0
+        last = s.get("last_token", s["first_token"])
+        tpot = ((last - s["first_token"]) * 1000.0
+                / max(1, n_tokens - 1)) if n_tokens > 1 else 0.0
+        ttft_h.observe(max(0.0, ttft))
+        if n_tokens > 1:
+            tpot_h.observe(max(0.0, tpot))
+        e2e = (s.get("resolved", last) - s["queued"]) * 1000.0
+        tracer.instant(
+            f"reqspan:{self.rid}:{self.engine}:slot{self.slot}:"
+            f"n={n_tokens}:ttft={ttft:.3f},tpot={tpot:.3f},e={e2e:.3f}",
+            t=s.get("resolved", last))
+
+    def to_dict(self) -> dict:
+        now = time.perf_counter()
+        return {"rid": self.rid, "engine": self.engine, "slot": self.slot,
+                "phases": dict(self.stamps),
+                "age_ms": round((now - self.stamps["queued"]) * 1000.0, 3)
+                if "queued" in self.stamps else None}
+
+
+def start_gen(engine: str) -> Optional[GenSpan]:
+    """GenSpan for one accepted generative request (None when spans are
+    off — same FLAGS_serving_spans gate as the serving pipeline)."""
+    if not enabled():
+        return None
+    span = GenSpan(engine)
     span.stamp("queued")
     span.flow("s")
     return span

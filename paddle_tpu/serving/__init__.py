@@ -39,5 +39,8 @@ class EngineOverloaded(ResourceExhaustedError):
 
 
 from .engine import EngineConfig, InferenceEngine  # noqa: E402
+from .generation import GenerationConfig, GenerationEngine  # noqa: E402
+from .kv_cache import PagedKVCache  # noqa: E402
 
-__all__ = ["InferenceEngine", "EngineConfig", "EngineOverloaded"]
+__all__ = ["InferenceEngine", "EngineConfig", "EngineOverloaded",
+           "GenerationEngine", "GenerationConfig", "PagedKVCache"]

@@ -1,0 +1,862 @@
+"""Continuous-batching generation engine over a paged KV cache.
+
+The PR 2/3 engine is one-shot: a request enters a bucket, runs once,
+leaves. Autoregressive decode — the dominant production inference
+workload — needs **iteration-level scheduling** (Orca) over a
+**paged KV cache** (vLLM): requests join the running batch via a
+prefill pass, every engine step advances EVERY live sequence by one
+token through a single jitted decode program, and sequences leave on
+EOS / max-tokens / deadline, freeing their pages the same step.
+
+Shape discipline is what makes this TPU-native: the decode batch is a
+FIXED number of slots (`FLAGS_gen_max_slots`) with inactive slots
+masked, and prompts pad up to `FLAGS_gen_prefill_buckets`, so XLA
+compiles exactly **one decode step** and **one prefill per bucket** —
+sequences joining and leaving mid-decode never retrace (the compile
+ledger in `stats()` proves it, the same exactness contract as the PR 3
+per-(device, bucket) ledgers). K/V lives in `serving.PagedKVCache`
+pools; on TPU the Pallas `paged_attention` kernel reads pages in place,
+elsewhere a dense gather reference keeps the math bit-anchored to
+`GPTModel.generate` (`ops/paged_ops.py`).
+
+Hardening carries over from the one-shot engine, re-expressed at token
+granularity: bounded intake (`EngineOverloaded`), worst-case page
+admission control (a request is only admitted when the allocator can
+cover prompt + max-new, so running sequences are never starved;
+exhaustion defers admission and dumps a flight record), per-request
+deadlines enforced before EVERY decode step (a mid-decode expiry
+cancels just that sequence and frees its pages), poison isolation via
+per-slot non-finite-logit flags (a poisoned sequence fails only its own
+future; its pages are zeroed before reuse so NaNs cannot leak through
+masked attention into the next owner), shutdown-drain, and
+`/readyz`-compatible `health()`. TTFT/TPOT spans feed the `ttft_ms` /
+`tpot_ms` histograms and `reqspan:` trace instants
+(`tools/latency_report.py`).
+
+Single-device by design: one engine owns one chip's pools and step
+loop (the PR 3 lane made token-level — collector and lane collapse into
+one step thread because the decode batch IS the lane). Data-parallel
+scale-out = one engine per chip behind the router tier's `/readyz`.
+"""
+from __future__ import annotations
+
+import itertools
+import threading
+import time
+from collections import deque
+from concurrent.futures import Future
+from typing import List, Optional
+
+import numpy as np
+
+from ..framework import monitor
+from ..framework.errors import (ExecutionTimeoutError, FatalError,
+                                InvalidArgumentError,
+                                ResourceExhaustedError, UnavailableError)
+from ..framework.flags import flag
+from ..profiler import (RecordEvent, device_telemetry, exporter,
+                        flight_recorder, spans)
+from .kv_cache import PagedKVCache
+
+__all__ = ["GenerationConfig", "GenerationEngine"]
+
+
+def _now_ms() -> float:
+    return time.perf_counter() * 1000.0
+
+
+class GenerationConfig:
+    """Continuous-batching knobs; defaults ride the FLAGS_gen_* /
+    FLAGS_paged_* registry so deployments tune engines without code
+    changes."""
+
+    def __init__(self, max_slots: Optional[int] = None,
+                 page_size: Optional[int] = None,
+                 num_pages: Optional[int] = None,
+                 pages_per_seq: Optional[int] = None,
+                 prefill_buckets=None,
+                 max_new_tokens: Optional[int] = None,
+                 max_queue_depth: Optional[int] = None,
+                 request_timeout_ms: Optional[float] = None,
+                 top_k: int = 0, seed: int = 0, warmup: bool = True):
+        self.max_slots = int(flag("FLAGS_gen_max_slots")
+                             if max_slots is None else max_slots)
+        if self.max_slots < 1:
+            raise InvalidArgumentError("max_slots must be >= 1")
+        self.page_size = int(flag("FLAGS_paged_page_size")
+                             if page_size is None else page_size)
+        self.num_pages = int(flag("FLAGS_paged_num_pages")
+                             if num_pages is None else num_pages)
+        self.pages_per_seq = int(flag("FLAGS_paged_pages_per_seq")
+                                 if pages_per_seq is None else pages_per_seq)
+        if prefill_buckets is None:
+            raw = str(flag("FLAGS_gen_prefill_buckets"))
+            prefill_buckets = [int(x) for x in raw.split(",") if x.strip()]
+        buckets = sorted({int(b) for b in prefill_buckets if int(b) >= 1})
+        if not buckets:
+            raise InvalidArgumentError("prefill_buckets must be non-empty")
+        self.prefill_buckets = tuple(buckets)
+        self.max_new_tokens = int(flag("FLAGS_gen_max_new_tokens")
+                                  if max_new_tokens is None
+                                  else max_new_tokens)
+        self.max_queue_depth = int(flag("FLAGS_gen_max_queue_depth")
+                                   if max_queue_depth is None
+                                   else max_queue_depth)
+        self.request_timeout_ms = float(
+            flag("FLAGS_gen_request_timeout_ms")
+            if request_timeout_ms is None else request_timeout_ms)
+        self.top_k = int(top_k)
+        self.seed = int(seed)
+        self.warmup = bool(warmup)
+
+
+class _GenRequest:
+    __slots__ = ("rid", "prompt", "max_new", "eos", "do_sample",
+                 "temperature", "future", "deadline_ms", "t_enqueue_ms",
+                 "span", "slot", "pt_row", "toks", "next_pos", "ordinal")
+
+    _ids = itertools.count(1)
+
+    def __init__(self, prompt, max_new, eos, do_sample, temperature,
+                 future, deadline_ms, t_enqueue_ms, span):
+        self.rid = next(self._ids)
+        self.prompt = prompt            # np.int32 [S]
+        self.max_new = max_new
+        self.eos = eos
+        self.do_sample = do_sample
+        self.temperature = temperature
+        self.future = future
+        self.deadline_ms = deadline_ms
+        self.t_enqueue_ms = t_enqueue_ms
+        self.span = span                # GenSpan or None
+        self.slot: Optional[int] = None
+        self.pt_row = None              # np.int32 [pages_per_seq]
+        self.toks: List[int] = []       # generated tokens (eos included)
+        self.next_pos = 0               # cache position the NEXT step writes
+        self.ordinal = 0                # engine-local submit ordinal
+
+
+class GenerationEngine:
+    """Token-level continuous-batching front-end over a
+    `models.GPTForCausalLM`.
+
+    `submit(prompt_ids, ...)` returns a `concurrent.futures.Future`
+    resolving to the full token sequence (prompt + generated, numpy
+    int32). Greedy by default; `do_sample=True` draws from the
+    temperature-scaled distribution using the ENGINE's PRNG stream
+    (`config.seed` folded with the step counter — per-request seeds
+    don't exist because co-resident sequences share each step's
+    program).
+
+    Scheduling contract: admission is FIFO with head-of-line blocking —
+    a request is admitted the moment a slot AND its worst-case pages
+    (prompt + max_new) are both available, prefills immediately, and
+    joins the very next decode step. Deadlines are whole-request and
+    checked before every step; an expired sequence is cancelled
+    mid-decode with nothing delivered (deadline semantics are
+    streaming-unsafe by design — there is no partial result).
+
+    Numerics: decode always runs the one compiled [max_slots] program,
+    so a sequence's tokens are independent of WHO shares the batch
+    (row-independent math) and bit-stable across repeats on one engine
+    config. Comparisons against `GPTModel.generate` cross program/shape
+    boundaries and hold at token level (greedy) / float tolerance, per
+    the standard XLA per-shape caveat.
+    """
+
+    def __init__(self, model, config: Optional[GenerationConfig] = None,
+                 name: str = "generation", device=None,
+                 metrics_port: Optional[int] = None, **overrides):
+        if config is None:
+            config = GenerationConfig(**overrides)
+        elif overrides:
+            raise InvalidArgumentError(
+                "pass either a GenerationConfig or keyword overrides, "
+                "not both")
+        import copy
+        self._cfg = copy.copy(config)
+        self.name = name
+        from ..models.gpt import GPTForCausalLM
+        if not isinstance(model, GPTForCausalLM):
+            raise InvalidArgumentError(
+                f"GenerationEngine serves a models.GPTForCausalLM "
+                f"(got {type(model).__name__})")
+        self._model = model
+        mcfg = model.gpt.config
+        self._W = model.decode_weights()  # raises for MoE
+        self._H = mcfg.num_heads
+        self._D = mcfg.hidden_size // mcfg.num_heads
+        self._scale = 1.0 / self._D ** 0.5
+        self._max_position = mcfg.max_position_embeddings
+        if self._cfg.pages_per_seq <= 0:
+            self._cfg.pages_per_seq = -(-self._max_position
+                                        // self._cfg.page_size)
+        # buckets are bounded by the PER-SEQUENCE page capacity too, not
+        # just max_position: a wider bucket would compute page indices
+        # past the table width, which the gather CLAMPS onto the
+        # sequence's last real page — pad-token K/V would silently
+        # overwrite prompt state there
+        cap = min(self._max_position,
+                  self._cfg.pages_per_seq * self._cfg.page_size)
+        self._cfg.prefill_buckets = tuple(sorted(
+            {min(int(b), cap) for b in self._cfg.prefill_buckets}))
+        self._device = device
+        dtype = np.asarray(self._W["lnf"][0]).dtype
+        self._cache = PagedKVCache(
+            mcfg.num_layers, self._H, self._D, self._cfg.page_size,
+            self._cfg.num_pages, self._cfg.pages_per_seq, dtype=str(dtype))
+        self._kp = self._cache.k_pages
+        self._vp = self._cache.v_pages
+
+        self._cv = threading.Condition()
+        self._queue: deque = deque()
+        self._slots: List[Optional[_GenRequest]] = \
+            [None] * self._cfg.max_slots
+        self._closed = False
+        self._abort = False
+        self._warmed = False
+        self._steps_total = 0
+        self._prefills_total = 0
+        self._tokens_total = 0
+        self._exhaust_dumped = False   # one flight dump per episode
+        self._req_seq = 0              # engine-local submit ordinal
+        self._ledger = {}              # "decode[m=M]"/"prefill[b=S]" -> traces
+        self._death: Optional[BaseException] = None
+        self._pre_step_hook = None     # test seam: runs on the step thread
+        self._hist = monitor.histogram(f"{name}_request_ms")
+        self._base_key = None          # PRNGKey, built lazily on first use
+
+        self._build_programs()
+        flight_recorder.touch()
+        device_telemetry.touch()
+        exporter.register_engine(self)
+        try:
+            if self._cfg.warmup:
+                self._warmup()
+            self._warmed = True
+            self._thread = threading.Thread(
+                target=self._loop, daemon=True, name=f"{name}-genstep")
+            self._thread.start()
+            self._owns_metrics_server = (metrics_port is not None
+                                         and int(metrics_port) == 0)
+            self.metrics_server = None
+            self.metrics_server = exporter.start_metrics_server(
+                metrics_port)
+        except Exception:
+            exporter.unregister_engine(self)
+            raise
+
+    # -- jitted programs ---------------------------------------------------
+
+    def _note_trace(self, key: str):
+        # runs at TRACE time only (python side effect under jit), so the
+        # ledger counts compiles exactly — the same accounting trick as
+        # Predictor.compile_count
+        self._ledger[key] = self._ledger.get(key, 0) + 1
+        monitor.stat_add("STAT_gen_compiles")
+
+    def _build_programs(self):
+        import jax
+        import jax.numpy as jnp
+
+        from ..models.gpt import gpt_decode_step, gpt_logits, gpt_prefill
+        from ..ops.paged_ops import (page_rows_for_positions,
+                                     paged_attention, paged_write)
+
+        H, P, scale = self._H, self._cfg.page_size, self._scale
+        top_k = self._cfg.top_k
+        eng = self
+
+        def prefill_fn(W, kp, vp, pt_row, ids, length):
+            eng._note_trace(f"prefill[b={ids.shape[1]}]")
+            h, ks, vs = gpt_prefill(W, ids, num_heads=H, scale=scale)
+            S_b = ids.shape[1]
+            pos = jnp.arange(S_b)
+            page_ids, offs = page_rows_for_positions(pt_row, pos, P)
+            kp = paged_write(kp, None, page_ids, offs, ks[:, 0])
+            vp = paged_write(vp, None, page_ids, offs, vs[:, 0])
+            idx = jnp.clip(length - 1, 0, S_b - 1)
+            return kp, vp, gpt_logits(W, h[0, idx])
+
+        def write_kv(cache, layer, k, v, pos):
+            kp, vp, pt = cache
+            page_ids, offs = page_rows_for_positions(pt, pos, P)
+            return (paged_write(kp, layer, page_ids, offs, k),
+                    paged_write(vp, layer, page_ids, offs, v), pt)
+
+        def attend(cache, layer, q, pos):
+            kp, vp, pt = cache
+            return paged_attention(q, kp[layer], vp[layer], pt, pos, scale)
+
+        def decode_fn(W, kp, vp, pt, tok, pos, active, temps, smask, key):
+            eng._note_trace(f"decode[m={tok.shape[0]}]")
+            logits, (kp, vp, _) = gpt_decode_step(
+                W, tok, pos, (kp, vp, pt), write_kv, attend,
+                num_heads=H, scale=scale)
+            greedy = jnp.argmax(logits, -1).astype(jnp.int32)
+            lg = logits / jnp.maximum(temps[:, None], 1e-6)
+            if top_k:
+                kth = jax.lax.top_k(lg, int(top_k))[0][..., -1:]
+                lg = jnp.where(lg < kth, -1e30, lg)
+            sampled = jax.random.categorical(key, lg).astype(jnp.int32)
+            nxt = jnp.where(smask, sampled, greedy)
+            bad = active & ~jnp.all(jnp.isfinite(logits), axis=-1)
+            return kp, vp, jnp.where(active, nxt, 0), bad
+
+        def zero_fn(kp, vp, pages):
+            # trash-padded page rows: the scratch page is re-zeroed with
+            # every free, which also scrubs poisoned prefill tails
+            return (kp.at[:, :, pages].set(0.0),
+                    vp.at[:, :, pages].set(0.0))
+
+        self._prefill_jit = jax.jit(prefill_fn, donate_argnums=(1, 2))
+        self._decode_jit = jax.jit(decode_fn, donate_argnums=(1, 2))
+        self._zero_jit = jax.jit(zero_fn, donate_argnums=(0, 1))
+
+    def _dev_ctx(self):
+        import jax
+        import contextlib
+        return (jax.default_device(self._device)
+                if self._device is not None else contextlib.nullcontext())
+
+    def _decode_call(self, *args):
+        """One jitted decode dispatch (seam: tests wrap this to inject
+        per-slot failures)."""
+        with self._dev_ctx():
+            return self._decode_jit(*args)
+
+    def _zero_pages(self, pages):
+        row = self._cache.zero_rows(pages)
+        with self._dev_ctx():
+            self._kp, self._vp = self._zero_jit(self._kp, self._vp, row)
+
+    def _warmup(self):
+        """Compile every prefill bucket + the decode step + the zeroing
+        scatter up front: no live request pays a compile, and the
+        ledger's exactly-once invariant is observable from step one.
+        Warmup writes land only in the reserved scratch page."""
+        M, PP = self._cfg.max_slots, self._cfg.pages_per_seq
+        trash = np.zeros((PP,), np.int32)
+        with RecordEvent("generation::warmup"):
+            for b in self._cfg.prefill_buckets:
+                ids = np.zeros((1, b), np.int32)
+                with self._dev_ctx():
+                    self._kp, self._vp, lg = self._prefill_jit(
+                        self._W, self._kp, self._vp, trash, ids,
+                        np.int32(1))
+                np.asarray(lg)
+            args = self._step_arrays()
+            kp, vp, nxt, bad = self._decode_call(
+                self._W, self._kp, self._vp, *args)
+            np.asarray(nxt)
+            self._kp, self._vp = kp, vp
+            self._zero_pages([])
+
+    # -- request intake ----------------------------------------------------
+
+    def submit(self, prompt_ids, max_new_tokens: Optional[int] = None,
+               eos_token_id: Optional[int] = None,
+               timeout_ms: Optional[float] = None,
+               do_sample: bool = False,
+               temperature: float = 1.0) -> Future:
+        """Enqueue one prompt (1-D int token ids); returns a Future of
+        the full sequence (prompt + generated tokens, numpy int32; EOS,
+        when hit, is included). Raises `EngineOverloaded` at
+        max_queue_depth, `InvalidArgumentError`/`ResourceExhaustedError`
+        for requests that could never run."""
+        from . import EngineOverloaded
+        with RecordEvent("generation::submit"):
+            from ..framework.tensor import Tensor
+            if isinstance(prompt_ids, Tensor):
+                prompt_ids = prompt_ids.numpy()
+            prompt = np.asarray(prompt_ids)
+            if prompt.ndim != 1 or prompt.size < 1:
+                raise InvalidArgumentError(
+                    f"{self.name}: prompt_ids must be a non-empty 1-D "
+                    f"token array, got shape {tuple(prompt.shape)}")
+            if not np.issubdtype(prompt.dtype, np.integer):
+                raise InvalidArgumentError(
+                    f"{self.name}: prompt_ids must be integer token ids")
+            prompt = prompt.astype(np.int32)
+            max_new = int(self._cfg.max_new_tokens
+                          if max_new_tokens is None else max_new_tokens)
+            if max_new < 1:
+                raise InvalidArgumentError("max_new_tokens must be >= 1")
+            S = int(prompt.size)
+            total = S + max_new
+            if S > self._cfg.prefill_buckets[-1]:
+                raise InvalidArgumentError(
+                    f"{self.name}: prompt length {S} exceeds the largest "
+                    f"prefill bucket {self._cfg.prefill_buckets[-1]}")
+            if total > self._max_position:
+                raise InvalidArgumentError(
+                    f"{self.name}: {total} positions exceed "
+                    f"max_position_embeddings={self._max_position}")
+            if not self._cache.fits(total):
+                raise ResourceExhaustedError(
+                    f"{self.name}: {total} tokens need "
+                    f"{self._cache.pages_needed(total)} pages but the "
+                    f"pool holds {self._cache.usable_pages} "
+                    f"(pages_per_seq={self._cache.pages_per_seq}); raise "
+                    f"FLAGS_paged_num_pages or shrink the request")
+            t = _now_ms()
+            tmo = (self._cfg.request_timeout_ms if timeout_ms is None
+                   else float(timeout_ms))
+            with self._cv:
+                if self._closed:
+                    raise UnavailableError(
+                        f"{self.name}: engine is shut down")
+                if len(self._queue) >= self._cfg.max_queue_depth:
+                    monitor.stat_add("STAT_gen_rejected")
+                    raise EngineOverloaded(
+                        f"{self.name}: queue depth "
+                        f"{self._cfg.max_queue_depth} reached; shed load "
+                        f"or raise FLAGS_gen_max_queue_depth")
+                req = _GenRequest(
+                    prompt, max_new, eos_token_id, bool(do_sample),
+                    float(temperature), Future(),
+                    None if not tmo else t + tmo, t,
+                    spans.start_gen(self.name))
+                self._req_seq += 1
+                req.ordinal = self._req_seq
+                self._queue.append(req)
+                monitor.stat_add("STAT_gen_queue_depth")
+                self._cv.notify_all()
+            monitor.stat_add("STAT_gen_requests")
+            return req.future
+
+    def generate(self, prompt_ids, **kw) -> np.ndarray:
+        """Synchronous submit: blocks for this prompt's full sequence."""
+        return self.submit(prompt_ids, **kw).result()
+
+    # -- step loop ---------------------------------------------------------
+
+    def _num_active(self) -> int:
+        return sum(1 for r in self._slots if r is not None)
+
+    def _loop(self):
+        try:
+            while True:
+                with self._cv:
+                    while (not self._queue and self._num_active() == 0
+                           and not self._closed):
+                        self._cv.wait()
+                    if self._closed and self._abort:
+                        self._evict_all(UnavailableError(
+                            f"{self.name}: engine shut down"))
+                        return
+                    if (self._closed and not self._queue
+                            and self._num_active() == 0):
+                        return
+                self._admit()
+                self._expire_active()
+                if self._num_active():
+                    self._step()
+                else:
+                    with self._cv:
+                        if (self._queue and self._num_active() == 0
+                                and not self._abort):
+                            # unadmittable head (page exhaustion): bounded
+                            # wait so queued deadlines still expire
+                            self._cv.wait(0.01)
+        except BaseException as e:  # noqa: BLE001 — never hang submitters
+            self._die(e)
+            raise
+
+    def _die(self, e: BaseException):
+        stranded = []
+        with self._cv:
+            self._closed = True
+            self._death = e
+            while self._queue:
+                stranded.append(self._queue.popleft())
+                monitor.stat_sub("STAT_gen_queue_depth")
+            self._cv.notify_all()
+        err = UnavailableError(f"{self.name}: generation engine died: "
+                               f"{e!r}")
+        active = [r for r in self._slots if r is not None]
+        for req in active + stranded:
+            try:
+                req.future.set_exception(err)
+            except Exception:
+                pass
+        flight_recorder.dump("gen_engine_death", {
+            "engine": self.name, "error": repr(e),
+            "stranded_requests": len(stranded),
+            "active_sequences": len(active),
+            "inflight_spans": [r.span.to_dict() for r in active
+                               if r.span is not None][:64]})
+
+    # -- admission ---------------------------------------------------------
+
+    def _admit(self):
+        """Admit queued requests while a slot AND worst-case pages are
+        both free (FIFO, head-of-line blocking — later smaller requests
+        never overtake, so admission latency stays predictable)."""
+        while True:
+            with self._cv:
+                # whole-queue sweep, not just the head: a request queued
+                # BEHIND a page-blocked head must still get its deadline
+                # error on time (head-of-line blocking blocks admission,
+                # never expiry)
+                self._expire_queued()
+                if not self._queue:
+                    return
+                req = self._queue[0]
+                slot = next((i for i, r in enumerate(self._slots)
+                             if r is None), None)
+                if slot is None:
+                    return
+                total = int(req.prompt.size) + req.max_new
+                if not self._cache.can_admit(total):
+                    monitor.stat_add("STAT_gen_admit_blocked")
+                    if not self._exhaust_dumped:
+                        self._exhaust_dumped = True
+                        flight_recorder.dump("gen_allocator_exhausted", {
+                            "engine": self.name, "rid": req.rid,
+                            "need_pages":
+                                self._cache.pages_needed(total),
+                            "cache": self._cache.stats(),
+                            "queue_depth": len(self._queue)})
+                    return
+                self._queue.popleft()
+                monitor.stat_sub("STAT_gen_queue_depth")
+                if not req.future.set_running_or_notify_cancel():
+                    continue
+                req.slot = slot
+                req.pt_row = self._cache.alloc(req.rid, total)
+                self._slots[slot] = req
+                if req.span is not None:
+                    req.span.slot = slot
+                    req.span.stamp("admitted")
+            self._do_prefill(req)
+
+    def _expire_queued(self):
+        """Fail every expired request and drop every cancelled one from
+        the WHOLE queue (position-independent); caller holds the lock."""
+        t = _now_ms()
+        live = deque()
+        for req in self._queue:
+            if req.deadline_ms is not None and t > req.deadline_ms:
+                monitor.stat_sub("STAT_gen_queue_depth")
+                monitor.stat_add("STAT_gen_timeouts")
+                try:
+                    req.future.set_exception(ExecutionTimeoutError(
+                        f"{self.name}: request expired after "
+                        f"{t - req.t_enqueue_ms:.1f}ms in queue"))
+                except Exception:
+                    pass
+                continue
+            if req.future.cancelled():
+                monitor.stat_sub("STAT_gen_queue_depth")
+                continue
+            live.append(req)
+        self._queue = live
+
+    def _bucket_for(self, S: int) -> int:
+        for b in self._cfg.prefill_buckets:
+            if b >= S:
+                return b
+        return self._cfg.prefill_buckets[-1]
+
+    def _do_prefill(self, req: _GenRequest):
+        """Run the request's prompt through the bucketed prefill program
+        (writes its K/V pages), sample the first token, and mark the
+        slot live — it joins the very next decode step. A poisoned
+        request (non-finite logits — the pools came back valid) fails
+        ONLY this request and returns its pages zeroed; an exception
+        from the jitted call itself is engine-fatal, because the pools
+        were DONATED into it and may already be consumed — touching
+        them again (even to zero this request's pages) would
+        dereference deleted buffers (same contract as a decode-step
+        exception)."""
+        S = int(req.prompt.size)
+        bucket = self._bucket_for(S)
+        ids = np.zeros((1, bucket), np.int32)
+        ids[0, :S] = req.prompt
+        with RecordEvent(f"generation::prefill[b={bucket}]"):
+            with self._dev_ctx():
+                self._kp, self._vp, logits = self._prefill_jit(
+                    self._W, self._kp, self._vp, req.pt_row, ids,
+                    np.int32(S))
+            lg = np.asarray(logits)
+        if not np.all(np.isfinite(lg)):
+            monitor.stat_add("STAT_gen_poisoned")
+            flight_recorder.dump("gen_poisoned_sequence", {
+                "engine": self.name, "rid": req.rid, "stage": "prefill",
+                "bucket": bucket, "error": "non-finite prefill logits"})
+            self._release(req)
+            try:
+                req.future.set_exception(FatalError(
+                    f"{self.name}: non-finite prefill logits for request "
+                    f"{req.rid} (poisoned prompt or weights)"))
+            except Exception:
+                pass
+            return
+        self._prefills_total += 1
+        monitor.stat_add("STAT_gen_prefills")
+        tok = self._sample_host(req, lg)
+        req.toks.append(tok)
+        req.next_pos = S
+        self._tokens_total += 1
+        monitor.stat_add("STAT_gen_tokens")
+        if req.span is not None:
+            req.span.stamp("prefilled")
+            req.span.stamp("first_token")
+            req.span.stamp("last_token")
+        if self._finished(req, tok):
+            self._complete(req)
+
+    def _sample_host(self, req: _GenRequest, logits: np.ndarray) -> int:
+        """First-token sampling on host (prefill returns logits; decode
+        samples in-graph). Greedy is np.argmax — first-max ties, same
+        as jnp.argmax, so greedy parity with generate() holds."""
+        if not req.do_sample:
+            return int(np.argmax(logits))
+        lg = logits / max(req.temperature, 1e-6)
+        if self._cfg.top_k:
+            kth = np.sort(lg)[-int(self._cfg.top_k)]
+            lg = np.where(lg < kth, -1e30, lg)
+        # engine-local ordinal, NOT the process-global rid: two engines
+        # with the same config/seed must sample identical streams
+        r = np.random.RandomState(
+            (self._cfg.seed * 1000003 + req.ordinal) % (2 ** 31))
+        g = -np.log(-np.log(r.uniform(1e-12, 1.0, lg.shape)))
+        return int(np.argmax(lg + g))
+
+    # -- decode step -------------------------------------------------------
+
+    def _step_arrays(self):
+        M, PP = self._cfg.max_slots, self._cfg.pages_per_seq
+        toks = np.zeros((M,), np.int32)
+        pos = np.zeros((M,), np.int32)
+        active = np.zeros((M,), bool)
+        temps = np.ones((M,), np.float32)
+        smask = np.zeros((M,), bool)
+        pt = np.zeros((M, PP), np.int32)
+        for i, req in enumerate(self._slots):
+            if req is None:
+                continue
+            active[i] = True
+            toks[i] = req.toks[-1]
+            pos[i] = req.next_pos
+            temps[i] = req.temperature
+            smask[i] = req.do_sample
+            pt[i] = req.pt_row
+        key = self._step_key()
+        return pt, toks, pos, active, temps, smask, key
+
+    def _step_key(self):
+        import jax
+        if self._base_key is None:
+            self._base_key = jax.random.PRNGKey(self._cfg.seed)
+        return jax.random.fold_in(self._base_key, self._steps_total)
+
+    def _step(self):
+        """ONE engine step: every live sequence advances one token
+        through the single compiled decode program (inactive slots are
+        masked into the reserved scratch page). The np.asarray below is
+        the step's only host sync."""
+        if self._pre_step_hook is not None:
+            self._pre_step_hook(self)
+        args = self._step_arrays()
+        with RecordEvent(f"generation::step[m={self._cfg.max_slots}]"):
+            kp, vp, nxt, bad = self._decode_call(
+                self._W, self._kp, self._vp, *args)
+            nxt = np.asarray(nxt)
+            bad = np.asarray(bad)
+        self._kp, self._vp = kp, vp
+        self._steps_total += 1
+        monitor.stat_add("STAT_gen_steps")
+        for i, req in enumerate(self._slots):
+            if req is None:
+                continue
+            if bad[i]:
+                # poison isolation: only THIS sequence fails; its pages
+                # are zeroed before reuse so the NaN cannot reach the
+                # next owner's masked attention
+                monitor.stat_add("STAT_gen_poisoned")
+                flight_recorder.dump("gen_poisoned_sequence", {
+                    "engine": self.name, "rid": req.rid, "stage": "decode",
+                    "slot": i, "generated": len(req.toks),
+                    "error": "non-finite decode logits"})
+                self._evict(req, FatalError(
+                    f"{self.name}: sequence {req.rid} produced "
+                    f"non-finite logits at step {len(req.toks)}"))
+                continue
+            tok = int(nxt[i])
+            req.toks.append(tok)
+            req.next_pos += 1
+            self._tokens_total += 1
+            monitor.stat_add("STAT_gen_tokens")
+            if req.span is not None:
+                req.span.stamp("last_token")
+            if self._finished(req, tok):
+                self._complete(req)
+
+    def _finished(self, req: _GenRequest, tok: int) -> bool:
+        return ((req.eos is not None and tok == req.eos)
+                or len(req.toks) >= req.max_new)
+
+    def _expire_active(self):
+        """Per-step deadline enforcement: an expired sequence cancels
+        mid-decode — pages freed the same step, only its future fails."""
+        t = _now_ms()
+        for req in list(self._slots):
+            if req is None or req.deadline_ms is None:
+                continue
+            if t > req.deadline_ms:
+                monitor.stat_add("STAT_gen_timeouts")
+                self._evict(req, ExecutionTimeoutError(
+                    f"{self.name}: request {req.rid} expired after "
+                    f"{t - req.t_enqueue_ms:.1f}ms with "
+                    f"{len(req.toks)}/{req.max_new} tokens decoded "
+                    f"(deadlines are whole-request; partial streams are "
+                    f"not delivered)"))
+
+    # -- completion / eviction ---------------------------------------------
+
+    def _release(self, req: _GenRequest):
+        """Return the request's slot + pages (pages zeroed on device)."""
+        pages = self._cache.free(req.rid)
+        if pages:
+            self._zero_pages(pages)
+            self._exhaust_dumped = False  # pages freed: new episode
+        if req.slot is not None and self._slots[req.slot] is req:
+            self._slots[req.slot] = None
+        with self._cv:
+            self._cv.notify_all()
+
+    def _complete(self, req: _GenRequest):
+        self._release(req)
+        out = np.concatenate([req.prompt,
+                              np.asarray(req.toks, np.int32)])
+        t_done = _now_ms()
+        self._hist.observe(t_done - req.t_enqueue_ms)
+        if req.deadline_ms is not None and t_done > req.deadline_ms:
+            # finished the same instant it expired: honor the deadline
+            # (a timeout, NOT a completion — the two counters partition
+            # the finished-naturally outcomes)
+            monitor.stat_add("STAT_gen_timeouts")
+            try:
+                req.future.set_exception(ExecutionTimeoutError(
+                    f"{self.name}: request expired after "
+                    f"{t_done - req.t_enqueue_ms:.1f}ms"))
+            except Exception:
+                pass
+            return
+        try:
+            req.future.set_result(out)
+        except Exception:  # racing caller-side cancel
+            pass
+        else:
+            monitor.stat_add("STAT_gen_completions")  # delivered results
+            if req.span is not None:
+                req.span.stamp("resolved")
+                req.span.finish(len(req.toks))
+
+    def _evict(self, req: _GenRequest, err: BaseException):
+        """Cancel a LIVE sequence mid-decode: free + zero its pages,
+        fail only its own future."""
+        self._release(req)
+        monitor.stat_add("STAT_gen_evictions")
+        try:
+            req.future.set_exception(err)
+        except Exception:
+            pass
+
+    def _evict_all(self, err: BaseException):
+        for req in list(self._slots):
+            if req is not None:
+                self._evict(req, err)
+
+    # -- lifecycle / introspection -----------------------------------------
+
+    def stats(self) -> dict:
+        """Engine snapshot: per-slot state, page-pool occupancy, the
+        exact compile ledger, token/step totals, and the TTFT/TPOT +
+        end-to-end latency histograms."""
+        with self._cv:
+            depth = len(self._queue)
+            slots = [{"slot": i,
+                      "rid": r.rid if r is not None else None,
+                      "generated": len(r.toks) if r is not None else 0,
+                      "prompt_len": int(r.prompt.size)
+                      if r is not None else 0}
+                     for i, r in enumerate(self._slots)]
+            ledger = dict(self._ledger)
+            steps, prefills, tokens = (self._steps_total,
+                                       self._prefills_total,
+                                       self._tokens_total)
+        return {
+            "slots": slots,
+            "queue_depth": depth,
+            "pages": self._cache.stats(),
+            "compiles": ledger,
+            "steps": steps,
+            "prefills": prefills,
+            "tokens": tokens,
+            "latency_ms": self._hist.snapshot(),
+            "ttft_ms": monitor.histogram("ttft_ms").snapshot(),
+            "tpot_ms": monitor.histogram("tpot_ms").snapshot(),
+        }
+
+    def health(self) -> dict:
+        """`/readyz` verdict, same shape as InferenceEngine.health() so
+        the router tier drains generation replicas identically."""
+        with self._cv:
+            depth = len(self._queue)
+            draining = self._closed
+            live = int(getattr(self, "_thread", None) is not None
+                       and self._thread.is_alive() and self._death is None)
+            slots_free = sum(1 for r in self._slots if r is None)
+        limit = self._cfg.max_queue_depth
+        warmed = self._warmed
+        if draining:
+            reason = "draining"
+        elif not warmed:
+            reason = "warming up"
+        elif not live:
+            reason = "step loop dead"
+        elif depth >= limit:
+            reason = "queue at rejection threshold"
+        else:
+            reason = "ok"
+        return {"ready": reason == "ok", "reason": reason,
+                "warmup_complete": warmed, "draining": draining,
+                "live_lanes": live, "queue_depth": depth,
+                "queue_limit": limit, "slots_free": slots_free,
+                "slots": self._cfg.max_slots}
+
+    def shutdown(self, drain: bool = True,
+                 timeout_s: Optional[float] = None):
+        """Stop intake; by default every queued + live sequence finishes
+        before the step loop exits. drain=False fails pending futures
+        fast (live sequences are evicted, pages freed)."""
+        with self._cv:
+            self._closed = True
+            if not drain:
+                self._abort = True
+                while self._queue:
+                    req = self._queue.popleft()
+                    monitor.stat_sub("STAT_gen_queue_depth")
+                    try:
+                        req.future.set_exception(UnavailableError(
+                            f"{self.name}: engine shut down"))
+                    except Exception:
+                        pass
+            self._cv.notify_all()
+        t = getattr(self, "_thread", None)
+        if t is not None:
+            t.join(timeout_s)
+        exporter.unregister_engine(self)
+        if getattr(self, "_owns_metrics_server", False) \
+                and self.metrics_server is not None:
+            self.metrics_server.close()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.shutdown()
+        return False

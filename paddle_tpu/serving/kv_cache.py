@@ -1,0 +1,167 @@
+"""Paged KV cache: block allocator + preallocated per-layer K/V pools.
+
+vLLM's PagedAttention memory model on TPU terms: decode-time K/V for
+every live sequence lives in ONE pair of preallocated pools
+`[L, H, num_pages, page_size, D]`, carved into fixed-size pages handed
+out by a free-list allocator. A sequence owns `ceil(tokens / page_size)`
+pages recorded in a fixed-width page-table row (trash-padded), so the
+device-side shapes never depend on how many sequences are live or how
+long they are — the prerequisite for the generation engine's single
+compiled decode step.
+
+Design points:
+
+- **Page 0 is reserved scratch ("trash")**: inactive decode slots and
+  padded prefill tails write there, and page-table padding points there,
+  so masked lanes always have a legal physical target. It is never
+  allocated.
+- **Worst-case admission**: `can_admit(tokens)` is exact page
+  arithmetic over the request's prompt + max-new budget; the engine
+  refuses admission (keeps the request queued) while free pages are
+  short, so a mid-decode sequence can never be starved of the pages it
+  was promised — no mid-flight OOM, evictions only on deadline/poison.
+- **Zero-on-free**: freed pages are zeroed by the owner engine before
+  reuse (`zero_rows` builds the scatter coordinates). Masked attention
+  multiplies stale entries by exactly 0.0, which is only safe when
+  stale never means NaN/Inf — a poisoned sequence's pages must not
+  leak NaNs into the next owner's masked lanes (0.0 * NaN = NaN).
+- Host-side state is plain python under the engine's lock; the pools
+  themselves are jnp arrays the engine threads through its jitted
+  step functions (donated, so XLA updates them in place).
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+import numpy as np
+
+from ..framework import monitor
+from ..framework.errors import InvalidArgumentError, ResourceExhaustedError
+
+__all__ = ["PagedKVCache"]
+
+TRASH_PAGE = 0
+
+
+class PagedKVCache:
+    """Block allocator over per-layer paged K/V pools.
+
+    `alloc()`/`free()` are NOT thread-safe — the generation engine calls
+    them from its single step thread (same single-writer discipline as
+    the PR 3 collector)."""
+
+    def __init__(self, num_layers: int, num_heads: int, head_dim: int,
+                 page_size: int, num_pages: int, pages_per_seq: int,
+                 dtype="float32"):
+        if page_size < 1 or num_pages < 2 or pages_per_seq < 1:
+            raise InvalidArgumentError(
+                f"PagedKVCache needs page_size>=1, num_pages>=2 (page 0 "
+                f"is reserved scratch), pages_per_seq>=1; got "
+                f"{page_size}/{num_pages}/{pages_per_seq}")
+        self.num_layers = int(num_layers)
+        self.num_heads = int(num_heads)
+        self.head_dim = int(head_dim)
+        self.page_size = int(page_size)
+        self.num_pages = int(num_pages)
+        self.pages_per_seq = int(pages_per_seq)
+        self.dtype = dtype
+        import jax.numpy as jnp
+        shape = (self.num_layers, self.num_heads, self.num_pages,
+                 self.page_size, self.head_dim)
+        self.k_pages = jnp.zeros(shape, dtype)
+        self.v_pages = jnp.zeros(shape, dtype)
+        # LIFO free list: the page freed last is reallocated first, so a
+        # hot pool keeps touching the same HBM region
+        self._free: List[int] = list(range(self.num_pages - 1, 0, -1))
+        self._owned: Dict[int, List[int]] = {}  # seq id -> pages
+        monitor.stat_set("STAT_kv_pages_inuse", 0)
+
+    # -- capacity arithmetic ----------------------------------------------
+
+    @property
+    def usable_pages(self) -> int:
+        return self.num_pages - 1  # minus the trash page
+
+    @property
+    def free_pages(self) -> int:
+        return len(self._free)
+
+    @property
+    def pages_in_use(self) -> int:
+        return self.usable_pages - len(self._free)
+
+    @property
+    def max_tokens_per_seq(self) -> int:
+        return self.pages_per_seq * self.page_size
+
+    def pages_needed(self, tokens: int) -> int:
+        return -(-int(tokens) // self.page_size)  # ceil
+
+    def fits(self, tokens: int) -> bool:
+        """Could `tokens` EVER be admitted (table width + pool size)?"""
+        need = self.pages_needed(tokens)
+        return need <= self.pages_per_seq and need <= self.usable_pages
+
+    def can_admit(self, tokens: int) -> bool:
+        """Admission check: worst-case pages available RIGHT NOW."""
+        need = self.pages_needed(tokens)
+        return need <= self.pages_per_seq and need <= len(self._free)
+
+    # -- alloc / free ------------------------------------------------------
+
+    def alloc(self, seq_id: int, tokens: int) -> np.ndarray:
+        """Reserve worst-case pages for `tokens`; returns the sequence's
+        fixed-width page-table row (trash-padded int32 [pages_per_seq]).
+        Raises ResourceExhaustedError when the pool is short — callers
+        gate on `can_admit` so this raising means an accounting bug."""
+        if seq_id in self._owned:
+            raise InvalidArgumentError(
+                f"sequence {seq_id} already holds pages")
+        need = self.pages_needed(tokens)
+        if need > self.pages_per_seq:
+            raise InvalidArgumentError(
+                f"{tokens} tokens need {need} pages > pages_per_seq="
+                f"{self.pages_per_seq} (page_size={self.page_size})")
+        if need > len(self._free):
+            raise ResourceExhaustedError(
+                f"KV page pool exhausted: need {need} pages, "
+                f"{len(self._free)} free of {self.usable_pages}")
+        pages = [self._free.pop() for _ in range(need)]
+        self._owned[seq_id] = pages
+        monitor.stat_set("STAT_kv_pages_inuse", self.pages_in_use)
+        row = np.full((self.pages_per_seq,), TRASH_PAGE, np.int32)
+        row[:need] = pages
+        return row
+
+    def free(self, seq_id: int) -> List[int]:
+        """Release a sequence's pages back to the free list; returns the
+        page ids (the engine zeroes them on device before reuse).
+        Idempotent — a double free (evict racing natural EOS) is a
+        no-op."""
+        pages = self._owned.pop(seq_id, [])
+        self._free.extend(pages)
+        monitor.stat_set("STAT_kv_pages_inuse", self.pages_in_use)
+        return pages
+
+    def owned(self, seq_id: int) -> Optional[List[int]]:
+        pages = self._owned.get(seq_id)
+        return list(pages) if pages is not None else None
+
+    def zero_rows(self, pages: List[int]) -> np.ndarray:
+        """Fixed-width page-id row for the engine's jitted zeroing
+        scatter (trash-padded so one compiled shape serves every free)."""
+        row = np.full((self.pages_per_seq,), TRASH_PAGE, np.int32)
+        row[:len(pages)] = pages[:self.pages_per_seq]
+        return row
+
+    def stats(self) -> dict:
+        return {
+            "page_size": self.page_size,
+            "usable_pages": self.usable_pages,
+            "pages_in_use": self.pages_in_use,
+            "free_pages": self.free_pages,
+            "pages_per_seq": self.pages_per_seq,
+            "sequences": len(self._owned),
+            "occupancy": round(self.pages_in_use
+                               / max(1, self.usable_pages), 4),
+        }

@@ -1,0 +1,393 @@
+"""serving.GenerationEngine: continuous batching over a paged KV cache.
+
+The load-bearing anchors:
+
+- **Greedy parity** — the engine's paged decode and `GPTModel.generate`'s
+  contiguous cache share one math (`models.gpt.gpt_prefill`/
+  `gpt_decode_step`); greedy outputs must agree at token level for the
+  same prompts (the decode programs are different compiled shapes, so
+  float bits may differ — argmax tokens must not; within ONE engine the
+  [max_slots] decode program is a single compiled shape and repeat runs
+  are bit-stable).
+- **Compile discipline** — exactly one decode-step compile per engine
+  and one prefill per prompt bucket, ledger-verified, with sequences
+  joining and leaving mid-decode.
+- **Page hygiene** — EOS/deadline/poison all free the sequence's pages
+  the same step, zeroed before reuse.
+"""
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import serving
+from paddle_tpu.framework import monitor
+from paddle_tpu.framework.errors import (ExecutionTimeoutError, FatalError,
+                                         InvalidArgumentError,
+                                         ResourceExhaustedError,
+                                         UnavailableError)
+from paddle_tpu.models import GPTConfig, GPTForCausalLM
+from paddle_tpu.profiler import exporter, flight_recorder
+from paddle_tpu.serving.kv_cache import PagedKVCache
+
+
+@pytest.fixture(scope="module")
+def model():
+    paddle.seed(11)
+    cfg = GPTConfig.tiny(dropout=0.0)
+    net = GPTForCausalLM(cfg)
+    net.eval()
+    return net
+
+
+def _prompts(n=2, S=7, seed=0, vocab=512):
+    return np.random.RandomState(seed).randint(
+        0, vocab, size=(n, S)).astype("int64")
+
+
+def _engine(model, **kw):
+    kw.setdefault("max_slots", 2)
+    kw.setdefault("page_size", 4)
+    kw.setdefault("num_pages", 64)
+    kw.setdefault("prefill_buckets", (8,))
+    kw.setdefault("max_new_tokens", 5)
+    kw.setdefault("request_timeout_ms", 0)
+    return serving.GenerationEngine(model, **kw)
+
+
+# -- allocator unit layer ---------------------------------------------------
+
+def test_paged_allocator_basics():
+    c = PagedKVCache(num_layers=2, num_heads=2, head_dim=4, page_size=4,
+                     num_pages=8, pages_per_seq=3)
+    assert c.usable_pages == 7           # page 0 reserved scratch
+    assert c.pages_needed(1) == 1 and c.pages_needed(4) == 1
+    assert c.pages_needed(5) == 2
+    assert c.fits(12) and not c.fits(13)  # pages_per_seq bound
+    row = c.alloc(1, 9)                   # 3 pages
+    assert row.shape == (3,) and (row[:3] > 0).all()
+    assert c.pages_in_use == 3 and c.can_admit(9)
+    c.alloc(2, 9)
+    c.alloc(3, 4)
+    assert c.pages_in_use == 7 and not c.can_admit(1)
+    assert monitor.stat_get("STAT_kv_pages_inuse") == 7
+    with pytest.raises(ResourceExhaustedError):
+        c.alloc(4, 1)
+    with pytest.raises(InvalidArgumentError):
+        c.alloc(1, 1)                     # double alloc same seq
+    freed = c.free(2)
+    assert len(freed) == 3 and c.can_admit(9)
+    assert c.free(2) == []                # idempotent double free
+    assert monitor.stat_get("STAT_kv_pages_inuse") == 4
+    with pytest.raises(InvalidArgumentError):
+        c.alloc(9, 13)                    # wider than the page table
+
+
+# -- parity / numerics ------------------------------------------------------
+
+def test_greedy_parity_with_generate(model):
+    ids = _prompts()
+    ref = model.generate(paddle.to_tensor(ids), max_new_tokens=5).numpy()
+    with _engine(model) as eng:
+        outs = [f.result(timeout=120)
+                for f in [eng.submit(p, max_new_tokens=5) for p in ids]]
+        s = eng.stats()
+    for out, r in zip(outs, ref):
+        np.testing.assert_array_equal(out, r)
+    assert s["compiles"] == {"prefill[b=8]": 1, "decode[m=2]": 1}
+    assert s["pages"]["pages_in_use"] == 0
+
+
+def test_repeat_runs_bit_stable_one_engine(model):
+    """Within ONE engine config the decode program is a single compiled
+    shape: repeated submissions of the same prompt are bit-stable, and
+    co-riders never perturb a sequence's tokens (row independence)."""
+    ids = _prompts(n=3, seed=5)
+    with _engine(model, max_slots=3) as eng:
+        solo = eng.submit(ids[0], max_new_tokens=6).result(timeout=120)
+        futs = [eng.submit(p, max_new_tokens=6) for p in ids]
+        crowd = [f.result(timeout=120) for f in futs]
+    np.testing.assert_array_equal(solo, crowd[0])
+
+
+def test_sampling_is_engine_deterministic(model):
+    ids = _prompts(seed=3)[0]
+    def run():
+        with _engine(model, seed=42) as eng:
+            return eng.generate(ids, max_new_tokens=6, do_sample=True,
+                                temperature=0.9)
+    a, b = run(), run()
+    np.testing.assert_array_equal(a, b)
+    assert a.shape == (ids.size + 6,)
+
+
+# -- scheduler dynamics -----------------------------------------------------
+
+def test_mid_decode_join_without_recompile(model):
+    ids = _prompts()
+    ref_a = model.generate(paddle.to_tensor(ids[0:1]),
+                           max_new_tokens=40).numpy()[0]
+    ref_b = model.generate(paddle.to_tensor(ids[1:2]),
+                           max_new_tokens=5).numpy()[0]
+    with _engine(model, num_pages=64) as eng:
+        fa = eng.submit(ids[0], max_new_tokens=40)
+        # wait until A is genuinely mid-decode, then join B
+        deadline = time.time() + 60
+        while eng.stats()["steps"] < 3:
+            assert time.time() < deadline, "engine never started stepping"
+            time.sleep(0.002)
+        joined_at = eng.stats()["steps"]
+        fb = eng.submit(ids[1], max_new_tokens=5)
+        out_b = fb.result(timeout=120)
+        out_a = fa.result(timeout=120)
+        s = eng.stats()
+    assert joined_at >= 3                      # B really joined mid-decode
+    np.testing.assert_array_equal(out_a, ref_a)
+    np.testing.assert_array_equal(out_b, ref_b)
+    # the join compiled NOTHING new: one decode step, one prefill bucket
+    assert s["compiles"] == {"prefill[b=8]": 1, "decode[m=2]": 1}
+
+
+def test_eos_frees_pages_same_step(model):
+    ids = _prompts()
+    ref = model.generate(paddle.to_tensor(ids[0:1]),
+                         max_new_tokens=5).numpy()[0]
+    S = ids.shape[1]
+    gen = ref[S:]
+    eos = int(gen[2])  # a token generated mid-stream
+    stop = int(np.where(gen == eos)[0][0])  # first occurrence wins
+    assert stop < len(gen) - 1, "eos must cut the stream short"
+    with _engine(model) as eng:
+        out = eng.generate(ids[0], max_new_tokens=5, eos_token_id=eos)
+        pages_after = eng.stats()["pages"]["pages_in_use"]
+    np.testing.assert_array_equal(out, ref[:S + stop + 1])  # EOS included
+    assert pages_after == 0
+
+
+def test_exhaustion_defers_admission_then_serves(model):
+    """Admission control: a request whose worst-case pages are not free
+    stays QUEUED (head-of-line) and is admitted as soon as a finishing
+    sequence frees pages — never failed, never starving a running
+    sequence mid-decode."""
+    ids = _prompts()
+    blocked0 = monitor.stat_get("STAT_gen_admit_blocked")
+    dumps0 = len([d for d in flight_recorder.dump_records()
+                  if d["reason"] == "gen_allocator_exhausted"])
+    # pool sized for exactly one sequence: ceil((7+5)/4) = 3 pages + trash
+    with _engine(model, num_pages=4) as eng:
+        fa = eng.submit(ids[0], max_new_tokens=5)
+        fb = eng.submit(ids[1], max_new_tokens=5)
+        out_a = fa.result(timeout=120)
+        out_b = fb.result(timeout=120)
+    assert out_a.shape == out_b.shape == (12,)
+    assert monitor.stat_get("STAT_gen_admit_blocked") > blocked0
+    assert len([d for d in flight_recorder.dump_records()
+                if d["reason"] == "gen_allocator_exhausted"]) > dumps0
+
+
+def test_queued_deadline_expires_behind_blocked_head(model):
+    """A request queued BEHIND a page-blocked head must still get its
+    deadline error on time — head-of-line blocking defers admission,
+    never expiry."""
+    ids = _prompts(n=3, seed=31)
+    # pool fits one 107-token sequence (27 pages of 29 usable) at a time
+    with _engine(model, num_pages=30, page_size=4,
+                 max_new_tokens=100) as eng:
+        fa = eng.submit(ids[0], max_new_tokens=100)   # occupies the pool
+        fh = eng.submit(ids[1], max_new_tokens=100)   # blocked head
+        fb = eng.submit(ids[2], max_new_tokens=5, timeout_ms=50)
+        with pytest.raises(ExecutionTimeoutError):
+            fb.result(timeout=30)   # must NOT wait for A to finish
+        fa.result(timeout=240)
+        fh.result(timeout=240)
+
+
+def test_request_that_can_never_fit_fails_fast(model):
+    with _engine(model, num_pages=4) as eng:
+        with pytest.raises(ResourceExhaustedError):
+            eng.submit(_prompts()[0], max_new_tokens=20)  # > pool
+        with pytest.raises(InvalidArgumentError):
+            eng.submit(np.arange(20), max_new_tokens=2)   # > bucket
+        with pytest.raises(InvalidArgumentError):
+            eng.submit(np.zeros((0,), np.int64))
+        with pytest.raises(InvalidArgumentError):
+            eng.submit(_prompts()[0], max_new_tokens=0)
+        with pytest.raises(InvalidArgumentError):
+            eng.submit(np.zeros((2, 3), np.int64))
+
+
+def test_deadline_expiry_mid_decode_cancels_only_that_future(model):
+    ids = _prompts()
+    t0 = monitor.stat_get("STAT_gen_timeouts")
+    e0 = monitor.stat_get("STAT_gen_evictions")
+    with _engine(model, num_pages=64) as eng:
+        fa = eng.submit(ids[0], max_new_tokens=40)          # no deadline
+        fb = eng.submit(ids[1], max_new_tokens=100, timeout_ms=60)
+        with pytest.raises(ExecutionTimeoutError):
+            fb.result(timeout=120)
+        out_a = fa.result(timeout=120)                      # unaffected
+        pages_after = eng.stats()["pages"]["pages_in_use"]
+    assert out_a.shape == (47,)
+    assert pages_after == 0                 # the cancel freed B's pages
+    assert monitor.stat_get("STAT_gen_timeouts") > t0
+    assert monitor.stat_get("STAT_gen_evictions") > e0
+
+
+def test_poisoned_sequence_fails_alone_and_pages_scrub(model):
+    """Poison isolation: NaN K/V in one sequence's pages fails ONLY that
+    sequence (non-finite-logit flag), and because freed pages are zeroed
+    the next owner of the same physical pages decodes cleanly."""
+    ids = _prompts()
+    ref_a = model.generate(paddle.to_tensor(ids[0:1]),
+                           max_new_tokens=12).numpy()[0]
+    ref_c = model.generate(paddle.to_tensor(ids[0:1]),
+                           max_new_tokens=17).numpy()[0]
+    p0 = monitor.stat_get("STAT_gen_poisoned")
+    fired = []
+
+    def hook(eng):
+        req = eng._slots[1] if len(eng._slots) > 1 else None
+        if not fired and req is not None and len(req.toks) >= 2:
+            pages = eng._cache.owned(req.rid)
+            if pages:
+                eng._kp = eng._kp.at[:, :, pages].set(np.nan)
+                fired.append(req.rid)
+
+    with _engine(model, num_pages=64) as eng:
+        eng._pre_step_hook = hook
+        fa = eng.submit(ids[0], max_new_tokens=12)
+        # B lands in slot 1 (A holds slot 0) and gets poisoned
+        fb = eng.submit(ids[1], max_new_tokens=12)
+        with pytest.raises(FatalError):
+            fb.result(timeout=120)
+        out_a = fa.result(timeout=120)
+        eng._pre_step_hook = None
+        # the poisoned pages were zeroed on free: a wider request that
+        # reuses them (6 pages > A's 5, so it reaches into B's freed
+        # pages under the LIFO free list) must decode exactly the
+        # clean-run tokens
+        out_c = eng.generate(ids[0], max_new_tokens=17)
+        pages_after = eng.stats()["pages"]["pages_in_use"]
+    assert fired, "test hook never found the co-resident sequence"
+    np.testing.assert_array_equal(out_a, ref_a)
+    np.testing.assert_array_equal(out_c, ref_c)
+    assert pages_after == 0
+    assert monitor.stat_get("STAT_gen_poisoned") > p0
+
+
+# -- lifecycle / backpressure / observability -------------------------------
+
+def test_backpressure_rejects_at_queue_depth(model):
+    with _engine(model, max_queue_depth=0) as eng:
+        with pytest.raises(serving.EngineOverloaded):
+            eng.submit(_prompts()[0], max_new_tokens=2)
+        assert monitor.stat_get("STAT_gen_rejected") >= 1
+
+
+def test_shutdown_drain_finishes_queued_work(model):
+    ids = _prompts(n=4, seed=9)
+    eng = _engine(model, num_pages=64)
+    futs = [eng.submit(p, max_new_tokens=4) for p in ids]
+    eng.shutdown(drain=True, timeout_s=120)
+    for f in futs:
+        assert f.result(timeout=1).shape == (11,)
+    with pytest.raises(UnavailableError):
+        eng.submit(ids[0])
+
+
+def test_shutdown_no_drain_fails_fast(model):
+    # five long requests: two decode for ~100 steps, three stay queued —
+    # both classes must fail fast on drain=False, nothing may hang
+    ids = _prompts(n=5, seed=21)
+    eng = _engine(model, num_pages=64, name="gen_nodrain")
+    futs = [eng.submit(p, max_new_tokens=100) for p in ids]
+    time.sleep(0.05)  # let the first admissions happen
+    eng.shutdown(drain=False, timeout_s=120)
+    for f in futs:
+        with pytest.raises(UnavailableError):
+            f.result(timeout=5)
+
+
+def test_health_and_readyz_lifecycle(model):
+    eng = _engine(model, name="gen_readyz")
+    try:
+        h = eng.health()
+        assert h["ready"] and h["reason"] == "ok"
+        assert h["warmup_complete"] and h["live_lanes"] == 1
+        payload = exporter.readiness_payload()
+        assert payload["engines"]["gen_readyz"]["ready"]
+    finally:
+        eng.shutdown()
+    h = eng.health()
+    assert not h["ready"] and h["reason"] == "draining"
+    assert "gen_readyz" not in exporter.readiness_payload()["engines"]
+
+
+def test_stats_shape_and_counters(model):
+    s0_steps = monitor.stat_get("STAT_gen_steps")
+    with _engine(model) as eng:
+        eng.generate(_prompts()[0], max_new_tokens=4)
+        s = eng.stats()
+    assert s["prefills"] >= 1 and s["tokens"] >= 4
+    assert s["queue_depth"] == 0
+    assert set(s["pages"]) >= {"pages_in_use", "usable_pages",
+                               "occupancy", "page_size"}
+    assert s["ttft_ms"]["count"] >= 1
+    assert monitor.stat_get("STAT_gen_steps") > s0_steps
+    assert monitor.stat_get("STAT_gen_completions") >= 1
+
+
+def test_latency_report_summarizes_gen_spans(model, tmp_path, capsys):
+    import importlib.util
+    import os
+    from paddle_tpu import profiler
+
+    with _engine(model, name="gen_report") as eng:
+        for p in _prompts(n=3, seed=13):
+            eng.generate(p, max_new_tokens=4)
+    path = str(tmp_path / "trace.json")
+    profiler.export_chrome_tracing(path)
+    spec = importlib.util.spec_from_file_location(
+        "latency_report", os.path.join(
+            os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+            "tools", "latency_report.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    gen = [g for g in mod.parse_gen_trace(path)
+           if g["engine"] == "gen_report"]
+    assert len(gen) >= 3
+    assert all(g["n"] == 4 and g["ttft"] > 0 for g in gen)
+    rep = mod.gen_report(gen, top=2)
+    assert rep["requests"] == len(gen)
+    for k in ("ttft", "tpot", "e2e"):
+        assert rep["phases_ms"][k]["p50"] <= rep["phases_ms"][k]["max"] + 1e-9
+    assert len(rep["slowest"]) == 2
+    # CLI renders both serving and generation sections as available
+    assert mod.main([path, "--engine", "gen_report"]) == 0
+    assert "ttft" in capsys.readouterr().out
+
+
+@pytest.mark.slow
+def test_generation_soak_many_slots(model):
+    """Heavy multi-slot churn: mixed lengths, sampling and greedy mixed,
+    requests joining/leaving constantly — one decode compile, no page
+    leaks, every future delivered."""
+    rng = np.random.RandomState(0)
+    with _engine(model, max_slots=4, num_pages=64,
+                 prefill_buckets=(4, 8)) as eng:
+        futs = []
+        for i in range(24):
+            S = int(rng.randint(2, 9))
+            p = rng.randint(0, 512, size=(S,))
+            futs.append((S, eng.submit(
+                p, max_new_tokens=int(rng.randint(1, 8)),
+                do_sample=bool(i % 3 == 0), temperature=0.8)))
+        for S, f in futs:
+            assert f.result(timeout=240).shape[0] > S
+        s = eng.stats()
+    decode_compiles = [v for k, v in s["compiles"].items()
+                       if k.startswith("decode")]
+    assert decode_compiles == [1]
+    assert s["pages"]["pages_in_use"] == 0

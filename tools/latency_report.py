@@ -16,6 +16,16 @@ prints:
   "why was THIS request slow" question `/metrics` histograms cannot
   answer.
 
+The continuous-batching GenerationEngine emits a second, slot-flavored
+reqspan shape per resolved request (profiler/spans.py GenSpan):
+
+    reqspan:<rid>:<engine>:slot<slot>:n=<tokens>:ttft=…,tpot=…,e=…
+
+with TTFT (queue + prefill to first token), TPOT (steady decode cadence
+per output token) and end-to-end milliseconds. Both shapes are parsed;
+whichever is present gets its own report section (phase percentiles +
+top-N slowest).
+
 Usage:  python tools/latency_report.py trace.json [--top 10]
                                        [--engine NAME] [--json]
 """
@@ -32,15 +42,26 @@ _REQSPAN = re.compile(
     r"q=(?P<q>[0-9.]+),p=(?P<p>[0-9.]+),d=(?P<d>[0-9.]+),"
     r"r=(?P<r>[0-9.]+),e=(?P<e>[0-9.]+)$")
 
+_GENSPAN = re.compile(
+    r"^reqspan:(?P<rid>\d+):(?P<engine>.*):slot(?P<slot>[^:]*):"
+    r"n=(?P<n>\d+):"
+    r"ttft=(?P<ttft>[0-9.]+),tpot=(?P<tpot>[0-9.]+),e=(?P<e>[0-9.]+)$")
+
 PHASES = (("queue", "q"), ("pad", "p"), ("device", "d"), ("resolve", "r"))
+GEN_PHASES = (("ttft", "ttft"), ("tpot", "tpot"))
 
 
-def parse_trace(path):
-    """[{rid, engine, lane, bucket, q, p, d, r, e, ts_us}] from the
-    trace's reqspan instants."""
+def _load_events(path):
     with open(path, encoding="utf-8") as f:
         data = json.load(f)
-    events = data.get("traceEvents", data if isinstance(data, list) else [])
+    return data.get("traceEvents", data if isinstance(data, list) else [])
+
+
+def parse_trace(path, events=None):
+    """[{rid, engine, lane, bucket, q, p, d, r, e, ts_us}] from the
+    trace's reqspan instants. Pass `events` to reuse an already-loaded
+    trace (main() loads the file once for both span shapes)."""
+    events = _load_events(path) if events is None else events
     out = []
     for ev in events:
         m = _REQSPAN.match(str(ev.get("name", "")))
@@ -51,6 +72,23 @@ def parse_trace(path):
                     "lane": g["lane"], "bucket": g["bucket"],
                     "q": float(g["q"]), "p": float(g["p"]),
                     "d": float(g["d"]), "r": float(g["r"]),
+                    "e": float(g["e"]), "ts_us": ev.get("ts", 0.0)})
+    return out
+
+
+def parse_gen_trace(path, events=None):
+    """[{rid, engine, slot, n, ttft, tpot, e, ts_us}] from the trace's
+    generation-engine reqspan instants."""
+    events = _load_events(path) if events is None else events
+    out = []
+    for ev in events:
+        m = _GENSPAN.match(str(ev.get("name", "")))
+        if not m:
+            continue
+        g = m.groupdict()
+        out.append({"rid": int(g["rid"]), "engine": g["engine"],
+                    "slot": g["slot"], "n": int(g["n"]),
+                    "ttft": float(g["ttft"]), "tpot": float(g["tpot"]),
                     "e": float(g["e"]), "ts_us": ev.get("ts", 0.0)})
     return out
 
@@ -86,6 +124,50 @@ def report(requests, top=10):
             "slowest": slowest}
 
 
+def gen_phase_stats(gens):
+    """{ttft/tpot/e2e: {count, mean, p50, p99, max}} over gen spans
+    (tpot percentiles exclude single-token requests — they have no
+    decode cadence to measure)."""
+    out = {}
+    for label, key in GEN_PHASES + (("e2e", "e"),):
+        rows = [g for g in gens if not (key == "tpot" and g["n"] <= 1)]
+        vals = sorted(g[key] for g in rows)
+        n = len(vals)
+        out[label] = {
+            "count": n,
+            "mean": round(sum(vals) / n, 3) if n else 0.0,
+            "p50": round(_pctl(vals, 50), 3),
+            "p99": round(_pctl(vals, 99), 3),
+            "max": round(vals[-1], 3) if n else 0.0,
+        }
+    return out
+
+
+def gen_report(gens, top=10):
+    return {"requests": len(gens), "phases_ms": gen_phase_stats(gens),
+            "tokens": sum(g["n"] for g in gens),
+            "slowest": sorted(gens, key=lambda g: -g["e"])[:top]}
+
+
+def render_gen(rep, file=sys.stdout):
+    print(f"{rep['requests']} generation span(s), "
+          f"{rep['tokens']} tokens", file=file)
+    print(f"\n{'phase':<10}{'p50(ms)':>10}{'p99(ms)':>10}"
+          f"{'mean':>10}{'max':>10}", file=file)
+    for label, _ in GEN_PHASES + (("e2e", "e"),):
+        s = rep["phases_ms"][label]
+        print(f"{label:<10}{s['p50']:>10.3f}{s['p99']:>10.3f}"
+              f"{s['mean']:>10.3f}{s['max']:>10.3f}", file=file)
+    if rep["slowest"]:
+        print(f"\ntop {len(rep['slowest'])} slowest:", file=file)
+        print(f"{'rid':>8} {'engine':<16}{'slot':>5}{'toks':>6}"
+              f"{'e2e(ms)':>10}{'ttft':>9}{'tpot':>9}", file=file)
+        for g in rep["slowest"]:
+            print(f"{g['rid']:>8} {g['engine']:<16}{g['slot']:>5}"
+                  f"{g['n']:>6}{g['e']:>10.3f}{g['ttft']:>9.3f}"
+                  f"{g['tpot']:>9.3f}", file=file)
+
+
 def render(rep, file=sys.stdout):
     print(f"{rep['requests']} request span(s)", file=file)
     print(f"\n{'phase':<10}{'p50(ms)':>10}{'p99(ms)':>10}"
@@ -117,20 +199,36 @@ def main(argv=None) -> int:
     ap.add_argument("--json", action="store_true",
                     help="emit the report as JSON instead of a table")
     args = ap.parse_args(argv)
-    requests = parse_trace(args.trace)
+    events = _load_events(args.trace)
+    requests = parse_trace(args.trace, events=events)
+    gens = parse_gen_trace(args.trace, events=events)
     if args.engine is not None:
         requests = [r for r in requests if r["engine"] == args.engine]
-    if not requests:
+        gens = [g for g in gens if g["engine"] == args.engine]
+    if not requests and not gens:
         print("no reqspan events found — was the trace exported from a "
               "process serving with FLAGS_serving_spans on?",
               file=sys.stderr)
         return 1
-    rep = report(requests, top=args.top)
+    out = {}
+    if requests:
+        out["serving"] = report(requests, top=args.top)
+    if gens:
+        out["generation"] = gen_report(gens, top=args.top)
     if args.json:
-        json.dump(rep, sys.stdout, indent=2)
+        # serving-only traces keep the original FLAT schema (pre-existing
+        # consumers read report['phases_ms'] directly); the sectioned
+        # wrapper only appears once generation spans exist in the trace
+        payload = out["serving"] if not gens else out
+        json.dump(payload, sys.stdout, indent=2)
         print()
     else:
-        render(rep)
+        if requests:
+            render(out["serving"])
+        if requests and gens:
+            print()
+        if gens:
+            render_gen(out["generation"])
     return 0
 
 
