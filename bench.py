@@ -706,6 +706,304 @@ def bench_generation():
     return eng_tps, extra
 
 
+def bench_quant():
+    """Quantized serving (ISSUE 9), three arms with regression gates:
+
+    (a) **weights** — continuous-batching GenerationEngine over a
+    `quantize_weights`-int8 GPT vs the sequential `generate` loop on the
+    SAME quantized model: the existing >=2x generation floor must hold
+    with integer-resident weights (the decode matmuls dequantize
+    in-graph). Emits fp32-vs-int8 decode-weight HBM bytes and the greedy
+    token-agreement parity delta vs the fp32 model.
+
+    (b) **artifact** — jit.save fp32 vs int8 vs int4 artifacts of an
+    MLP: on-disk bytes, Predictor output parity (max abs), and the
+    quantized artifact through the one-shot InferenceEngine (>=2x a
+    serial quantized-Predictor loop; exactly one compile per
+    (device, bucket) — the PR 2/3 ledger re-verified under quantized
+    weights).
+
+    (c) **int8 KV pages** — two GenerationEngines with EQUAL pool HBM
+    budgets, fp32 pages vs int8 pages + scale pools: int8 must admit
+    >=1.9x the concurrent sequences (page arithmetic AND sampled live
+    peak) and sustain >=1.5x aggregate tokens/sec at its saturated
+    batch, with exactly-once compile ledgers in both modes."""
+    import tempfile
+    import paddle_tpu as paddle
+    import paddle_tpu.nn as nn
+    from paddle_tpu import inference, serving
+    from paddle_tpu.framework import monitor
+    from paddle_tpu.models import GPTConfig, GPTForCausalLM
+    from paddle_tpu.quantization import quantize_weights
+    from paddle_tpu.serving.kv_cache import PagedKVCache
+    from paddle_tpu.static.input_spec import InputSpec
+
+    if _SMOKE:
+        HID, LAYERS, HEADS, VOCAB = 512, 4, 8, 2048
+        SLOTS, REQUESTS, MAX_NEW, PROMPT = 16, 32, 32, 16
+    else:
+        HID, LAYERS, HEADS, VOCAB = 768, 8, 12, 32000
+        SLOTS, REQUESTS, MAX_NEW, PROMPT = 16, 64, 64, 64
+    PAGE = 16
+    monitor.reset_all_stats()
+
+    def leaf_bytes(W):
+        import jax
+        return int(sum(np.asarray(x).nbytes
+                       for x in jax.tree_util.tree_leaves(W)))
+
+    def gpt(seed=0):
+        paddle.seed(seed)
+        cfg = GPTConfig(vocab_size=VOCAB, hidden_size=HID,
+                        num_layers=LAYERS, num_heads=HEADS,
+                        intermediate_size=4 * HID,
+                        max_position_embeddings=PROMPT + MAX_NEW,
+                        dropout=0.0)
+        net = GPTForCausalLM(cfg)
+        net.eval()
+        return net
+
+    rng = np.random.RandomState(0)
+    prompts = [rng.randint(0, VOCAB, size=(PROMPT,)).astype("int64")
+               for _ in range(REQUESTS)]
+
+    def run_engine(net, kv_dtype, num_pages, name, sample_peak=False):
+        """All prompts through one engine concurrently; returns
+        (tokens/sec, stats, peak live sequences, outputs)."""
+        eng = serving.GenerationEngine(
+            net, max_slots=SLOTS, page_size=PAGE, num_pages=num_pages,
+            prefill_buckets=(PROMPT,), max_new_tokens=MAX_NEW,
+            max_queue_depth=2 * REQUESTS, request_timeout_ms=0,
+            kv_cache_dtype=kv_dtype, name=name)
+        peak = [0]
+        stop = threading.Event()
+
+        def sampler():
+            while not stop.is_set():
+                live = sum(1 for s in eng.stats()["slots"]
+                           if s["rid"] is not None)
+                peak[0] = max(peak[0], live)
+                time.sleep(0.005)
+
+        th = None
+        if sample_peak:
+            th = threading.Thread(target=sampler, daemon=True)
+            th.start()
+        t0 = time.perf_counter()
+        futs = [eng.submit(p, max_new_tokens=MAX_NEW) for p in prompts]
+        outs = [f.result() for f in futs]
+        wall = time.perf_counter() - t0
+        stop.set()
+        if th is not None:
+            th.join()
+        s = eng.stats()
+        eng.shutdown()
+        toks = sum(len(o) - PROMPT for o in outs)
+        return toks / wall, s, peak[0], outs
+
+    def ledger_exact(s):
+        led = s["compiles"]
+        return (sum(v for k, v in led.items()
+                    if k.startswith("decode")) == 1
+                and all(v == 1 for k, v in led.items()
+                        if k.startswith("prefill")))
+
+    # ---- arm (a): weight-only int8 through the generation engine -----
+    pages_ample = SLOTS * -(-(PROMPT + MAX_NEW) // PAGE) + 1
+    net_fp = gpt()
+    w_fp_bytes = leaf_bytes(net_fp.decode_weights())
+    # fp32 greedy reference for the parity delta (same seed/weights)
+    ref_outs = [np.asarray(net_fp.generate(
+        paddle.to_tensor(p[None]), max_new_tokens=MAX_NEW).numpy()[0])
+        for p in prompts[:8]]
+    net_q = quantize_weights(gpt())
+    w_q_bytes = leaf_bytes(net_q.decode_weights())
+    # sequential baseline on the SAME int8-weight model (warm first)
+    net_q.generate(paddle.to_tensor(prompts[0][None]),
+                   max_new_tokens=MAX_NEW)
+    t0 = time.perf_counter()
+    for p in prompts:
+        net_q.generate(paddle.to_tensor(p[None]), max_new_tokens=MAX_NEW)
+    seq_tps = REQUESTS * MAX_NEW / (time.perf_counter() - t0)
+    eng_tps, s_w, _, q_outs = run_engine(net_q, "auto", pages_ample,
+                                         "bench_quant_weights")
+    # parity over GENERATED tokens only — prompt tokens trivially match
+    # and would dilute the quantization signal
+    agree = float(np.mean([np.mean(a[PROMPT:] == b[PROMPT:len(a)])
+                           for a, b in zip(ref_outs, q_outs)]))
+    weight_arm = {
+        "fp32_weight_bytes": w_fp_bytes,
+        "int8_weight_bytes": w_q_bytes,
+        "weight_bytes_ratio": round(w_fp_bytes / max(w_q_bytes, 1), 3),
+        "sequential_generate_tps": round(seq_tps, 2),
+        "engine_tps": round(eng_tps, 2),
+        "speedup": round(eng_tps / max(seq_tps, 1e-9), 3),
+        "greedy_agreement_vs_fp32": round(agree, 4),
+        "compile_ledger": s_w["compiles"],
+        "ledger_exact": ledger_exact(s_w),
+    }
+
+    # ---- arm (b): quantized jit.save artifact through the engine -----
+    DIM, HIDM = 256, 1024
+
+    class Net(nn.Layer):
+        def __init__(self):
+            super().__init__()
+            self.fc1 = nn.Linear(DIM, HIDM)
+            self.fc2 = nn.Linear(HIDM, HIDM)
+            self.fc3 = nn.Linear(HIDM, DIM)
+
+        def forward(self, x):
+            h = paddle.tanh(self.fc1(x))
+            return self.fc3(paddle.tanh(self.fc2(h)))
+
+    tmp = tempfile.mkdtemp()
+    spec = [InputSpec([None, DIM], "float32")]
+
+    def art_bytes(prefix):
+        return sum(os.path.getsize(prefix + ext)
+                   for ext in (".pdmodel", ".pdiparams", ".pdmeta"))
+
+    paddle.seed(0)
+    p_fp = os.path.join(tmp, "mlp_fp32")
+    paddle.jit.save(Net(), p_fp, input_spec=spec)
+    paddle.seed(0)
+    p_q8 = os.path.join(tmp, "mlp_int8")
+    paddle.jit.save(quantize_weights(Net()), p_q8, input_spec=spec)
+    paddle.seed(0)
+    p_q4 = os.path.join(tmp, "mlp_int4")
+    paddle.jit.save(quantize_weights(Net(), bits=4), p_q4,
+                    input_spec=spec)
+    x1 = np.random.RandomState(1).standard_normal((1, DIM)) \
+        .astype("float32")
+    pred_fp = inference.create_predictor(inference.Config(p_fp))
+    pred_q8 = inference.create_predictor(inference.Config(p_q8))
+    parity = float(np.abs(pred_fp.run([x1])[0]
+                          - pred_q8.run([x1])[0]).max())
+    # serial quantized-predictor baseline
+    for _ in range(3):
+        pred_q8.run([x1])
+    SERIAL = 100 if _SMOKE else 200
+    t0 = time.perf_counter()
+    for _ in range(SERIAL):
+        pred_q8.run([x1])
+    serial_qps = SERIAL / (time.perf_counter() - t0)
+    BUCKETS = (1, 4, 16, 64)
+    c0 = monitor.stat_get("STAT_predictor_compiles")
+    eng = serving.InferenceEngine(
+        inference.Config(p_q8), batch_buckets=BUCKETS,
+        max_batch_size=BUCKETS[-1], max_queue_depth=4096,
+        name="bench_quant_artifact")
+    warm = monitor.stat_get("STAT_predictor_compiles") - c0
+    SUBMITTERS, PER, PIPELINE = 32, 16 if _SMOKE else 40, 4
+    start = threading.Barrier(SUBMITTERS + 1)
+    errors = []
+
+    def client(i):
+        try:
+            r = np.random.RandomState(i)
+            x = r.standard_normal((1, DIM)).astype("float32")
+            start.wait()
+            from collections import deque
+            outstanding = deque()
+            for _ in range(PER):
+                outstanding.append(eng.submit(x, timeout_ms=0))
+                if len(outstanding) >= PIPELINE:
+                    outstanding.popleft().result()
+            for f in outstanding:
+                f.result()
+        except Exception as e:  # noqa: BLE001
+            errors.append(e)
+
+    threads = [threading.Thread(target=client, args=(i,), daemon=True)
+               for i in range(SUBMITTERS)]
+    for t in threads:
+        t.start()
+    start.wait()
+    t0 = time.perf_counter()
+    for t in threads:
+        t.join()
+    if errors:
+        raise RuntimeError(f"{len(errors)}/{SUBMITTERS} quant serving "
+                           f"clients failed: {errors[0]!r}")
+    qps = SUBMITTERS * PER / (time.perf_counter() - t0)
+    live = monitor.stat_get("STAT_predictor_compiles") - c0 - warm
+    s_art = eng.stats()
+    eng.shutdown()
+    lanes = len(s_art["lanes"])
+    one_per = (warm == lanes * len(BUCKETS) and live == 0
+               and all(c == 1 for lane in s_art["lanes"]
+                       for c in lane["bucket_compiles"].values()))
+    artifact_arm = {
+        "fp32_artifact_bytes": art_bytes(p_fp),
+        "int8_artifact_bytes": art_bytes(p_q8),
+        "int4_artifact_bytes": art_bytes(p_q4),
+        "artifact_shrink_int8": round(art_bytes(p_fp)
+                                      / art_bytes(p_q8), 2),
+        "artifact_shrink_int4": round(art_bytes(p_fp)
+                                      / art_bytes(p_q4), 2),
+        "predictor_parity_max_abs": parity,
+        "quantized_weights": s_art["quantized_weights"],
+        "serial_predictor_qps": round(serial_qps, 2),
+        "engine_qps": round(qps, 2),
+        "speedup_vs_serial": round(qps / max(serial_qps, 1e-9), 3),
+        "one_compile_per_bucket": one_per,
+    }
+
+    # ---- arm (c): int8 KV pages at an equal pool-byte budget ---------
+    pages_per_req = -(-(PROMPT + MAX_NEW) // PAGE)
+    D = HID // HEADS
+    dims = dict(num_layers=LAYERS, num_heads=HEADS, head_dim=D,
+                page_size=PAGE)
+    # budget sized so fp32 pages admit a FRACTION of the slots (the
+    # page-starved regime quantization exists to fix): slots/4 requests'
+    # worth of fp32 pages + the reserved scratch page
+    fp_pages = (SLOTS // 4) * pages_per_req + 1
+    budget = fp_pages * PagedKVCache.page_hbm_bytes(dtype="float32",
+                                                    **dims)
+    q_pages = PagedKVCache.pages_for_budget(budget, dtype="int8", **dims)
+    cap_fp = min(SLOTS, (fp_pages - 1) // pages_per_req)
+    cap_q = min(SLOTS, (q_pages - 1) // pages_per_req)
+    gb = 1024 ** 3
+    fp_tps, s_fp, peak_fp, fp_outs = run_engine(
+        net_fp, "float32", fp_pages, "bench_quant_kv_fp32",
+        sample_peak=True)
+    q_tps, s_q, peak_q, q_outs = run_engine(
+        net_fp, "int8", q_pages, "bench_quant_kv_int8",
+        sample_peak=True)
+    kv_agree = float(np.mean([np.mean(a[PROMPT:] == b[PROMPT:])
+                              for a, b in zip(fp_outs, q_outs)]))
+    kv_arm = {
+        "pool_budget_bytes": int(budget),
+        "fp32_pages": int(fp_pages),
+        "int8_pages": int(q_pages),
+        "kv_pages_per_gb_fp32": int(gb // PagedKVCache.page_hbm_bytes(
+            dtype="float32", **dims)),
+        "kv_pages_per_gb_int8": int(gb // PagedKVCache.page_hbm_bytes(
+            dtype="int8", **dims)),
+        "concurrent_capacity_fp32": int(cap_fp),
+        "concurrent_capacity_int8": int(cap_q),
+        "admit_ratio": round(cap_q / max(cap_fp, 1), 3),
+        "peak_live_fp32": int(peak_fp),
+        "peak_live_int8": int(peak_q),
+        # sampled live concurrency, gated alongside the arithmetic:
+        # admission could regress (admitted-then-starved, dead sampler)
+        # without moving can_admit's numbers
+        "peak_ratio": round(peak_q / max(peak_fp, 1), 3),
+        "fp32_tokens_per_sec": round(fp_tps, 2),
+        "int8_tokens_per_sec": round(q_tps, 2),
+        "tokens_ratio": round(q_tps / max(fp_tps, 1e-9), 3),
+        "token_agreement_int8_vs_fp32": round(kv_agree, 4),
+        "fp32_ledger": s_fp["compiles"],
+        "int8_ledger": s_q["compiles"],
+        "ledgers_exact": ledger_exact(s_fp) and ledger_exact(s_q),
+        "int8_pool_stats": s_q["pages"],
+    }
+    extra = {"weight_arm": weight_arm, "artifact_arm": artifact_arm,
+             "kv_arm": kv_arm}
+    return eng_tps, extra
+
+
 def bench_input():
     """Training input pipeline on an input-bound workload (ISSUE 4):
     synthetic slow dataset (per-item sleep calibrated per path against
@@ -1214,7 +1512,8 @@ def _run_mode(mode="train", backend=None):
     headline = {"serving": "serving_engine_qps_64_submitters",
                 "input": "input_pipeline_sharded_buffered_steps_per_sec",
                 "packing": "packing_effective_tokens_per_sec",
-                "generation": "generation_engine_tokens_per_sec"}\
+                "generation": "generation_engine_tokens_per_sec",
+                "quant": "quant_generation_engine_tokens_per_sec"}\
         .get(mode, _HEADLINE)
     if mode == "input":
         # the input bench exercises the sharded fit path; on a CPU host
@@ -1233,7 +1532,7 @@ def _run_mode(mode="train", backend=None):
         traceback.print_exc()
         _emit(headline, 0.0,
               {"serving": "requests/sec", "input": "steps/sec",
-               "packing": "tokens/sec",
+               "packing": "tokens/sec", "quant": "tokens/sec",
                "generation": "tokens/sec"}.get(mode, "samples/sec"),
               extra={"error": f"backend init failed: {e}",
                      "last_known_good": _best_prior(headline),
@@ -1321,6 +1620,48 @@ def _run_mode(mode="train", backend=None):
                     f"REGRESSION: {extra['page_pool']['pages_in_use']} KV "
                     f"pages still allocated after every request resolved "
                     f"— the allocator is leaking pages\n")
+        except Exception as e:  # noqa: BLE001
+            traceback.print_exc()
+            _emit(headline, 0.0, "tokens/sec",
+                  extra={"error": str(e)[:300]})
+        return
+
+    if mode == "quant":
+        try:
+            tps, extra = _with_retries(bench_quant)
+            _emit(headline, tps, "tokens/sec", extra=extra)
+            w, a, k = (extra["weight_arm"], extra["artifact_arm"],
+                       extra["kv_arm"])
+            if w["speedup"] < 2.0:
+                sys.stderr.write(
+                    f"REGRESSION: int8-weight generation engine is only "
+                    f"{w['speedup']}x the sequential generate loop — "
+                    f"quantized weights must hold the existing 2x "
+                    f"floor\n")
+            if a["speedup_vs_serial"] < 2.0:
+                sys.stderr.write(
+                    f"REGRESSION: quantized-artifact serving engine is "
+                    f"only {a['speedup_vs_serial']}x the serial "
+                    f"quantized predictor — below the 2x floor\n")
+            if k["admit_ratio"] < 1.9 or k["peak_ratio"] < 1.9:
+                sys.stderr.write(
+                    f"REGRESSION: int8 KV pool admits only "
+                    f"{k['admit_ratio']}x (arithmetic) / "
+                    f"{k['peak_ratio']}x (sampled live peak) the "
+                    f"concurrent sequences of fp32 at equal pool bytes "
+                    f"— below the 1.9x capacity floor\n")
+            if k["tokens_ratio"] < 1.5:
+                sys.stderr.write(
+                    f"REGRESSION: int8-KV engine sustains only "
+                    f"{k['tokens_ratio']}x the aggregate tokens/sec of "
+                    f"the page-starved fp32 engine — below the 1.5x "
+                    f"floor\n")
+            if not (w["ledger_exact"] and k["ledgers_exact"]
+                    and a["one_compile_per_bucket"]):
+                sys.stderr.write(
+                    "REGRESSION: a quantized-mode compile ledger shows "
+                    "more than one trace per (device, bucket/slot-shape) "
+                    "— quantization broke the exactly-once contract\n")
         except Exception as e:  # noqa: BLE001
             traceback.print_exc()
             _emit(headline, 0.0, "tokens/sec",
@@ -1421,7 +1762,7 @@ if __name__ == "__main__":
     import argparse
     ap = argparse.ArgumentParser(description=__doc__)
     ap.add_argument("--mode", choices=("train", "serving", "input",
-                                       "packing", "generation"),
+                                       "packing", "generation", "quant"),
                     default="train",
                     help="train: the round training configs (default); "
                          "serving: multi-lane InferenceEngine qps/latency/"
@@ -1437,7 +1778,13 @@ if __name__ == "__main__":
                          "continuous-batching GenerationEngine vs "
                          "sequential generate — tokens/sec, TTFT/TPOT "
                          "p50/p99, page-pool occupancy, and the "
-                         "one-decode-compile ledger")
+                         "one-decode-compile ledger; quant: quantized "
+                         "serving — int8-weight generation vs sequential "
+                         "(2x floor), fp32/int8/int4 artifact bytes + "
+                         "Predictor parity + quantized-artifact engine "
+                         "qps, and int8-vs-fp32 KV pools at equal HBM "
+                         "bytes (1.9x admits, 1.5x tokens/sec, "
+                         "exactly-once ledgers)")
     ap.add_argument("--backend", default=None,
                     help="pin the jax platform (cpu/tpu/gpu) — same effect "
                          "as JAX_PLATFORMS but works under launchers that "

@@ -74,6 +74,15 @@ register_flag("FLAGS_use_paged_attention", True,
               "[B,H,T,D] buffer and runs the same masked attention as "
               "GPTModel.generate's fixed cache (the CPU/interpret parity "
               "reference, ops/paged_ops.py)")
+register_flag("FLAGS_kv_cache_dtype", "auto",
+              "page dtype of serving.PagedKVCache pools: 'auto' stores "
+              "pages in the served model's dtype; 'int8' enables the "
+              "quantized page mode — int8 pools + per-(layer,head,page) "
+              "fp32 scale pools, quantize-on-append / dequantize-on-"
+              "read (ops/paged_ops.py), ~4x pages per HBM byte so the "
+              "same pool budget admits ~4x the concurrent sequences "
+              "(bench.py --mode quant gates >=1.9x at equal bytes); "
+              "'float32'/'bfloat16' force an unquantized page dtype")
 register_flag("FLAGS_paged_page_size", 16,
               "tokens per KV-cache page (serving.PagedKVCache); the TPU "
               "paged_attention kernel wants a multiple of 8")

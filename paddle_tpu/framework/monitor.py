@@ -15,7 +15,8 @@ import time
 from typing import Dict
 
 __all__ = ["StatValue", "stat_add", "stat_sub", "stat_reset", "stat_get",
-           "stat_set", "all_stats", "stat_time", "STAT_ADD", "STAT_SUB",
+           "stat_set", "stat_gauge_add", "all_stats", "stat_time",
+           "STAT_ADD", "STAT_SUB",
            "STAT_RESET", "StatHistogram", "histogram", "all_histograms",
            "registered_histograms", "reset_all_stats", "drain_deltas",
            "merge_deltas"]
@@ -53,6 +54,16 @@ class StatValue:
         processes is meaningless)."""
         with self._lock:
             self._v = int(v)
+            self.gauge = True
+            return self._v
+
+    def gauge_add(self, n: int) -> int:
+        """Atomically move a gauge LEVEL by a delta (resource-residency
+        gauges: a predictor replica adds its quantized-weight bytes on
+        load and subtracts them on collection). Gauge-marked like set(),
+        so the relay never sums it across processes."""
+        with self._lock:
+            self._v += int(n)
             self.gauge = True
             return self._v
 
@@ -277,6 +288,13 @@ def stat_get(name: str) -> int:
 def stat_set(name: str, v: int) -> int:
     """Set an absolute gauge level (device telemetry samplers)."""
     return _registry.get(name).set(v)
+
+
+def stat_gauge_add(name: str, n: int) -> int:
+    """Atomically add a (possibly negative) delta to a gauge level —
+    for residency gauges whose owners add on construction and subtract
+    on teardown (quantized weights, KV pools)."""
+    return _registry.get(name).gauge_add(n)
 
 
 def drain_deltas():

@@ -12,6 +12,7 @@ from __future__ import annotations
 
 import contextlib
 import os
+import weakref
 from typing import Dict, List, Optional
 
 import numpy as np
@@ -22,6 +23,28 @@ __all__ = ["Config", "create_predictor", "Predictor", "PredictorTensor",
            "as_device", "resolve_devices"]
 
 from .analysis import Analyzer, Argument, compile_subgraph_engine  # noqa: E402
+
+# STAT_quant_weight_hbm_bytes gauges device-resident quantized-weight
+# bytes across LIVE predictor replicas: each replica gauge_add()s its
+# integer tensors on load and subtracts them when it is collected
+# (weakref.finalize — Predictor has no explicit close; CPython refcount
+# collection makes this prompt in practice), so the gauge tracks actual
+# residency instead of growing monotonically across engine restarts.
+def _note_quant_bytes(delta: int) -> None:
+    from ..framework import monitor
+    monitor.stat_gauge_add("STAT_quant_weight_hbm_bytes", delta)
+
+
+def _same_buffer(a, b) -> bool:
+    """Do two jax Arrays share one device buffer? (device_put onto the
+    buffer's current device aliases instead of copying — distinct Array
+    objects, same memory.)"""
+    if a is b:
+        return True
+    try:
+        return a.unsafe_buffer_pointer() == b.unsafe_buffer_pointer()
+    except Exception:  # backends without buffer introspection
+        return False
 
 
 class Config:
@@ -192,7 +215,10 @@ class Predictor:
             raise ValueError("Config has no model path")
         try:
             self._translated = jit.load(config.model_path)
-            nin = len(self._translated._exported.in_avals)
+            self._quant = self._translated._quant
+            self._qargs = self._load_quant_args()
+            nin = len(self._translated._exported.in_avals) \
+                - len(self._qargs)
             self._input_names = [f"input_{i}" for i in range(nin)]
         except Exception as stablehlo_err:
             # not our StableHLO artifact — try the reference ProgramDesc
@@ -215,6 +241,8 @@ class Predictor:
                     f"reference ProgramDesc ({legacy_err!r})"
                 ) from legacy_err
             self._translated = None
+            self._quant = None
+            self._qargs = []
             self._input_names = list(self._legacy.feed_names)
         self._inputs: Dict[str, PredictorTensor] = {}
         self._outputs: List[PredictorTensor] = []
@@ -236,6 +264,52 @@ class Predictor:
         so fed host arrays land — and the executable compiles — there."""
         return self._device
 
+    # -- quantized artifacts ----------------------------------------------
+
+    def _load_quant_args(self):
+        """Device-resident integer weights for a quantized artifact: the
+        .pdmeta manifest names the int8/packed-int4 tensors + scales the
+        export expects as leading runtime arguments. They are uploaded
+        ONCE per replica (to this predictor's device) and stay in
+        integer form in HBM — the dequant is inside the compiled call,
+        fused into the matmul, so no fp32 copy of any quantized weight
+        ever materializes host- or device-side."""
+        if not self._quant:
+            return []
+        import jax
+        from ..framework import monitor
+        qargs = [jax.device_put(v, self._device)
+                 for v in self._translated._qargs]
+        monitor.stat_add("STAT_quant_weights_loaded",
+                         len(self._quant["entries"]))
+        # gauge only buffers this replica's device_put actually CREATED:
+        # a put onto the buffer's current device aliases it (same
+        # underlying buffer, no new HBM), so a same-device replica adds
+        # 0 and a cross-device replica adds its full copy — the base
+        # materialization itself is accounted once by TranslatedLayer
+        total = sum(int(a.nbytes) for a, v in
+                    zip(qargs, self._translated._qargs)
+                    if not _same_buffer(a, v))
+        if total:
+            _note_quant_bytes(total)
+            # LIVE residency: subtract when this replica is collected
+            # (its device buffers go with it)
+            weakref.finalize(self, _note_quant_bytes, -total)
+        return qargs
+
+    def quant_info(self) -> Optional[dict]:
+        """None for fp artifacts; else {bits histogram, device-resident
+        integer bytes, tensor count} — surfaced by engine.stats()."""
+        if not self._quant:
+            return None
+        bits = {}
+        for e in self._quant["entries"]:
+            bits[str(e["bits"])] = bits.get(str(e["bits"]), 0) + 1
+        return {"weight_tensors": len(self._quant["entries"]),
+                "bits": bits,
+                "resident_bytes": sum(int(a.nbytes)
+                                      for a in self._qargs)}
+
     def clone_for_device(self, device) -> "Predictor":
         """Replica on another device sharing the already-deserialized
         artifact (no disk re-load) but with its OWN cached jit wrapper,
@@ -252,6 +326,9 @@ class Predictor:
         p._jit_call = None
         p._jit_lock = threading.Lock()
         p.compile_count = 0
+        # integer weights are per-device state: each replica uploads its
+        # own copy to its chip (same int8/int4 bytes, new residence)
+        p._qargs = p._load_quant_args()
         return p
 
     def get_input_names(self):
@@ -273,8 +350,11 @@ class Predictor:
             sig = [(n, None, None) for n in self._input_names]
         else:
             sig = []
-            for n, aval in zip(self._input_names,
-                               self._translated._exported.in_avals):
+            # a quantized artifact's leading avals are its integer
+            # weights + scales (fed by the predictor, not the caller)
+            user_avals = self._translated._exported.in_avals[
+                len(self._qargs):]
+            for n, aval in zip(self._input_names, user_avals):
                 dims = tuple(d if isinstance(d, int) else None
                              for d in aval.shape)
                 sig.append((n, dims, np.dtype(aval.dtype)))
@@ -345,7 +425,11 @@ class Predictor:
             if self._legacy is not None:
                 out = self._legacy.run(dict(zip(self._input_names, arrays)))
             else:
-                out = self._get_jit_call()(*arrays)
+                # quantized artifacts: the device-resident integer
+                # weights ride every dispatch as leading jit ARGUMENTS —
+                # being runtime inputs (not baked constants) is what
+                # stops XLA from dequant-folding them to fp32 in HBM
+                out = self._get_jit_call()(*self._qargs, *arrays)
         return jax.tree_util.tree_leaves(out)
 
     def run(self, inputs: Optional[List[np.ndarray]] = None):

@@ -242,9 +242,43 @@ def _spec_to_sds(spec, scope=None, idx=0):
     return spec
 
 
+def _collect_quant(layer, bv):
+    """Quant manifest for jit.save: every sublayer exposing
+    `quant_weight_spec()` (quantization.WeightOnlyLinear) contributes its
+    quantized-weight and scale buffer names. These tensors are exported
+    as leading runtime ARGUMENTS of the StableHLO artifact instead of
+    baked closure constants: a baked int8 constant is legal StableHLO,
+    but XLA's compile-time constant folding would dequantize
+    `convert(q) * scale` into a resident fp32 weight — as an argument
+    the weight stays integer in HBM and the dequant fuses into the
+    matmul at run time. Tied layers appear once (named_sublayers dedups
+    by id, the same traversal named_buffers uses)."""
+    args, entries = [], []
+    for pfx, sub in layer.named_sublayers(include_self=True):
+        spec = getattr(sub, "quant_weight_spec", None)
+        if spec is None:
+            continue
+        for qattr, sattr, bits in spec():
+            qname = f"{pfx}.{qattr}" if pfx else qattr
+            sname = f"{pfx}.{sattr}" if pfx else sattr
+            if qname not in bv or sname not in bv:
+                continue  # tied layer already collected under its
+                # first traversal name
+            args += [qname, sname]
+            entries.append({"name": qname, "scale": sname,
+                            "bits": int(bits)})
+    return {"version": 1, "args": args, "entries": entries} \
+        if entries else None
+
+
 def save(layer, path, input_spec=None, **configs):
     """reference `jit.py:507` — writes {path}.pdmodel (StableHLO export),
-    {path}.pdiparams (weights), {path}.pdmeta (structure)."""
+    {path}.pdiparams (weights), {path}.pdmeta (structure + quant
+    manifest). Weight-only-quantized sublayers export their int8/packed
+    int4 tensors + scales as leading runtime arguments (see
+    _collect_quant); inference.Predictor reads the manifest and feeds
+    them device-resident, so the serving artifact is genuinely
+    integer-weighted end to end."""
     from ..framework.functional import functionalize
     from ..nn.layer.layers import Layer
 
@@ -258,9 +292,27 @@ def save(layer, path, input_spec=None, **configs):
             raise ValueError("jit.save requires input_spec")
         rng = jax.random.PRNGKey(0)
 
-        def infer(*xs):
-            out, _ = apply_fn(pv, bv, rng, False, *xs)
-            return out
+        quant = _collect_quant(layer, bv)
+        if quant is None:
+            def infer(*xs):
+                out, _ = apply_fn(pv, bv, rng, False, *xs)
+                return out
+            q_sds = []
+        else:
+            from ..framework import monitor
+            monitor.stat_add("STAT_quant_exports")
+            qnames = quant["args"]
+            bv_rest = {k: v for k, v in bv.items() if k not in set(qnames)}
+            q_sds = [jax.ShapeDtypeStruct(bv[n].shape, bv[n].dtype)
+                     for n in qnames]
+
+            def infer(*all_args):
+                qvals = all_args[:len(qnames)]
+                xs = all_args[len(qnames):]
+                bv2 = dict(bv_rest)
+                bv2.update(zip(qnames, qvals))
+                out, _ = apply_fn(pv, bv2, rng, False, *xs)
+                return out
 
         from ..static.input_spec import InputSpec
         dynamic = any(isinstance(s, InputSpec)
@@ -276,7 +328,7 @@ def save(layer, path, input_spec=None, **configs):
                 scope = jax.export.SymbolicScope()
                 sds = [_spec_to_sds(s, scope=scope, idx=i)
                        for i, s in enumerate(input_spec)]
-                exported = jax.export.export(jax.jit(infer))(*sds)
+                exported = jax.export.export(jax.jit(infer))(*q_sds, *sds)
             except Exception as sym_err:  # noqa: BLE001
                 import warnings
                 warnings.warn(
@@ -286,7 +338,7 @@ def save(layer, path, input_spec=None, **configs):
                 exported = None
         if exported is None:
             sds = [_spec_to_sds(s) for s in input_spec]
-            exported = jax.export.export(jax.jit(infer))(*sds)
+            exported = jax.export.export(jax.jit(infer))(*q_sds, *sds)
         with open(path + ".pdmodel", "wb") as f:
             f.write(exported.serialize())
         state = {n: np.asarray(v.numpy()) for n, v in
@@ -296,6 +348,8 @@ def save(layer, path, input_spec=None, **configs):
         meta = {"input_specs": [
             (tuple(d if isinstance(d, int) else str(d) for d in s.shape),
              str(s.dtype)) for s in sds]}
+        if quant is not None:
+            meta["quant"] = quant
         with open(path + ".pdmeta", "wb") as f:
             pickle.dump(meta, f, protocol=4)
         return
@@ -303,17 +357,40 @@ def save(layer, path, input_spec=None, **configs):
 
 
 class TranslatedLayer:
-    """reference `jit.py:787` TranslatedLayer — runs a saved program."""
+    """reference `jit.py:787` TranslatedLayer — runs a saved program.
+    Quantized artifacts (a "quant" manifest in .pdmeta) expect their
+    int8/int4 weight + scale tensors as leading call arguments; the
+    layer keeps them device-resident in integer form and prepends them
+    on every call (the dequant happens inside the compiled program)."""
 
-    def __init__(self, exported, state):
+    def __init__(self, exported, state, quant=None):
         self._exported = exported
         self._state = state
+        self._quant = quant
+        if quant:
+            missing = [n for n in quant["args"] if n not in state]
+            if missing:
+                raise ValueError(
+                    f"quantized artifact is missing weight tensors "
+                    f"{missing} in its params file")
+            self._qargs = [jnp.asarray(state[n]) for n in quant["args"]]
+            # this base materialization IS device memory: account it
+            # once here; Predictor replicas then count only buffers
+            # their device_put actually created (same-device puts alias
+            # the base buffer — see Predictor._load_quant_args)
+            import weakref
+            from ..inference import _note_quant_bytes
+            total = sum(int(a.nbytes) for a in self._qargs)
+            _note_quant_bytes(total)
+            weakref.finalize(self, _note_quant_bytes, -total)
+        else:
+            self._qargs = []
         self.training = False
 
     def __call__(self, *args):
         arrays = [a._value if isinstance(a, Tensor) else jnp.asarray(a)
                   for a in args]
-        out = self._exported.call(*arrays)
+        out = self._exported.call(*self._qargs, *arrays)
         return jax.tree_util.tree_map(lambda x: Tensor(x), out)
 
     forward = __call__
@@ -326,6 +403,14 @@ class TranslatedLayer:
         return {k: Tensor(jnp.asarray(v)) for k, v in self._state.items()}
 
 
+def load_meta(path) -> dict:
+    """The .pdmeta sidecar ({} when absent — pre-manifest artifacts)."""
+    if not os.path.exists(path + ".pdmeta"):
+        return {}
+    with open(path + ".pdmeta", "rb") as f:
+        return pickle.load(f)
+
+
 def load(path, **configs):
     with open(path + ".pdmodel", "rb") as f:
         exported = jax.export.deserialize(bytearray(f.read()))
@@ -333,4 +418,5 @@ def load(path, **configs):
     if os.path.exists(path + ".pdiparams"):
         with open(path + ".pdiparams", "rb") as f:
             state = pickle.load(f)
-    return TranslatedLayer(exported, state)
+    return TranslatedLayer(exported, state,
+                           quant=load_meta(path).get("quant"))

@@ -36,6 +36,19 @@ def _gen_ln(x, w, b):
     return (x - m) / jnp.sqrt(v + 1e-5) * w + b
 
 
+def _gen_w(w, dtype):
+    """Resolve one decode-weight leaf: a raw array passes through; a
+    weight-only-quantized leaf `(q_int8 [in,out], scale [out])` —
+    produced by decode_weights() for quantization.WeightOnlyLinear
+    projections — dequantizes HERE, inside the traced math, so the
+    HBM-resident form stays int8 and XLA fuses convert+mul into the
+    consuming matmul (the fp32 weight is a fused temporary only)."""
+    if isinstance(w, tuple):
+        q, s = w
+        return q.astype(dtype) * s.astype(dtype)
+    return w
+
+
 def gpt_logits(W, h):
     """Final LN + tied LM head over hidden states `h` [..., E]."""
     lnfw, lnfb = W["lnf"]
@@ -65,9 +78,9 @@ def gpt_prefill(W, ids, *, num_heads, scale):
 
         def heads(t):
             return t.reshape(B, S, H, D).transpose(0, 2, 1, 3)
-        q = heads(x @ wq + bq)
-        k = heads(x @ wk + bk)
-        v = heads(x @ wv + bv)
+        q = heads(x @ _gen_w(wq, x.dtype) + bq)
+        k = heads(x @ _gen_w(wk, x.dtype) + bk)
+        v = heads(x @ _gen_w(wv, x.dtype) + bv)
         ks.append(k)
         vs.append(v)
         s = jnp.einsum("bhqd,bhkd->bhqk", q, k) * scale
@@ -76,9 +89,10 @@ def gpt_prefill(W, ids, *, num_heads, scale):
         p = jax.nn.softmax(s, axis=-1)
         o = jnp.einsum("bhqk,bhkd->bhqd", p, v)
         o = o.transpose(0, 2, 1, 3).reshape(B, S, E)
-        h = h + (o @ wo + bo)
+        h = h + (o @ _gen_w(wo, h.dtype) + bo)
         x2 = _gen_ln(h, l2w, l2b)
-        h = h + (jax.nn.gelu(x2 @ w1 + b1, approximate=False) @ w2 + b2)
+        h = h + (jax.nn.gelu(x2 @ _gen_w(w1, h.dtype) + b1,
+                             approximate=False) @ _gen_w(w2, h.dtype) + b2)
     return h, jnp.stack(ks), jnp.stack(vs)
 
 
@@ -104,14 +118,15 @@ def gpt_decode_step(W, tok, pos, cache, write_kv, attend, *, num_heads,
     for i, (l1w, l1b, wq, bq, wk, bk, wv, bv, wo, bo, l2w, l2b,
             w1, b1, w2, b2) in enumerate(W["blocks"]):
         x = _gen_ln(h, l1w, l1b)
-        q = (x @ wq + bq).reshape(B, H, D)
-        k = (x @ wk + bk).reshape(B, H, D)
-        v = (x @ wv + bv).reshape(B, H, D)
+        q = (x @ _gen_w(wq, x.dtype) + bq).reshape(B, H, D)
+        k = (x @ _gen_w(wk, x.dtype) + bk).reshape(B, H, D)
+        v = (x @ _gen_w(wv, x.dtype) + bv).reshape(B, H, D)
         cache = write_kv(cache, i, k, v, pos)
         o = attend(cache, i, q, pos).reshape(B, E)
-        h = h + (o @ wo + bo)
+        h = h + (o @ _gen_w(wo, h.dtype) + bo)
         x2 = _gen_ln(h, l2w, l2b)
-        h = h + (jax.nn.gelu(x2 @ w1 + b1, approximate=False) @ w2 + b2)
+        h = h + (jax.nn.gelu(x2 @ _gen_w(w1, h.dtype) + b1,
+                             approximate=False) @ _gen_w(w2, h.dtype) + b2)
     return gpt_logits(W, h), cache
 
 
@@ -351,23 +366,33 @@ class GPTForCausalLM(nn.Layer):
         """The decode-math weight pytree shared by `generate()` and
         `serving.GenerationEngine`: raw jnp leaves (value-fresh after
         training steps — they ride jitted programs as ARGUMENTS, never
-        baked constants)."""
+        baked constants). A projection replaced by
+        `quantization.WeightOnlyLinear` (quantize_weights) contributes a
+        `(q_int8, scale)` leaf instead of a float array — the integer
+        tensor is what rides HBM; `_gen_w` dequantizes inside the traced
+        matmul (int4 layers unpack once to int8 here, still 4x smaller
+        than fp32)."""
         gpt = self.gpt
         if gpt.config.use_moe:
             raise NotImplementedError("generate() with MoE blocks")
+
+        def w(lin):
+            leaf = getattr(lin, "quant_decode_leaf", None)
+            return leaf() if leaf is not None else lin.weight._value
+
         return {
             "wte": gpt.wte.weight._value, "wpe": gpt.wpe.weight._value,
             "lnf": (gpt.ln_f.weight._value, gpt.ln_f.bias._value),
             "blocks": [(
                 blk.ln1.weight._value, blk.ln1.bias._value,
-                blk.attn.q_proj.weight._value, blk.attn.q_proj.bias._value,
-                blk.attn.k_proj.weight._value, blk.attn.k_proj.bias._value,
-                blk.attn.v_proj.weight._value, blk.attn.v_proj.bias._value,
-                blk.attn.out_proj.weight._value,
+                w(blk.attn.q_proj), blk.attn.q_proj.bias._value,
+                w(blk.attn.k_proj), blk.attn.k_proj.bias._value,
+                w(blk.attn.v_proj), blk.attn.v_proj.bias._value,
+                w(blk.attn.out_proj),
                 blk.attn.out_proj.bias._value,
                 blk.ln2.weight._value, blk.ln2.bias._value,
-                blk.mlp[0].weight._value, blk.mlp[0].bias._value,
-                blk.mlp[2].weight._value, blk.mlp[2].bias._value)
+                w(blk.mlp[0]), blk.mlp[0].bias._value,
+                w(blk.mlp[2]), blk.mlp[2].bias._value)
                 for blk in gpt.blocks],
         }
 

@@ -1,4 +1,4 @@
-from . import functional, initializer, utils
+from . import functional, initializer, quant, utils
 from .layer.activation import *  # noqa: F401,F403
 from .layer.common import *  # noqa: F401,F403
 from .layer.container import *  # noqa: F401,F403

@@ -1,0 +1,129 @@
+"""Weight-only quantization primitives (reference direction:
+`paddle.nn.quant.weight_quantize` / `weight_only_linear` — the v2.0 slim
+toolchain stops at fake-quant, later versions grew the weight-only API).
+
+TPU rationale: serving is HBM-capacity/bandwidth bound, not int-math
+bound. Weights store as int8 (4x smaller) or packed int4 (8x smaller,
+two nibbles per int8 byte) with per-output-channel fp32 scales, stay
+integer in HBM, and dequantize inside the jitted matmul —
+`dequant(q) @ x` is a convert+mul XLA fuses into the MXU epilogue, so
+the fp32 weight exists only as a fused temporary, never as a resident
+buffer. All quantization math is symmetric abs-max:
+
+    scale[o] = max(|W[:, o]|) / qmax        (qmax: 127 int8, 7 int4)
+    q        = clip(round(W / scale), -qmax, qmax)
+    W'       = q * scale
+
+int4 packing is two-nibbles-per-int8 along the OUTPUT axis: output
+channels 2j (low nibble) and 2j+1 (high nibble) share a byte; an odd
+channel count pads one zero column that unpacking slices back off.
+Nibbles are sign-extended on unpack with int8 arithmetic shifts
+(`(b << 4) >> 4` / `b >> 4`), which jit cleanly — the packed tensor
+rides the compiled program as an int8 argument.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+__all__ = ["weight_quantize", "weight_dequantize", "weight_only_linear",
+           "quant_bits", "pack_int4", "unpack_int4"]
+
+_ALGOS = {"weight_only_int8": 8, "weight_only_int4": 4}
+
+
+def quant_bits(algo: str) -> int:
+    if algo not in _ALGOS:
+        raise ValueError(f"unknown weight-quant algo {algo!r}; expected "
+                         f"one of {sorted(_ALGOS)}")
+    return _ALGOS[algo]
+
+
+def _as_np(x) -> np.ndarray:
+    from ..framework.tensor import Tensor
+    if isinstance(x, Tensor):
+        x = x._value
+    return np.asarray(x)
+
+
+def pack_int4(q: np.ndarray) -> np.ndarray:
+    """Pack int4 values (int8 storage, range [-7, 7]) two per byte along
+    the last axis: column 2j -> low nibble, 2j+1 -> high nibble. An odd
+    column count gets one zero pad column."""
+    q = np.asarray(q)
+    if q.shape[-1] % 2:
+        pad = [(0, 0)] * (q.ndim - 1) + [(0, 1)]
+        q = np.pad(q, pad)
+    lo = q[..., 0::2].astype(np.uint8) & 0x0F
+    hi = q[..., 1::2].astype(np.uint8) & 0x0F
+    return np.ascontiguousarray((hi << 4) | lo).view(np.int8)
+
+
+def unpack_int4(packed, out_features: int):
+    """Sign-extend packed nibbles back to int8 values in [-8, 7] and
+    slice off the odd-count pad column. jnp-traceable (the serving
+    dequant path runs this inside the compiled program); also accepts
+    numpy."""
+    import jax.numpy as jnp
+    p = jnp.asarray(packed, jnp.int8)
+    lo = jnp.right_shift(jnp.left_shift(p, 4), 4)   # arithmetic: signed
+    hi = jnp.right_shift(p, 4)
+    q = jnp.stack([lo, hi], axis=-1).reshape(*p.shape[:-1], -1)
+    return q[..., :int(out_features)]
+
+
+def weight_quantize(w, algo: str = "weight_only_int8"):
+    """Symmetric abs-max per-output-channel weight quantization.
+
+    w: [in_features, out_features] (any array-like / Tensor). Returns
+    (q, scale) numpy arrays: int8 `q` is [in, out] for int8 or packed
+    [in, ceil(out/2)] for int4; `scale` is fp32 [out]."""
+    bits = quant_bits(algo)
+    w = _as_np(w).astype(np.float32)
+    if w.ndim != 2:
+        raise ValueError(f"weight_quantize expects a 2-D [in, out] "
+                         f"weight, got shape {tuple(w.shape)}")
+    qmax = float(2 ** (bits - 1) - 1)
+    scale = (np.maximum(np.abs(w).max(axis=0), 1e-8) / qmax).astype(
+        np.float32)
+    q = np.clip(np.round(w / scale), -qmax, qmax).astype(np.int8)
+    if bits == 4:
+        q = pack_int4(q)
+    return q, scale
+
+
+def weight_dequantize(q, scale, algo: str = "weight_only_int8",
+                      out_dtype="float32"):
+    """Inverse of weight_quantize: [in, out] floating weight. jnp-
+    traceable — this is the expression the jitted matmuls fuse."""
+    import jax.numpy as jnp
+    bits = quant_bits(algo)
+    scale = jnp.asarray(scale)
+    q = jnp.asarray(q)
+    if bits == 4:
+        q = unpack_int4(q, scale.shape[-1])
+    return q.astype(out_dtype) * scale.astype(out_dtype)
+
+
+def weight_only_linear(x, weight, weight_scale, bias=None,
+                       weight_dtype: str = "int8"):
+    """y = x @ dequant(weight) (+ bias) with the dequant staying inside
+    the traced computation (int8/int4 weight remains the HBM-resident
+    form; XLA fuses convert+mul into the matmul). Tensor in, Tensor
+    out — the functional core of quantization.WeightOnlyLinear."""
+    from ..framework.tensor import apply_op
+    algo = {"int8": "weight_only_int8",
+            "int4": "weight_only_int4"}.get(weight_dtype)
+    if algo is None:
+        raise ValueError(f"weight_dtype must be 'int8' or 'int4', got "
+                         f"{weight_dtype!r}")
+
+    def impl(v, q, s, *b):
+        w = weight_dequantize(q, s, algo, out_dtype=v.dtype)
+        out = v @ w
+        if b:
+            out = out + b[0]
+        return out
+
+    args = (x, weight, weight_scale) + \
+        ((bias,) if bias is not None else ())
+    return apply_op("weight_only_linear", impl, args, {})

@@ -38,7 +38,8 @@ from ..framework import monitor
 from ..framework.flags import flag
 
 __all__ = ["cached_attention", "paged_attention", "paged_gather",
-           "paged_write", "page_rows_for_positions"]
+           "paged_gather_quantized", "paged_write",
+           "paged_write_quantized", "page_rows_for_positions"]
 
 
 def cached_attention(q, kb, vb, pos, scale):
@@ -93,6 +94,85 @@ def paged_write(pages, layer, page_ids, offsets, values):
     return pages.at[layer, :, page_ids, offsets, :].set(values)
 
 
+# -- int8 page mode ---------------------------------------------------------
+#
+# FLAGS_kv_cache_dtype=int8: pools store int8 with a parallel
+# per-(layer, head, page) fp32 scale pool (symmetric abs-max; dequant =
+# q * scale). Writes QUANTIZE on append; reads dequantize on gather. The
+# quantization grid is per page: when a newly appended token's abs-max
+# exceeds the page's current scale, the page's existing int8 content is
+# REQUANTIZED onto the wider grid (round(q * old/new)) — shape-static,
+# touches only the [P, D] page being appended to, and bounds the
+# round-off to one extra rounding per scale growth. Scale 0 marks an
+# empty page (zero-on-free resets both pools), so freed pages never leak
+# a stale grid to their next owner.
+
+
+def _q8(v, s):
+    """Symmetric int8 quantization of `v` against per-slice scales `s`
+    (broadcastable); s == 0 (empty/all-zero) maps to 0."""
+    q = jnp.where(s > 0, v / jnp.where(s > 0, s, 1.0), 0.0)
+    return jnp.clip(jnp.round(q), -127, 127).astype(jnp.int8)
+
+
+def paged_gather_quantized(pages, scales, page_table, dtype=jnp.float32):
+    """Dequantizing gather: int8 pages [H, N, P, D] + scales [H, N] →
+    dense floating [B, H, PP*P, D] (only THIS batch's pages are ever
+    materialized in floating form — the pools stay int8 in HBM)."""
+    monitor.stat_add("STAT_kv_quant_reads")  # traces, not calls
+    H, _, P, D = pages.shape
+    B, PP = page_table.shape
+    kb = jnp.take(pages, page_table, axis=1)        # [H, B, PP, P, D]
+    sc = jnp.take(scales, page_table, axis=1)       # [H, B, PP]
+    kb = kb.astype(dtype) * sc[..., None, None].astype(dtype)
+    return jnp.moveaxis(kb, 1, 0).reshape(B, H, PP * P, D)
+
+
+def paged_write_quantized(pages, scales, layer, page_ids, offsets, values):
+    """Quantize-on-append into int8 pools; returns (pages, scales).
+
+    Decode (`layer` an int): page_ids/offsets [B], values [B, H, D] —
+    gathers each row's single page, grows its scale to cover the new
+    token (requantizing existing content when it does), writes the
+    quantized token. Duplicate page ids (inactive slots parked on the
+    trash page) scatter last-writer-wins, which is fine for the same
+    reason the fp32 path tolerates it: trash content is masked junk.
+
+    Prefill (`layer=None`): page_ids/offsets [S], values [L, H, S, D] —
+    scatter-max builds each target page's scale over every token landing
+    in it, then all tokens quantize against their page's final scale.
+    Assumes the target pages are freshly zeroed (scale 0) — exactly what
+    zero-on-free guarantees for an alloc; the trash page (padded prefill
+    tails) accumulates junk between frees, which dequantizes finite and
+    is masked out, same as the fp32 contract."""
+    monitor.stat_add("STAT_kv_quant_writes")  # traces, not calls
+    if layer is None:
+        a = jnp.max(jnp.abs(values), axis=-1) / 127.0        # [L, H, S]
+        scales = scales.at[:, :, page_ids].max(a)            # dup-safe
+        s_tok = scales[:, :, page_ids]                       # [L, H, S]
+        q = _q8(values, s_tok[..., None])
+        return pages.at[:, :, page_ids, offsets, :].set(q), scales
+    B = page_ids.shape[0]
+    fdt = values.dtype
+    a = jnp.max(jnp.abs(values), axis=-1) / 127.0            # [B, H]
+    s_old = scales[layer][:, page_ids]                       # [H, B]
+    s_new = jnp.maximum(s_old, a.T)                          # [H, B]
+    pk = pages[layer][:, page_ids]                           # [H, B, P, D]
+    ratio = jnp.where(s_new > 0,
+                      s_old / jnp.where(s_new > 0, s_new, 1.0), 1.0)
+    pk = jnp.round(pk.astype(fdt) * ratio[..., None, None]) \
+        .astype(jnp.int8)
+    q = _q8(values, jnp.moveaxis(s_new, 1, 0)[..., None])    # [B, H, D]
+    pk = pk.at[:, jnp.arange(B), offsets, :].set(jnp.moveaxis(q, 0, 1))
+    # scatter target: the scalar layer index joins the advanced block,
+    # which is then non-contiguous, so the batch dim lands in FRONT
+    # (same subtlety as paged_write's docstring) — move it there
+    pages = pages.at[layer, :, page_ids, :, :].set(
+        jnp.moveaxis(pk, 1, 0))                              # [B, H, P, D]
+    scales = scales.at[layer, :, page_ids].set(s_new.T)      # [B, H]
+    return pages, scales
+
+
 def _use_kernel() -> bool:
     if not bool(flag("FLAGS_use_paged_attention")):
         return False
@@ -102,7 +182,8 @@ def _use_kernel() -> bool:
         return False
 
 
-def paged_attention(q, k_pages, v_pages, page_table, pos, scale):
+def paged_attention(q, k_pages, v_pages, page_table, pos, scale,
+                    k_scales=None, v_scales=None):
     """One decode position of attention over a paged KV cache.
 
     q [B, H, D]; k_pages/v_pages [H, N, P, D] (ONE layer's pool);
@@ -111,7 +192,18 @@ def paged_attention(q, k_pages, v_pages, page_table, pos, scale):
 
     TPU dispatches the Pallas kernel (pages read in place); everywhere
     else the reference gathers to dense and reuses `cached_attention` —
-    the generate-anchored math."""
+    the generate-anchored math.
+
+    int8 pools pass k_scales/v_scales ([H, N] per-page scales): the
+    Pallas kernel has no int8+scale-pool input layout, so quantized
+    reads always take the dequantizing gather + dense reference (the
+    gather materializes only this batch's pages in floating form; the
+    pools stay int8 in HBM — on TPU and CPU alike)."""
+    if k_scales is not None:
+        monitor.stat_add("STAT_paged_attn_reference")  # traces, not calls
+        kb = paged_gather_quantized(k_pages, k_scales, page_table, q.dtype)
+        vb = paged_gather_quantized(v_pages, v_scales, page_table, q.dtype)
+        return cached_attention(q, kb, vb, pos, scale)
     if _use_kernel():
         monitor.stat_add("STAT_paged_attn_kernel")  # traces, not calls
         from jax.experimental.pallas.ops.tpu.paged_attention import (

@@ -52,7 +52,8 @@ _PREFIX = "paddle_tpu_"
 # up-down stats: current level, not a monotone total → Prometheus gauge
 _GAUGES = {"STAT_serving_queue_depth", "STAT_train_step_flops",
            "STAT_train_mfu_bp", "STAT_kv_pages_inuse",
-           "STAT_gen_queue_depth"}
+           "STAT_gen_queue_depth", "STAT_kv_cache_hbm_bytes",
+           "STAT_quant_weight_hbm_bytes"}
 # device-telemetry levels set via stat_set (per-device ids vary)
 _GAUGE_SUFFIXES = ("_hbm_bytes_in_use", "_hbm_bytes_limit")
 

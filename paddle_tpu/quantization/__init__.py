@@ -103,56 +103,85 @@ class PTQ:
 
 
 class WeightOnlyLinear(nn.Layer):
-    """Weight-only int8 linear (reference direction:
+    """Weight-only int8/int4 linear (reference direction:
     `paddle.nn.quant.weight_only_linear` in later versions; the v2.0
     slim toolchain stops at fake-quant).
 
     TPU rationale: serving memory/HBM-bandwidth is the bottleneck, not
-    int8 math — weights store as int8 + per-output-channel fp scales
-    (4x smaller, 4x less HBM traffic on the weight stream) and
-    dequantize into the matmul's bf16/fp32 epilogue, which XLA fuses."""
+    int math — weights store as int8 (4x smaller) or packed int4 (8x,
+    two nibbles per byte — nn/quant.py) + per-output-channel fp scales
+    and dequantize into the matmul's bf16/fp32 epilogue, which XLA
+    fuses; the integer tensor is the only HBM-resident form. The
+    quantized buffers are what `jit.save` exports (as runtime ARGUMENTS
+    of the StableHLO artifact, never baked constants XLA could
+    dequant-fold back to fp32 — see jit/__init__.py)."""
 
-    def __init__(self, inner: "nn.Linear"):
+    def __init__(self, inner: "nn.Linear", bits: int = 8):
         super().__init__()
-        import numpy as np
+        from ..nn import quant as nn_quant
 
-        w = np.asarray(inner.weight._value, np.float32)   # [in, out]
-        scale = np.maximum(np.abs(w).max(axis=0), 1e-8) / 127.0
-        q = np.clip(np.round(w / scale), -127, 127).astype(np.int8)
-        self.register_buffer("weight_int8", Tensor(jnp.asarray(q)))
+        if bits not in (8, 4):
+            raise ValueError(f"WeightOnlyLinear supports bits=8 or 4, "
+                             f"got {bits}")
+        self.weight_bits = bits
+        algo = f"weight_only_int{bits}"
+        q, scale = nn_quant.weight_quantize(inner.weight, algo)
+        self.register_buffer(self._qname, Tensor(jnp.asarray(q)))
         self.register_buffer("weight_scale",
                              Tensor(jnp.asarray(scale, jnp.float32)))
         self.bias = inner.bias
         self._out_features = inner._out_features
 
+    @property
+    def _qname(self) -> str:
+        return "weight_int8" if self.weight_bits == 8 else "weight_int4"
+
+    @property
+    def quant_weight(self) -> Tensor:
+        return getattr(self, self._qname)
+
+    def quant_weight_spec(self):
+        """jit.save manifest hook: (quant buffer attr, scale attr, bits)
+        — any layer exposing this has its quantized tensors exported as
+        integer runtime arguments of the serving artifact."""
+        return [(self._qname, "weight_scale", self.weight_bits)]
+
+    def quant_decode_leaf(self):
+        """(q_int8 [in, out], scale [out]) for the generation engine's
+        decode-weight pytree (models/gpt.py): int4 unpacks ONCE to int8
+        values here (still 4x smaller than fp32 in HBM), so the jitted
+        decode math has a single integer dequant form."""
+        from ..nn import quant as nn_quant
+        q = self.quant_weight._value
+        s = self.weight_scale._value
+        if self.weight_bits == 4:
+            q = nn_quant.unpack_int4(q, s.shape[-1])
+        return (q, s)
+
     def forward(self, x):
-        def impl(v, q, s, *b):
-            w = q.astype(v.dtype) * s.astype(v.dtype)
-            out = v @ w
-            if b:
-                out = out + b[0]
-            return out
-        args = (x, self.weight_int8, self.weight_scale) + \
-            ((self.bias,) if self.bias is not None else ())
-        return apply_op("weight_only_linear", impl, args, {})
+        from ..nn.quant import weight_only_linear
+        return weight_only_linear(
+            x, self.quant_weight, self.weight_scale, self.bias,
+            weight_dtype="int8" if self.weight_bits == 8 else "int4")
 
 
 def quantize_weights(model: nn.Layer, bits: int = 8,
                      _seen=None) -> nn.Layer:
     """Swap every nn.Linear for WeightOnlyLinear in place (weight-only
-    PTQ; int8 is the only width the int8 storage path supports). A
-    Linear shared by several parents (tied heads) is quantized ONCE and
-    the single replacement is re-linked everywhere, preserving tying;
-    fake-quant wrappers (QuantizedLinear/Conv2D) are left intact."""
-    if bits != 8:
+    PTQ; bits=8 stores int8, bits=4 stores packed two-nibbles-per-byte
+    int4). A Linear shared by several parents (tied heads) is quantized
+    ONCE and the single replacement is re-linked everywhere, preserving
+    tying; fake-quant wrappers (QuantizedLinear/Conv2D) are left
+    intact."""
+    if bits not in (8, 4):
         raise NotImplementedError("weight-only quantization supports "
-                                  "bits=8")
+                                  "bits=8 or bits=4")
     seen = _seen if _seen is not None else {}
     for name, sub in list(model._sub_layers.items()):
         if isinstance(sub, nn.Linear):
             rep = seen.get(id(sub))
             if rep is None:
-                rep = seen[id(sub)] = WeightOnlyLinear(sub)
+                rep = seen[id(sub)] = WeightOnlyLinear(sub, bits=bits)
             model._sub_layers[name] = rep
         elif isinstance(sub, (QuantizedLinear, QuantizedConv2D)):
             continue   # fake-quant wrappers own their inner Linear

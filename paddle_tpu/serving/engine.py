@@ -1033,9 +1033,19 @@ class InferenceEngine:
                 snap["bucket_compiles"] = dict(l.bucket_compiles)
         slots = sum(b * s["batches"] for b, s in buckets.items())
         served = sum(s["rows"] for s in buckets.values())
+        # weight-only-quantized artifact? (Predictor read the .pdmeta
+        # manifest and keeps int8/int4 weights device-resident) — the
+        # capacity-planning signal next to the per-lane ledger
+        qinfo = None
+        for lane in self._lanes:
+            getq = getattr(lane.predictor, "quant_info", None)
+            if getq is not None:
+                qinfo = getq()
+                break
         return {
             "buckets": buckets,
             "lanes": lanes,
+            "quantized_weights": qinfo,
             "queue_depth": depth,
             "rows_served": served,
             "mean_occupancy": round(served / slots, 4) if slots else 0.0,

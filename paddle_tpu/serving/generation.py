@@ -17,7 +17,13 @@ ledger in `stats()` proves it, the same exactness contract as the PR 3
 per-(device, bucket) ledgers). K/V lives in `serving.PagedKVCache`
 pools; on TPU the Pallas `paged_attention` kernel reads pages in place,
 elsewhere a dense gather reference keeps the math bit-anchored to
-`GPTModel.generate` (`ops/paged_ops.py`).
+`GPTModel.generate` (`ops/paged_ops.py`). With
+`kv_cache_dtype="int8"` (FLAGS_kv_cache_dtype) the pools store int8
+pages + per-(layer, head, page) scale pools — quantize-on-append,
+dequantize-on-read, ~4x the concurrent sequences per HBM byte; parity
+vs fp32 pages is token-level (different compiled programs). A
+`quantize_weights`'d model composes independently: its decode-weight
+pytree carries (int8, scale) leaves dequantized in-graph.
 
 Hardening carries over from the one-shot engine, re-expressed at token
 granularity: bounded intake (`EngineOverloaded`), worst-case page
@@ -56,7 +62,7 @@ from ..framework.errors import (ExecutionTimeoutError, FatalError,
 from ..framework.flags import flag
 from ..profiler import (RecordEvent, device_telemetry, exporter,
                         flight_recorder, spans)
-from .kv_cache import PagedKVCache
+from .kv_cache import TRASH_PAGE, PagedKVCache
 
 __all__ = ["GenerationConfig", "GenerationEngine"]
 
@@ -78,6 +84,7 @@ class GenerationConfig:
                  max_new_tokens: Optional[int] = None,
                  max_queue_depth: Optional[int] = None,
                  request_timeout_ms: Optional[float] = None,
+                 kv_cache_dtype: Optional[str] = None,
                  top_k: int = 0, seed: int = 0, warmup: bool = True):
         self.max_slots = int(flag("FLAGS_gen_max_slots")
                              if max_slots is None else max_slots)
@@ -105,6 +112,14 @@ class GenerationConfig:
         self.request_timeout_ms = float(
             flag("FLAGS_gen_request_timeout_ms")
             if request_timeout_ms is None else request_timeout_ms)
+        self.kv_cache_dtype = str(flag("FLAGS_kv_cache_dtype")
+                                  if kv_cache_dtype is None
+                                  else kv_cache_dtype)
+        if self.kv_cache_dtype not in ("auto", "int8", "float32",
+                                       "bfloat16"):
+            raise InvalidArgumentError(
+                f"kv_cache_dtype must be auto/int8/float32/bfloat16, "
+                f"got {self.kv_cache_dtype!r}")
         self.top_k = int(top_k)
         self.seed = int(seed)
         self.warmup = bool(warmup)
@@ -202,11 +217,20 @@ class GenerationEngine:
             {min(int(b), cap) for b in self._cfg.prefill_buckets}))
         self._device = device
         dtype = np.asarray(self._W["lnf"][0]).dtype
+        kv_dtype = (str(dtype) if self._cfg.kv_cache_dtype == "auto"
+                    else self._cfg.kv_cache_dtype)
         self._cache = PagedKVCache(
             mcfg.num_layers, self._H, self._D, self._cfg.page_size,
-            self._cfg.num_pages, self._cfg.pages_per_seq, dtype=str(dtype))
+            self._cfg.num_pages, self._cfg.pages_per_seq, dtype=kv_dtype)
+        # int8 page mode: quantize-on-append decode/prefill programs
+        # thread the parallel scale pools (donated alongside the pages);
+        # everything above this line — admission arithmetic, page
+        # tables, zero-on-free, the compile ledger — is dtype-blind
+        self._quant_kv = self._cache.quantized
         self._kp = self._cache.k_pages
         self._vp = self._cache.v_pages
+        self._ks = self._cache.k_scales
+        self._vs = self._cache.v_scales
 
         self._cv = threading.Condition()
         self._queue: deque = deque()
@@ -255,43 +279,96 @@ class GenerationEngine:
         self._ledger[key] = self._ledger.get(key, 0) + 1
         monitor.stat_add("STAT_gen_compiles")
 
+    def _pools(self):
+        """The donated device-pool tuple the jitted programs thread:
+        (k_pages, v_pages) — plus the parallel scale pools in the int8
+        page mode."""
+        if self._quant_kv:
+            return (self._kp, self._vp, self._ks, self._vs)
+        return (self._kp, self._vp)
+
+    def _set_pools(self, pools):
+        if self._quant_kv:
+            self._kp, self._vp, self._ks, self._vs = pools
+        else:
+            self._kp, self._vp = pools
+
     def _build_programs(self):
         import jax
         import jax.numpy as jnp
 
         from ..models.gpt import gpt_decode_step, gpt_logits, gpt_prefill
         from ..ops.paged_ops import (page_rows_for_positions,
-                                     paged_attention, paged_write)
+                                     paged_attention, paged_write,
+                                     paged_write_quantized)
 
         H, P, scale = self._H, self._cfg.page_size, self._scale
         top_k = self._cfg.top_k
+        quant = self._quant_kv
+        # pools per program signature: (kp, vp) or (kp, vp, ks, vs) —
+        # the int8 mode's scale pools ride (and are donated) alongside
+        # the pages so quantize-on-append updates both in place
+        NP = self._npool = 4 if quant else 2
         eng = self
 
-        def prefill_fn(W, kp, vp, pt_row, ids, length):
+        def write_pages(pools, layer, page_ids, offs, k, v):
+            if quant:
+                kp, vp, ksc, vsc = pools
+                kp, ksc = paged_write_quantized(kp, ksc, layer, page_ids,
+                                                offs, k)
+                vp, vsc = paged_write_quantized(vp, vsc, layer, page_ids,
+                                                offs, v)
+                return (kp, vp, ksc, vsc)
+            kp, vp = pools
+            # a forced narrower page dtype (kv_cache_dtype="bfloat16"
+            # under an fp32 model) is a deliberate storage downcast
+            return (paged_write(kp, layer, page_ids, offs,
+                                k.astype(kp.dtype)),
+                    paged_write(vp, layer, page_ids, offs,
+                                v.astype(vp.dtype)))
+
+        def prefill_fn(W, *rest):
+            pools, (pt_row, ids, length) = rest[:NP], rest[NP:]
             eng._note_trace(f"prefill[b={ids.shape[1]}]")
             h, ks, vs = gpt_prefill(W, ids, num_heads=H, scale=scale)
             S_b = ids.shape[1]
             pos = jnp.arange(S_b)
             page_ids, offs = page_rows_for_positions(pt_row, pos, P)
-            kp = paged_write(kp, None, page_ids, offs, ks[:, 0])
-            vp = paged_write(vp, None, page_ids, offs, vs[:, 0])
+            # bucket-pad tail positions (pos >= length) write to the
+            # reserved scratch page, never the sequence's own pages —
+            # the documented contract, and load-bearing in the int8
+            # mode: the scatter-max page scales must not bake pad-token
+            # K/V magnitudes into a real page's quantization grid (the
+            # grid only ever widens, so the pollution would be
+            # permanent; fp32 merely overwrites the junk later)
+            valid = pos < length
+            page_ids = jnp.where(valid, page_ids, TRASH_PAGE)
+            offs = jnp.where(valid, offs, 0)
+            pools = write_pages(pools, None, page_ids, offs,
+                                ks[:, 0], vs[:, 0])
             idx = jnp.clip(length - 1, 0, S_b - 1)
-            return kp, vp, gpt_logits(W, h[0, idx])
+            return (*pools, gpt_logits(W, h[0, idx]))
 
         def write_kv(cache, layer, k, v, pos):
-            kp, vp, pt = cache
+            pools, pt = cache
             page_ids, offs = page_rows_for_positions(pt, pos, P)
-            return (paged_write(kp, layer, page_ids, offs, k),
-                    paged_write(vp, layer, page_ids, offs, v), pt)
+            return (write_pages(pools, layer, page_ids, offs, k, v), pt)
 
         def attend(cache, layer, q, pos):
-            kp, vp, pt = cache
+            pools, pt = cache
+            if quant:
+                kp, vp, ksc, vsc = pools
+                return paged_attention(q, kp[layer], vp[layer], pt, pos,
+                                       scale, ksc[layer], vsc[layer])
+            kp, vp = pools
             return paged_attention(q, kp[layer], vp[layer], pt, pos, scale)
 
-        def decode_fn(W, kp, vp, pt, tok, pos, active, temps, smask, key):
+        def decode_fn(W, *rest):
+            pools = rest[:NP]
+            pt, tok, pos, active, temps, smask, key = rest[NP:]
             eng._note_trace(f"decode[m={tok.shape[0]}]")
-            logits, (kp, vp, _) = gpt_decode_step(
-                W, tok, pos, (kp, vp, pt), write_kv, attend,
+            logits, (pools, _) = gpt_decode_step(
+                W, tok, pos, (pools, pt), write_kv, attend,
                 num_heads=H, scale=scale)
             greedy = jnp.argmax(logits, -1).astype(jnp.int32)
             lg = logits / jnp.maximum(temps[:, None], 1e-6)
@@ -301,17 +378,30 @@ class GenerationEngine:
             sampled = jax.random.categorical(key, lg).astype(jnp.int32)
             nxt = jnp.where(smask, sampled, greedy)
             bad = active & ~jnp.all(jnp.isfinite(logits), axis=-1)
-            return kp, vp, jnp.where(active, nxt, 0), bad
+            return (*pools, jnp.where(active, nxt, 0), bad)
 
-        def zero_fn(kp, vp, pages):
+        def zero_fn(*rest):
             # trash-padded page rows: the scratch page is re-zeroed with
-            # every free, which also scrubs poisoned prefill tails
+            # every free, which also scrubs poisoned prefill tails; the
+            # int8 mode resets the freed pages' SCALES too, so the next
+            # owner starts from a clean quantization grid and a poisoned
+            # page's scale can't survive its content
+            pools, pages = rest[:NP], rest[NP]
+            if quant:
+                kp, vp, ksc, vsc = pools
+                return (kp.at[:, :, pages].set(0),
+                        vp.at[:, :, pages].set(0),
+                        ksc.at[:, :, pages].set(0.0),
+                        vsc.at[:, :, pages].set(0.0))
+            kp, vp = pools
             return (kp.at[:, :, pages].set(0.0),
                     vp.at[:, :, pages].set(0.0))
 
-        self._prefill_jit = jax.jit(prefill_fn, donate_argnums=(1, 2))
-        self._decode_jit = jax.jit(decode_fn, donate_argnums=(1, 2))
-        self._zero_jit = jax.jit(zero_fn, donate_argnums=(0, 1))
+        donate = tuple(range(1, 1 + NP))
+        self._prefill_jit = jax.jit(prefill_fn, donate_argnums=donate)
+        self._decode_jit = jax.jit(decode_fn, donate_argnums=donate)
+        self._zero_jit = jax.jit(zero_fn,
+                                 donate_argnums=tuple(range(NP)))
 
     def _dev_ctx(self):
         import jax
@@ -328,7 +418,7 @@ class GenerationEngine:
     def _zero_pages(self, pages):
         row = self._cache.zero_rows(pages)
         with self._dev_ctx():
-            self._kp, self._vp = self._zero_jit(self._kp, self._vp, row)
+            self._set_pools(self._zero_jit(*self._pools(), row))
 
     def _warmup(self):
         """Compile every prefill bucket + the decode step + the zeroing
@@ -341,15 +431,14 @@ class GenerationEngine:
             for b in self._cfg.prefill_buckets:
                 ids = np.zeros((1, b), np.int32)
                 with self._dev_ctx():
-                    self._kp, self._vp, lg = self._prefill_jit(
-                        self._W, self._kp, self._vp, trash, ids,
-                        np.int32(1))
-                np.asarray(lg)
+                    out = self._prefill_jit(
+                        self._W, *self._pools(), trash, ids, np.int32(1))
+                self._set_pools(out[:-1])
+                np.asarray(out[-1])
             args = self._step_arrays()
-            kp, vp, nxt, bad = self._decode_call(
-                self._W, self._kp, self._vp, *args)
-            np.asarray(nxt)
-            self._kp, self._vp = kp, vp
+            out = self._decode_call(self._W, *self._pools(), *args)
+            np.asarray(out[-2])
+            self._set_pools(out[:-2])
             self._zero_pages([])
 
     # -- request intake ----------------------------------------------------
@@ -576,10 +665,11 @@ class GenerationEngine:
         ids[0, :S] = req.prompt
         with RecordEvent(f"generation::prefill[b={bucket}]"):
             with self._dev_ctx():
-                self._kp, self._vp, logits = self._prefill_jit(
-                    self._W, self._kp, self._vp, req.pt_row, ids,
+                out = self._prefill_jit(
+                    self._W, *self._pools(), req.pt_row, ids,
                     np.int32(S))
-            lg = np.asarray(logits)
+            self._set_pools(out[:-1])
+            lg = np.asarray(out[-1])
         if not np.all(np.isfinite(lg)):
             monitor.stat_add("STAT_gen_poisoned")
             flight_recorder.dump("gen_poisoned_sequence", {
@@ -661,11 +751,10 @@ class GenerationEngine:
             self._pre_step_hook(self)
         args = self._step_arrays()
         with RecordEvent(f"generation::step[m={self._cfg.max_slots}]"):
-            kp, vp, nxt, bad = self._decode_call(
-                self._W, self._kp, self._vp, *args)
-            nxt = np.asarray(nxt)
-            bad = np.asarray(bad)
-        self._kp, self._vp = kp, vp
+            out = self._decode_call(self._W, *self._pools(), *args)
+            nxt = np.asarray(out[-2])
+            bad = np.asarray(out[-1])
+        self._set_pools(out[:-2])
         self._steps_total += 1
         monitor.stat_add("STAT_gen_steps")
         for i, req in enumerate(self._slots):

@@ -28,9 +28,19 @@ Design points:
 - Host-side state is plain python under the engine's lock; the pools
   themselves are jnp arrays the engine threads through its jitted
   step functions (donated, so XLA updates them in place).
+- **int8 page mode** (`dtype="int8"`, FLAGS_kv_cache_dtype): pools
+  store int8 with parallel per-(layer, head, page) fp32 scale pools
+  (`k_scales`/`v_scales`); `ops/paged_ops.paged_write_quantized`
+  quantizes on append, the attention path dequantizes on gather. One
+  page costs ~4x fewer HBM bytes than fp32
+  (`page_hbm_bytes`/`pages_for_budget` do the arithmetic), so the same
+  pool budget admits ~4x the concurrent sequences — the quantized-
+  serving capacity multiplier. Zero-on-free covers the scale pools:
+  a freed page's scale resets to 0 ("empty") with its content.
 """
 from __future__ import annotations
 
+import weakref
 from typing import Dict, List, Optional
 
 import numpy as np
@@ -41,6 +51,15 @@ from ..framework.errors import InvalidArgumentError, ResourceExhaustedError
 __all__ = ["PagedKVCache"]
 
 TRASH_PAGE = 0
+
+# STAT_kv_cache_hbm_bytes gauges pool bytes across LIVE caches: each
+# cache gauge_add()s its pool (+ scale-pool) bytes at construction and
+# subtracts them when collected (weakref.finalize — the engine drops
+# its cache on GC, there is no explicit close), so a multi-engine
+# process exports the aggregate of what actually exists rather than
+# whichever pool was built last.
+def _note_pool_bytes(delta: int) -> None:
+    monitor.stat_gauge_add("STAT_kv_cache_hbm_bytes", delta)
 
 
 class PagedKVCache:
@@ -64,19 +83,64 @@ class PagedKVCache:
         self.page_size = int(page_size)
         self.num_pages = int(num_pages)
         self.pages_per_seq = int(pages_per_seq)
-        self.dtype = dtype
+        self.dtype = str(dtype)
+        self.quantized = self.dtype == "int8"
         import jax.numpy as jnp
         shape = (self.num_layers, self.num_heads, self.num_pages,
                  self.page_size, self.head_dim)
-        self.k_pages = jnp.zeros(shape, dtype)
-        self.v_pages = jnp.zeros(shape, dtype)
+        self.k_pages = jnp.zeros(shape, self.dtype)
+        self.v_pages = jnp.zeros(shape, self.dtype)
+        # int8 page mode: per-(layer, head, page) symmetric abs-max
+        # scales in a parallel pool (dequant = q * scale; scale 0 means
+        # "page empty" — zero-on-free resets both pools, so a freed
+        # page's next owner starts from a clean quantization grid)
+        if self.quantized:
+            sshape = (self.num_layers, self.num_heads, self.num_pages)
+            self.k_scales = jnp.zeros(sshape, "float32")
+            self.v_scales = jnp.zeros(sshape, "float32")
+        else:
+            self.k_scales = self.v_scales = None
         # LIFO free list: the page freed last is reallocated first, so a
         # hot pool keeps touching the same HBM region
         self._free: List[int] = list(range(self.num_pages - 1, 0, -1))
         self._owned: Dict[int, List[int]] = {}  # seq id -> pages
         monitor.stat_set("STAT_kv_pages_inuse", 0)
+        b = self.hbm_bytes()
+        _note_pool_bytes(b)
+        weakref.finalize(self, _note_pool_bytes, -b)
 
     # -- capacity arithmetic ----------------------------------------------
+
+    @staticmethod
+    def page_hbm_bytes(num_layers: int, num_heads: int, head_dim: int,
+                       page_size: int, dtype="float32") -> int:
+        """Device bytes ONE page costs across both pools (K and V, every
+        layer), including its slice of the int8 scale pools — the unit
+        of the capacity arithmetic below."""
+        item = np.dtype(dtype).itemsize
+        b = 2 * num_layers * num_heads * page_size * head_dim * item
+        if str(dtype) == "int8":
+            b += 2 * num_layers * num_heads * 4  # fp32 scale per (L,H)
+        return b
+
+    @classmethod
+    def pages_for_budget(cls, budget_bytes: int, *, num_layers: int,
+                         num_heads: int, head_dim: int, page_size: int,
+                         dtype="float32") -> int:
+        """Most pages (incl. the reserved scratch page) an HBM budget
+        admits: int8 pages are ~4x denser than fp32 — the serving-
+        capacity multiplier the quantized KV mode exists for, and how
+        bench.py builds equal-byte fp32/int8 pools."""
+        per = cls.page_hbm_bytes(num_layers, num_heads, head_dim,
+                                 page_size, dtype)
+        return max(2, int(budget_bytes) // per)
+
+    def hbm_bytes(self) -> int:
+        """Live device bytes of the K/V pools + scale pools."""
+        b = int(self.k_pages.nbytes) + int(self.v_pages.nbytes)
+        if self.quantized:
+            b += int(self.k_scales.nbytes) + int(self.v_scales.nbytes)
+        return b
 
     @property
     def usable_pages(self) -> int:
@@ -156,6 +220,9 @@ class PagedKVCache:
 
     def stats(self) -> dict:
         return {
+            "dtype": self.dtype,
+            "quantized": self.quantized,
+            "hbm_bytes": self.hbm_bytes(),
             "page_size": self.page_size,
             "usable_pages": self.usable_pages,
             "pages_in_use": self.pages_in_use,

@@ -38,7 +38,8 @@ _SKIP_FILES = {os.path.join(PKG, "framework", "monitor.py")}
 
 _CALL = re.compile(
     r'(?:\b(?:STAT_ADD|STAT_SUB|STAT_RESET|stat_add|stat_sub|stat_reset|'
-    r'stat_get|stat_set|stat_time)|\bhistogram)\s*\(\s*(f?)"([^"]+)"')
+    r'stat_get|stat_set|stat_gauge_add|stat_time)|\bhistogram)'
+    r'\s*\(\s*(f?)"([^"]+)"')
 _PLACEHOLDER = re.compile(r"\{([^{}]*)\}")
 _DOC_ROW = re.compile(r"^\|\s*([^|]+?)\s*\|")
 
