@@ -589,6 +589,7 @@ class Model:
             # epoch-boundary _sync_carry never writes deleted buffers
             # back into the network's Tensors.
             if any(getattr(leaf, "is_deleted", lambda: False)()
+                   # lint: allow(use-after-donate): is_deleted() probes buffer liveness metadata without touching the (possibly deleted) data — detecting a consumed carry is this handler's whole purpose
                    for leaf in jax.tree_util.tree_leaves(carry)):
                 self._train_carry = None
                 self._opt_state = None  # its arrays rode the same donation

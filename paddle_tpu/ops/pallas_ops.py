@@ -40,6 +40,7 @@ def _block_pref(flag_name):
     bench runs 1.47x dense (bench.py). The splash path rides the same
     flags (tools/perf_splash_sweep.py re-runs the sweep for it)."""
     from ..framework.flags import flag
+    # lint: allow(flag-in-trace): this IS the sanctioned snapshot point — flash/splash_attention_raw reads the tile flags once per outer trace and threads them through the custom_vjp as static args, so fwd and bwd can never desync (the PR 6 contract)
     pref = int(flag(flag_name))
     if pref < _BLOCK_MIN or pref % _BLOCK_MIN != 0:
         raise ValueError(
@@ -96,6 +97,7 @@ except Exception:  # pragma: no cover
 def _interpret():
     """Run kernels in interpreter mode off-TPU (CPU test meshes)."""
     from ..framework.flags import flag
+    # lint: allow(flag-in-trace): interpret mode is lowering structure by definition — the flag selects HOW pallas_call compiles (TPU vs interpreter), re-read at every trace; there is no runtime value to thread
     if flag("FLAGS_flash_attention_interpret"):
         return True
     try:

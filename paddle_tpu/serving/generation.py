@@ -431,6 +431,7 @@ class GenerationEngine:
             for b in self._cfg.prefill_buckets:
                 ids = np.zeros((1, b), np.int32)
                 with self._dev_ctx():
+                    # lint: allow(use-after-donate): donate_argnums covers only the NP pool args riding in the *splat; trash sits AFTER them (position NP+1) and is never donated — reused read-only across warmup prefills
                     out = self._prefill_jit(
                         self._W, *self._pools(), trash, ids, np.int32(1))
                 self._set_pools(out[:-1])

@@ -1,0 +1,53 @@
+"""Tier-1 gate: the whole tracecheck suite runs green over paddle_tpu/.
+
+Every invariant pass (flag-in-trace, use-after-donate,
+scatter-batch-dim, gauge-discipline, lock-discipline, flags-inventory,
+stats-doc) must report zero findings — a new violation lands either
+with a fix or with a reasoned `# lint: allow(<rule>): <reason>`
+comment, and a reasonless suppression is itself a finding
+(bad-suppression), so the tree stays at zero unexplained suppressions.
+"""
+from __future__ import annotations
+
+import os
+import subprocess
+import sys
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, os.path.join(ROOT, "tools"))
+
+import tracecheck  # noqa: E402
+
+
+def test_lint_clean():
+    ctx = tracecheck.load_context(os.path.join(ROOT, "paddle_tpu"), ROOT)
+    findings = tracecheck.run_rules(ctx)
+    assert ctx.modules, "loader found no modules — broken paths"
+    assert not findings, (
+        "tracecheck findings (fix, or suppress with a reasoned "
+        "`# lint: allow(<rule>): <reason>`):\n"
+        + "\n".join(f.format() for f in findings))
+
+
+def test_every_suppression_carries_a_reason():
+    """Belt and braces over the bad-suppression machinery: grep every
+    allow() in the tree and demand the `: <reason>` tail."""
+    ctx = tracecheck.load_context(os.path.join(ROOT, "paddle_tpu"), ROOT)
+    n_allows = 0
+    for mod in ctx.modules:
+        for line, entries in mod.allows.items():
+            for rule_name, reason in entries:
+                n_allows += 1
+                assert reason, (
+                    f"{mod.rel}:{line}: allow({rule_name}) without a "
+                    f"written reason")
+    assert n_allows >= 3  # the audited trace/donation allows exist
+
+
+def test_check_stats_shim_cli():
+    """`python tools/check_stats.py` keeps its original contract."""
+    proc = subprocess.run(
+        [sys.executable, os.path.join(ROOT, "tools", "check_stats.py")],
+        capture_output=True, text=True)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "OK" in proc.stdout

@@ -1,0 +1,273 @@
+"""tracecheck rule corpus: every pass must flag its seeded bad example
+and stay silent on the good twin, suppressions need written reasons,
+and the lint.py CLI honors the 0/1/2 exit-code contract with a clean
+--json round trip.
+
+The fixtures live in tests/tracecheck_fixtures/<rule>/: each holds a
+mini repo (pkg/ tree + optional COVERAGE.md) so the doc-cross-checking
+passes exercise both directions without touching the real docs.
+"""
+from __future__ import annotations
+
+import importlib.util
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, os.path.join(ROOT, "tools"))
+
+import tracecheck  # noqa: E402
+
+FIX = os.path.join(ROOT, "tests", "tracecheck_fixtures")
+LINT = os.path.join(ROOT, "tools", "lint.py")
+
+
+def run_fixture(name, rules=None):
+    root = os.path.join(FIX, name)
+    ctx = tracecheck.load_context(os.path.join(root, "pkg"), root)
+    return tracecheck.run_rules(ctx, rules)
+
+
+def lint_main():
+    spec = importlib.util.spec_from_file_location("lint", LINT)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod.main
+
+
+def _bad_only(findings, rule, bad="bad.py", good="good.py"):
+    """Every finding carries `rule`, touches the bad file, and never the
+    good twin."""
+    assert findings, f"{rule}: seeded violation not flagged"
+    for f in findings:
+        assert f.rule == rule
+        assert good not in f.path, f"{rule} flagged the good twin: {f.format()}"
+        assert bad in f.path, f"{rule} flagged the wrong file: {f.format()}"
+
+
+# ---------------------------------------------------------------------------
+# one test per rule: seeded bad flagged, good twin silent
+# ---------------------------------------------------------------------------
+
+def test_flag_in_trace_corpus():
+    fs = run_fixture("flag_in_trace", ["flag-in-trace"])
+    _bad_only(fs, "flag-in-trace")
+    # the direct flag() call, the bare FLAGS_* global, the
+    # transitively-reachable helper, the jit(partial(f, ...)) form, and
+    # the jit-wrapped lambda inside a traced function — which must be
+    # reported exactly ONCE despite being walked from two FuncInfos
+    assert len(fs) == 5
+    assert any("FLAGS_scale" in f.message for f in fs)
+    assert any("_inner" in f.message for f in fs)
+    assert any("part_kernel" in f.message for f in fs)
+    assert sum("<lambda" in f.message for f in fs) == 1
+
+
+def test_use_after_donate_corpus():
+    fs = run_fixture("use_after_donate", ["use-after-donate"])
+    _bad_only(fs, "use-after-donate")
+    # the donate_argnums positional seed, the donate_argnames keyword
+    # seed, the same-local-name no-clobber seed, the factory-closure
+    # (lexical scoping) seed, the loop-without-rebind seed, the
+    # same-line sequencing seed, the store-on-the-load's-own-line seed
+    # (`step(carry, x)` then `carry = carry + 1` — the rebind executes
+    # AFTER the read), and the never-bound inline `jax.jit(...)(args)`
+    # seed
+    assert len(fs) == 8
+    assert all("`carry`" in f.message for f in fs)
+    assert any("named_step" in f.message for f in fs)
+    assert any("jstep" in f.message for f in fs)
+    assert any("inside a loop" in f.message for f in fs)
+    assert any("jax.jit(...)" in f.message for f in fs)
+
+
+def test_scatter_batch_dim_corpus():
+    fs = run_fixture("scatter_batch_dim", ["scatter-batch-dim"])
+    _bad_only(fs, "scatter-batch-dim")
+    # the .at[...] update and the pool-like gather
+    assert len(fs) == 2
+
+
+def test_gauge_discipline_corpus():
+    fs = run_fixture("gauge_discipline", ["gauge-discipline"])
+    _bad_only(fs, "gauge-discipline")
+    # mixed-discipline name + counter ops on a documented gauge
+    assert len(fs) == 2
+    assert any("STAT_fix_mixed_level" in f.message for f in fs)
+    assert any("STAT_fix_doc_gauge" in f.message for f in fs)
+
+
+def test_lock_discipline_corpus():
+    fs = run_fixture("lock_discipline", ["lock-discipline"])
+    _bad_only(fs, "lock-discipline")
+    # both unlocked sites of the contended attribute (loop + caller)
+    assert len(fs) == 2
+    assert all("_count" in f.message for f in fs)
+
+
+def test_flags_inventory_corpus():
+    fs = run_fixture("flags_inventory", ["flags-inventory"])
+    assert {f.rule for f in fs} == {"flags-inventory"}
+    missing = [f for f in fs if "FLAGS_fix_missing_doc" in f.message]
+    ghost = [f for f in fs if "FLAGS_fix_ghost" in f.message]
+    assert len(fs) == 2 and missing and ghost
+    assert missing[0].path.endswith(os.path.join("framework", "flags.py"))
+    assert ghost[0].path == "COVERAGE.md"
+    # the documented flag is clean in both directions
+    assert not any("FLAGS_fix_documented" in f.message for f in fs)
+
+
+def test_stats_doc_corpus():
+    fs = run_fixture("stats_doc", ["stats-doc"])
+    assert {f.rule for f in fs} == {"stats-doc"}
+    undoc = [f for f in fs if "STAT_fix_undocumented_thing" in f.message]
+    stale = [f for f in fs if "STAT_fix_stale_thing" in f.message]
+    assert len(fs) == 2 and undoc and stale
+    assert undoc[0].path.endswith("mod.py")
+    assert stale[0].path == "COVERAGE.md"
+
+
+# ---------------------------------------------------------------------------
+# suppressions: reasoned allow() silences, reasonless is itself a finding
+# ---------------------------------------------------------------------------
+
+def test_reasoned_allow_suppresses():
+    fs = run_fixture("suppression", ["scatter-batch-dim"])
+    assert not any("suppressed.py" in f.path for f in fs)
+
+
+def test_reasonless_allow_is_reported_and_does_not_suppress():
+    fs = run_fixture("suppression", ["scatter-batch-dim"])
+    reasonless = [f for f in fs if "reasonless.py" in f.path]
+    assert {f.rule for f in reasonless} == \
+        {"scatter-batch-dim", "bad-suppression"}
+
+
+def test_malformed_allow_is_reported_and_does_not_suppress():
+    fs = run_fixture("suppression", ["scatter-batch-dim"])
+    malformed = [f for f in fs if "malformed.py" in f.path]
+    assert {f.rule for f in malformed} == \
+        {"scatter-batch-dim", "bad-suppression"}
+    assert any("malformed" in f.message for f in malformed)
+
+
+def test_unknown_rule_allow_is_reported():
+    fs = run_fixture("suppression", ["scatter-batch-dim"])
+    unknown = [f for f in fs if "unknown.py" in f.path]
+    assert len(unknown) == 1 and unknown[0].rule == "bad-suppression"
+    assert "no-such-rule" in unknown[0].message
+
+
+def test_parse_error_is_a_finding_not_a_crash():
+    fs = run_fixture("parse_error")
+    assert len(fs) == 1 and fs[0].rule == "parse-error"
+    assert "broken.py" in fs[0].path
+
+
+def test_allow_text_in_strings_is_inert():
+    """Allow-shaped text inside docstrings/string literals neither
+    suppresses the adjacent violation nor reports bad-suppression."""
+    fs = run_fixture("suppression", ["scatter-batch-dim"])
+    quoted = [f for f in fs if "quoted.py" in f.path]
+    assert [f.rule for f in quoted] == ["scatter-batch-dim"]
+
+
+def test_run_rules_rejects_unknown_rule_name():
+    with pytest.raises(KeyError):
+        run_fixture("suppression", ["not-a-rule"])
+
+
+def test_repeated_rule_selection_does_not_duplicate_findings():
+    """`--rule x --rule x` must behave exactly like `--rule x`."""
+    once = run_fixture("scatter_batch_dim", ["scatter-batch-dim"])
+    twice = run_fixture("scatter_batch_dim",
+                        ["scatter-batch-dim", "scatter-batch-dim"])
+    assert [(f.path, f.line) for f in twice] == \
+        [(f.path, f.line) for f in once]
+
+
+def test_fstring_normalizers_agree_on_format_specs():
+    """The regex normalizer (stats-doc / the check_stats shim) and the
+    AST normalizer (gauge-discipline) must wildcard the same name to
+    the same token, or the doc Kind cross-check silently lapses."""
+    import ast as _ast
+    from tracecheck.rules.stats_doc import _normalize, \
+        normalize_fstring_ast
+    for text in ('STAT_lat{ms:.0f}_bucket', 'STAT_x{n!r}_y',
+                 'STAT_serving_lane{self.index}_batches'):
+        via_ast = normalize_fstring_ast(
+            _ast.parse(f'f"{text}"', mode="eval").body)
+        assert _normalize(text, True) == via_ast, text
+
+
+# ---------------------------------------------------------------------------
+# lint.py CLI: --json round trip + the 0/1/2 exit-code contract
+# ---------------------------------------------------------------------------
+
+def test_json_round_trip(capsys):
+    root = os.path.join(FIX, "scatter_batch_dim")
+    code = lint_main()(["--json", "--rule", "scatter-batch-dim",
+                        "--pkg", os.path.join(root, "pkg"),
+                        "--repo", root])
+    payload = json.loads(capsys.readouterr().out)
+    assert code == 1
+    assert payload["ok"] is False
+    assert payload["rules"] == ["scatter-batch-dim"]
+    assert payload["modules"] == 3  # __init__, bad, good
+    got = {(f["rule"], f["path"], f["line"]) for f in payload["findings"]}
+    ctx = tracecheck.load_context(os.path.join(root, "pkg"), root)
+    want = {(f.rule, f.path, f.line)
+            for f in tracecheck.run_rules(ctx, ["scatter-batch-dim"])}
+    assert got == want  # JSON carries exactly the API's findings
+
+
+def test_exit_zero_on_clean_tree(capsys):
+    root = os.path.join(FIX, "scatter_batch_dim")
+    code = lint_main()(["--json", "--rule", "flag-in-trace",
+                        "--pkg", os.path.join(root, "pkg"),
+                        "--repo", root])
+    payload = json.loads(capsys.readouterr().out)
+    assert code == 0 and payload["ok"] is True and not payload["findings"]
+
+
+def test_exit_two_on_internal_error(capsys):
+    code = lint_main()(["--rule", "no-such-rule",
+                        "--pkg", os.path.join(FIX, "suppression", "pkg"),
+                        "--repo", os.path.join(FIX, "suppression")])
+    capsys.readouterr()
+    assert code == 2
+
+
+def test_exit_two_on_missing_pkg_path(capsys, tmp_path):
+    """A typo'd --pkg must never report a clean tree it never scanned."""
+    code = lint_main()(["--pkg", str(tmp_path / "no-such-tree"),
+                        "--repo", str(tmp_path)])
+    capsys.readouterr()
+    assert code == 2
+
+
+def test_cli_subprocess_contract():
+    """The real `python tools/lint.py --json` process honors the same
+    contract (no jax import, so this stays cheap)."""
+    root = os.path.join(FIX, "use_after_donate")
+    proc = subprocess.run(
+        [sys.executable, LINT, "--json", "--rule", "use-after-donate",
+         "--pkg", os.path.join(root, "pkg"), "--repo", root],
+        capture_output=True, text=True)
+    assert proc.returncode == 1
+    payload = json.loads(proc.stdout)
+    assert payload["findings"], "seeded corpus produced no findings"
+    assert {f["rule"] for f in payload["findings"]} == {"use-after-donate"}
+
+
+def test_list_rules_names_all_seven(capsys):
+    assert lint_main()(["--list-rules"]) == 0
+    out = capsys.readouterr().out
+    for name in ("flag-in-trace", "use-after-donate", "scatter-batch-dim",
+                 "gauge-discipline", "lock-discipline", "flags-inventory",
+                 "stats-doc"):
+        assert name in out

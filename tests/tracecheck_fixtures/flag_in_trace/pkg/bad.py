@@ -1,0 +1,51 @@
+"""Seeded flag-in-trace violations: flag reads inside traced bodies."""
+import functools
+
+import jax
+
+from .somewhere import flag
+
+
+def kernel(x):
+    if flag("FLAGS_fast_path"):  # BAD: read at trace time
+        return x * 2
+    return x
+
+
+fast_kernel = jax.jit(kernel)
+
+
+def global_reader(x):
+    return x * FLAGS_scale  # BAD: mutable-global read under trace
+
+
+scaled = jax.jit(global_reader)
+
+
+def _inner(x):
+    return x * flag("FLAGS_inner")  # BAD: transitively trace-reachable
+
+
+def outer(x):
+    return _inner(x)
+
+
+outer_jit = jax.jit(outer)
+
+
+def part_kernel(x, n):
+    return x * n * flag("FLAGS_part")  # BAD: traced through partial
+
+
+stepped = jax.jit(functools.partial(part_kernel, n=4))
+
+
+def lambda_host(x):
+    # BAD — but exactly ONE finding: the lambda body is walked both
+    # under this enclosing traced function and as its own trace-rooted
+    # FuncInfo, and the rule must dedup by node identity
+    f = jax.jit(lambda y: y * flag("FLAGS_lam"))
+    return f(x)
+
+
+hosted = jax.jit(lambda_host)

@@ -1,0 +1,23 @@
+"""Good twin: the flag is snapshotted OUTSIDE the trace and threaded
+through as a static argument."""
+import jax
+
+from .somewhere import flag
+
+
+def kernel(x, fast):
+    return x * 2 if fast else x
+
+
+def run(x):
+    fast = bool(flag("FLAGS_fast_path"))  # snapshot outside the trace
+    return jax.jit(kernel, static_argnums=1)(x, fast)
+
+
+def kernel_default(x, fast=bool(flag("FLAGS_fast_path"))):
+    # the default evaluates ONCE at def time — that IS the sanctioned
+    # snapshot position, not an in-trace read
+    return x * 2 if fast else x
+
+
+snapped = jax.jit(kernel_default, static_argnums=1)

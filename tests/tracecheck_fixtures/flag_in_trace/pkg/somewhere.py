@@ -1,0 +1,5 @@
+"""Stand-in flags registry for the fixture."""
+
+
+def flag(name):
+    return 0

@@ -1,0 +1,10 @@
+"""Stand-in flags registry: one documented flag, one seeded
+undocumented flag."""
+
+
+def register_flag(name, default, doc=""):
+    pass
+
+
+register_flag("FLAGS_fix_documented", True, "mentioned in COVERAGE.md")
+register_flag("FLAGS_fix_missing_doc", 0, "BAD: no doc mention")

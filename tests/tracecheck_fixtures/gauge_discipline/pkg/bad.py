@@ -1,0 +1,16 @@
+"""Seeded gauge-discipline violations: one name used with both
+disciplines, and a counter-op name the fixture COVERAGE.md documents
+as a gauge."""
+from .monitorlike import stat_add, stat_set
+
+
+def report_level(n):
+    stat_set("STAT_fix_mixed_level", n)
+
+
+def bump_level():
+    stat_add("STAT_fix_mixed_level")  # BAD: counter op on a gauge name
+
+
+def bump_documented_gauge():
+    stat_add("STAT_fix_doc_gauge")  # BAD: COVERAGE.md says gauge

@@ -1,0 +1,10 @@
+"""Good twins: one discipline per name, doc kind matching the ops."""
+from .monitorlike import stat_add, stat_set
+
+
+def report_level(n):
+    stat_set("STAT_fix_pure_gauge", n)
+
+
+def bump_counter():
+    stat_add("STAT_fix_pure_counter")

@@ -1,0 +1,9 @@
+"""Stand-in stat registry for the fixture."""
+
+
+def stat_add(name, delta=1):
+    pass
+
+
+def stat_set(name, value):
+    pass

@@ -1,0 +1,16 @@
+"""Seeded lock-discipline violation: the same attribute mutated from a
+thread loop and the caller's thread, no lock at either site."""
+import threading
+
+
+class Engine:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._count = 0
+        self._thread = threading.Thread(target=self._loop)
+
+    def _loop(self):
+        self._count += 1  # BAD: caller thread also writes this
+
+    def submit(self):
+        self._count += 1  # BAD: loop thread also writes this

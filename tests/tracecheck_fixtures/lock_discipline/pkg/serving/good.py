@@ -1,0 +1,20 @@
+"""Good twins: contended writes under the lock; thread-private and
+construction-time state lock-free."""
+import threading
+
+
+class Engine:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._count = 0
+        self._steps = 0  # only ever touched by the loop thread
+        self._thread = threading.Thread(target=self._loop)
+
+    def _loop(self):
+        self._steps += 1  # single-entry attr: no lock needed
+        with self._lock:
+            self._count += 1
+
+    def submit(self):
+        with self._lock:
+            self._count += 1

@@ -1,0 +1,10 @@
+"""Seeded scatter-batch-dim violations: non-contiguous advanced
+indexing with no acknowledgment anywhere nearby."""
+
+
+def paged_write(pool, layer, page_ids, offsets, vals):
+    return pool.at[layer, :, page_ids, offsets].set(vals)  # BAD
+
+
+def page_gather(pages, layer, page_ids, offsets):
+    return pages[layer, :, page_ids, offsets]  # BAD: pool-like gather

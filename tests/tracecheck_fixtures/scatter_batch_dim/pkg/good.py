@@ -1,0 +1,25 @@
+"""Good twins: contiguous advanced indices stay in place; a
+non-contiguous site with the adjacent moveaxis acknowledgment is the
+documented idiom."""
+import jax.numpy as jnp
+
+
+def paged_write_contiguous(pool, page_ids, offsets, vals):
+    # adjacent advanced indices: the index block stays in place
+    return pool.at[page_ids, offsets].set(vals)
+
+
+def scalar_update(pool, vals):
+    # integers + slices only is BASIC indexing: nothing reorders
+    return pool.at[0, :, 1].set(vals)
+
+
+def scalar_gather(pages):
+    return pages[0, :, 3]
+
+
+def paged_write_acknowledged(pool, layer, page_ids, offsets, vals):
+    # advanced indices are split by the `:` so the batch dim lands in
+    # front of the result; moveaxis puts the update in that layout
+    vals = jnp.moveaxis(vals, 0, 1)
+    return pool.at[layer, :, page_ids, offsets].set(vals)

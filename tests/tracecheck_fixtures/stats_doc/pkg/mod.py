@@ -1,0 +1,11 @@
+"""Stand-in metric call sites: one documented, one seeded
+undocumented (the fixture COVERAGE.md also carries a stale row)."""
+
+
+def stat_add(name, delta=1):
+    pass
+
+
+def work():
+    stat_add("STAT_fix_documented_thing")
+    stat_add("STAT_fix_undocumented_thing")  # BAD: no inventory row

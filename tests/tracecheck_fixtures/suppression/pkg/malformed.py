@@ -1,0 +1,7 @@
+"""An allow with a dangling colon: syntactically broken, so it neither
+suppresses nor names a reason — it must still be surfaced."""
+
+
+def paged_write(pool, layer, page_ids, offsets, vals):
+    # lint: allow(scatter-batch-dim):
+    return pool.at[layer, :, page_ids, offsets].set(vals)

@@ -1,0 +1,9 @@
+"""Docs may quote the syntax — suppress a finding with a
+`# lint: allow(<rule>): <reason>` comment — without creating one."""
+
+HELP = "silence with `# lint: allow(scatter-batch-dim): some reason`"
+
+
+def paged_write(pool, layer, page_ids, offsets, vals):
+    usage = "# lint: allow(scatter-batch-dim): not a comment"
+    return pool.at[layer, :, page_ids, offsets].set(vals), usage

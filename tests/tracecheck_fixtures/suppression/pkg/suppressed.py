@@ -1,0 +1,6 @@
+"""A violation silenced by a reasoned allow — the sanctioned way."""
+
+
+def paged_write(pool, layer, page_ids, offsets, vals):
+    # lint: allow(scatter-batch-dim): fixture — the caller pre-arranges vals batch-dim-front
+    return pool.at[layer, :, page_ids, offsets].set(vals)

@@ -1,0 +1,4 @@
+"""An allow() naming a rule that does not exist."""
+
+# lint: allow(no-such-rule): typos must not silently suppress nothing
+X = 1

@@ -1,0 +1,74 @@
+"""Seeded use-after-donate violation: a donated buffer read after the
+donating call."""
+import jax
+
+
+def _step(carry, x):
+    return carry + x
+
+
+step = jax.jit(_step, donate_argnums=(0,))
+
+
+def train(carry, x):
+    new_carry = step(carry, x)
+    stale = carry.sum()  # BAD: carry's buffer was donated and deleted
+    return new_carry, stale
+
+
+named_step = jax.jit(_step, donate_argnames=("carry",))
+
+
+def train_named(carry, x):
+    new_carry = named_step(carry=carry, x=x)
+    stale = carry.sum()  # BAD: donated by NAME through the keyword
+    return new_carry, stale
+
+
+def train_a(carry, x):
+    step = jax.jit(_step, donate_argnums=(0,))
+    new = step(carry, x)
+    return new, carry.sum()  # BAD: and train_b's different spec for the
+    # same local name `step` must not clobber this one
+
+
+def train_b(carry, x):
+    step = jax.jit(_step, donate_argnames=("x",))
+    out = step(carry, x=x)
+    return out, carry.sum()  # fine: only x is donated in THIS scope
+
+
+def make_train():
+    jstep = jax.jit(_step, donate_argnums=(0,))
+
+    def run(carry, x):
+        new = jstep(carry, x)
+        return new, carry.sum()  # BAD: the closure sees the factory's
+        # donating binding (lexical scoping)
+
+    return run
+
+
+def loop_train(carry, xs):
+    for x in xs:
+        step(carry, x)  # BAD: never rebound — iteration 2 reads a
+        # deleted buffer
+    return carry
+
+
+def inline_use(carry, x):
+    new = step(carry, x); stale = carry.sum()  # BAD: same line, after
+    return new, stale
+
+
+def self_heal_illusion(carry, x):
+    step(carry, x)  # donated, result dropped
+    carry = carry + 1  # BAD: the RHS reads the deleted buffer — the
+    # store on this SAME line executes after the read and heals nothing
+    return carry
+
+
+def inline_jit_call(carry, x):
+    new = jax.jit(_step, donate_argnums=(0,))(carry, x)
+    return new, carry.sum()  # BAD: donated through an inline jit that
+    # was never bound to a name

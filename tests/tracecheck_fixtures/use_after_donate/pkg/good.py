@@ -1,0 +1,51 @@
+"""Good twins: rebinding from the result, the conditional-donation
+idiom, and non-donated arguments."""
+import jax
+
+
+def _step(carry, x):
+    return carry + x
+
+
+step = jax.jit(_step, donate_argnums=(0,))
+
+
+def train(carry, x):
+    carry = step(carry, x)  # rebound from the call's result
+    return carry, carry.sum()
+
+
+def loop_train(carry, xs):
+    for x in xs:
+        carry = step(carry, x)  # rebound every iteration
+    return carry
+
+
+donate_second = jax.jit(_step, donate_argnums=(1,))
+
+
+def splat(pools, trash, x):
+    # runtime positions after a *splat are unknowable: `trash` must not
+    # be mis-attributed to donated position 1
+    out = donate_second(x, *pools, trash)
+    return out, trash.sum()
+
+
+def inline_jit_call(carry, x):
+    # inline jit WITHOUT a donate spec — nothing is consumed
+    new = jax.jit(_step)(carry, x)
+    # inline donating jit whose argument is rebound from the result
+    carry = jax.jit(_step, donate_argnums=(0,))(carry, new)
+    return carry, carry.sum()
+
+
+def make_step(donate):
+    # the repo's donation-toggle idiom: only position 0 can ever be
+    # donated, so reading x afterward is fine
+    toggled = jax.jit(_step, donate_argnums=(0,) if donate else ())
+
+    def train2(carry, x):
+        carry = toggled(carry, x)
+        return carry, x.sum()  # x was never donated
+
+    return train2

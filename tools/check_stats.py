@@ -1,150 +1,48 @@
 #!/usr/bin/env python
 """Bidirectional lint between the code's metric names and COVERAGE.md.
 
-Code → doc: every STAT counter / histogram name bumped anywhere in
-`paddle_tpu/` must be documented in COVERAGE.md ("Metrics inventory"
-section), so the metrics surface cannot silently drift — a new counter
-lands together with its one-line contract, the same way the reference
-keeps `monitor.h` registrations reviewable in one table.
-
-Doc → code: every row of that inventory table must still correspond to
-a name bumped in the code — a renamed or deleted counter must take its
-row with it, or the table rots into a catalogue of metrics dashboards
-can no longer scrape.
-
-Scans for literal (including f-string) first arguments of
-STAT_ADD/STAT_SUB/stat_add/stat_sub/stat_set/stat_time/stat_get/... and
-monitor.histogram(...). F-string placeholders are normalized to a
-`<token>` wildcard built from the expression's last identifier —
-`f"STAT_serving_lane{self.index}_batches"` must be documented as
-`STAT_serving_lane<index>_batches`.
-
-Run directly (exit 1 + both drift lists) or through the tier-1 test
-`tests/test_observability.py::test_check_stats_lint`.
+CLI-compatible shim: the implementation migrated into the tracecheck
+framework (`tools/tracecheck/rules/stats_doc.py`) as its `stats-doc`
+pass — run `python tools/lint.py` for the whole suite. This script
+keeps the original contract (exit 1 + both drift lists, and the
+`collect_names`/`undocumented`/`documented_names`/`stale_documented`
+API that `tests/test_observability.py::test_check_stats_lint` loads).
 """
 from __future__ import annotations
 
 import os
-import re
 import sys
 
 ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, os.path.join(ROOT, "tools"))
+
+from tracecheck.rules import stats_doc as _impl  # noqa: E402
+
 PKG = os.path.join(ROOT, "paddle_tpu")
 COVERAGE = os.path.join(ROOT, "COVERAGE.md")
-
-# monitor.py defines the registry; its docstrings/macro aliases are not
-# metric registrations
-_SKIP_FILES = {os.path.join(PKG, "framework", "monitor.py")}
-
-_CALL = re.compile(
-    r'(?:\b(?:STAT_ADD|STAT_SUB|STAT_RESET|stat_add|stat_sub|stat_reset|'
-    r'stat_get|stat_set|stat_gauge_add|stat_time)|\bhistogram)'
-    r'\s*\(\s*(f?)"([^"]+)"')
-_PLACEHOLDER = re.compile(r"\{([^{}]*)\}")
-_DOC_ROW = re.compile(r"^\|\s*([^|]+?)\s*\|")
-
-
-def _normalize(literal: str, is_fstring: bool) -> str:
-    if not is_fstring:
-        return literal
-
-    def repl(m):
-        idents = re.findall(r"[A-Za-z_][A-Za-z0-9_]*", m.group(1))
-        return f"<{idents[-1]}>" if idents else "<v>"
-
-    return _PLACEHOLDER.sub(repl, literal)
 
 
 def collect_names():
     """{normalized_name: [file:line, ...]} for every literal metric name
     registered/bumped under paddle_tpu/."""
-    names = {}
-    for dirpath, _, files in os.walk(PKG):
-        for fn in files:
-            if not fn.endswith(".py"):
-                continue
-            path = os.path.join(dirpath, fn)
-            if path in _SKIP_FILES:
-                continue
-            with open(path, encoding="utf-8") as f:
-                for lineno, line in enumerate(f, 1):
-                    for m in _CALL.finditer(line):
-                        name = _normalize(m.group(2), bool(m.group(1)))
-                        rel = os.path.relpath(path, ROOT)
-                        names.setdefault(name, []).append(
-                            f"{rel}:{lineno}")
-    return names
+    return _impl.collect_names(PKG, ROOT)
 
 
 def undocumented():
     """[(name, sites)] of metric names missing from COVERAGE.md."""
-    with open(COVERAGE, encoding="utf-8") as f:
-        text = f.read()
-    return sorted((name, sites) for name, sites in collect_names().items()
-                  if name not in text)
+    return _impl.undocumented(PKG, ROOT, COVERAGE)
 
 
 def documented_names(coverage_path=None):
-    """Metric names listed in the COVERAGE.md 'Metrics inventory' table
-    (first cell of each data row, header/separator skipped)."""
-    with open(coverage_path or COVERAGE, encoding="utf-8") as f:
-        text = f.read()
-    try:
-        section = text.split("### Metrics inventory", 1)[1]
-    except IndexError:
-        return []
-    # the inventory runs until the next heading
-    for stop in ("\n## ", "\n### "):
-        idx = section.find(stop)
-        if idx != -1:
-            section = section[:idx]
-    names = []
-    for line in section.splitlines():
-        m = _DOC_ROW.match(line.strip())
-        if not m:
-            continue
-        name = m.group(1)
-        if name in ("Name",) or set(name) <= {"-", ":"}:
-            continue  # table header / separator
-        names.append(name)
-    return names
-
-
-def _source_blob():
-    parts = []
-    for dirpath, _, files in os.walk(PKG):
-        for fn in files:
-            if fn.endswith(".py"):
-                with open(os.path.join(dirpath, fn),
-                          encoding="utf-8") as f:
-                    parts.append(f.read())
-    return "\n".join(parts)
+    """Metric names listed in the COVERAGE.md 'Metrics inventory'
+    table."""
+    return _impl.documented_names(coverage_path or COVERAGE)
 
 
 def stale_documented(coverage_path=None):
     """[name] of inventory rows whose metric no longer appears in the
-    code — the doc→code direction. A name missing from the call-site
-    scan gets a second chance against the raw source (some counters are
-    bumped through name tables, e.g. the splash kernel's _keys dict);
-    `<token>` wildcards match any f-string placeholder."""
-    live = set(collect_names())
-    blob = None
-    out = []
-    for name in documented_names(coverage_path):
-        if name in live:
-            continue
-        if blob is None:
-            blob = _source_blob()
-        if "<" in name:
-            pat = re.compile(r"\{[^{}]*\}".join(
-                re.escape(frag)
-                for frag in re.split(r"<[^>]*>", name)))
-            if pat.search(blob):
-                continue
-        elif name in blob:
-            continue
-        out.append(name)
-    return sorted(out)
+    code."""
+    return _impl.stale_documented(PKG, ROOT, coverage_path or COVERAGE)
 
 
 def main() -> int:

@@ -1,0 +1,98 @@
+#!/usr/bin/env python
+"""tracecheck runner: the repo's AST static-analysis suite.
+
+    python tools/lint.py                 # all passes over paddle_tpu/
+    python tools/lint.py --json          # machine-readable findings
+    python tools/lint.py --rule flag-in-trace --rule lock-discipline
+    python tools/lint.py --list-rules
+
+Exit codes (the CI contract, enforced by tests/test_tracecheck.py):
+  0  clean — no findings
+  1  findings reported
+  2  internal error (the linter itself failed; never confuse a broken
+     linter with a clean tree)
+
+Rules live in tools/tracecheck/rules/; suppress one finding with a
+same-line or preceding-line comment `# lint: allow(<rule>): <reason>`
+(the reason is mandatory). Run as a tier-1 gate by
+tests/test_lint_clean.py.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import traceback
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, os.path.join(ROOT, "tools"))
+
+import tracecheck  # noqa: E402
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(
+        prog="lint.py", description=__doc__,
+        formatter_class=argparse.RawDescriptionHelpFormatter)
+    p.add_argument("--json", action="store_true",
+                   help="emit findings as JSON on stdout")
+    p.add_argument("--rule", action="append", default=None,
+                   metavar="NAME", help="run only this pass (repeatable)")
+    p.add_argument("--pkg", default=os.path.join(ROOT, "paddle_tpu"),
+                   help="python tree to lint (default: paddle_tpu/)")
+    p.add_argument("--repo", default=ROOT,
+                   help="repo root holding README/COVERAGE "
+                        "(default: this repo)")
+    p.add_argument("--list-rules", action="store_true",
+                   help="print the registered passes and exit")
+    args = p.parse_args(argv)
+
+    if args.list_rules:
+        for name in sorted(tracecheck.RULES):
+            print(f"{name}: {tracecheck.RULES[name].doc}")
+        return 0
+
+    try:
+        ctx = tracecheck.load_context(args.pkg, args.repo)
+        if not ctx.modules and not ctx.parse_errors:
+            # a typo'd --pkg must never report a clean tree it never
+            # scanned
+            print(f"tracecheck: no python modules under {args.pkg!r} — "
+                  f"wrong --pkg path?", file=sys.stderr)
+            return 2
+        findings = tracecheck.run_rules(ctx, args.rule)
+    except Exception:
+        traceback.print_exc()
+        print("tracecheck: internal error (see traceback above)",
+              file=sys.stderr)
+        return 2
+
+    if args.json:
+        print(json.dumps({
+            "ok": not findings,
+            "modules": len(ctx.modules),
+            "rules": args.rule or sorted(tracecheck.RULES),
+            "findings": [f.to_dict() for f in findings],
+        }, indent=2))
+        return 1 if findings else 0
+
+    if not findings:
+        n = len(args.rule or tracecheck.RULES)
+        print(f"tracecheck: OK — {n} passes over {len(ctx.modules)} "
+              f"modules, no findings")
+        return 0
+    for f in findings:
+        print(f.format(), file=sys.stderr)
+    by_rule = {}
+    for f in findings:
+        by_rule[f.rule] = by_rule.get(f.rule, 0) + 1
+    summary = ", ".join(f"{k}: {v}" for k, v in sorted(by_rule.items()))
+    print(f"tracecheck: {len(findings)} finding(s) ({summary}) — fix "
+          f"each, or suppress with `# lint: allow(<rule>): <reason>`",
+          file=sys.stderr)
+    return 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,27 @@
+"""tracecheck — AST static analysis encoding this repo's invariants.
+
+PaddlePaddle's C++ core enforces its invariants structurally
+(`PADDLE_ENFORCE*`, per-op registration checks); a pure-Python
+reproduction has nothing equivalent, and the CHANGES.md record shows
+the cost: the same bug classes (flags baked at trace time, use after
+donation, the scalar+array advanced-indexing batch-dim-front trap,
+gauges summed like counters, lock-free thread-shared state) were each
+caught only by manual review, sometimes on the second or third try.
+tracecheck machine-checks them: a shared AST framework (module loader,
+trace-context inference, `# lint: allow(<rule>): <reason>`
+suppressions) plus one rule pass per trap class.
+
+Run via `python tools/lint.py` (human or `--json` output; exit 0 clean,
+1 findings, 2 internal error) or the tier-1 test
+`tests/test_lint_clean.py`.
+"""
+from __future__ import annotations
+
+from .core import (Context, Finding, Module, RULES, load_context, rule,
+                   run_rules)
+
+# importing the rules package registers every pass in RULES
+from . import rules  # noqa: E402,F401  (import for side effect)
+
+__all__ = ["Context", "Finding", "Module", "RULES", "load_context",
+           "rule", "run_rules"]

@@ -1,0 +1,290 @@
+"""tracecheck core: module loading, suppressions, findings, rule registry.
+
+The framework is deliberately execution-free: every pass works on
+`ast` trees + raw source text, so linting `paddle_tpu/` never imports
+it (no jax initialization, no device probing — the linter must run in
+CI processes that have neither).
+
+Suppressions: a finding is silenced by a comment on the SAME line or
+the line DIRECTLY ABOVE it, spelled
+
+    # lint: allow(<rule-name>): <reason>
+
+The reason is mandatory — an allow() without one is itself reported
+(rule `bad-suppression`, unsuppressable), which is how the tree stays
+at zero unexplained suppressions.
+"""
+from __future__ import annotations
+
+import ast
+import io
+import os
+import re
+import tokenize
+from typing import Callable, Dict, List, Optional
+
+__all__ = ["Finding", "Module", "Context", "RULES", "rule",
+           "load_context", "run_rules", "parent_map", "terminal_name",
+           "node_source", "own_nodes"]
+
+_ALLOW = re.compile(
+    r"#\s*lint:\s*allow\(\s*([A-Za-z0-9_-]+)\s*\)\s*(?::\s*(\S.*?))?\s*$")
+# anything that LOOKS like an allow but fails the strict form above
+# (dangling colon, reason without the colon, unclosed paren...) must be
+# reported, not silently ignored — a typo'd suppression that neither
+# suppresses nor surfaces would strand the author
+_ALLOW_ANY = re.compile(r"#\s*lint:\s*allow\b")
+
+
+class Finding:
+    """One rule violation at one source location."""
+
+    __slots__ = ("rule", "path", "line", "message")
+
+    def __init__(self, rule: str, path: str, line: int, message: str):
+        self.rule = rule
+        self.path = path
+        self.line = int(line)
+        self.message = message
+
+    def to_dict(self) -> dict:
+        return {"rule": self.rule, "path": self.path, "line": self.line,
+                "message": self.message}
+
+    def format(self) -> str:
+        return f"{self.path}:{self.line}: [{self.rule}] {self.message}"
+
+    def __repr__(self):  # pragma: no cover - debug aid
+        return f"Finding({self.format()!r})"
+
+
+class Module:
+    """One parsed source file: tree + lines + suppression table."""
+
+    def __init__(self, path: str, rel: str, dotted: str, source: str):
+        self.path = path          # absolute
+        self.rel = rel            # repo-relative, for display
+        self.dotted = dotted      # e.g. "paddle_tpu.serving.engine"
+        self.source = source
+        self.lines = source.splitlines()
+        self.tree = ast.parse(source, filename=path)
+        # line -> [(rule, reason-or-None)] — scanned over real COMMENT
+        # tokens only, so allow-shaped text inside string literals /
+        # docstrings (e.g. docs quoting the suppression syntax) is
+        # never a suppression
+        self.allows: Dict[int, List[tuple]] = {}
+        self.malformed_allows: List[int] = []
+        for i, text in self._comments():
+            m = _ALLOW.search(text)
+            if m:
+                self.allows.setdefault(i, []).append(
+                    (m.group(1), m.group(2)))
+            elif _ALLOW_ANY.search(text):
+                self.malformed_allows.append(i)
+
+    def _comments(self):
+        """(line, comment_text) for every comment token. The source
+        already parsed as python, so tokenization failing would be a
+        bug — let it propagate."""
+        toks = tokenize.generate_tokens(io.StringIO(self.source).readline)
+        return [(tok.start[0], tok.string) for tok in toks
+                if tok.type == tokenize.COMMENT]
+
+    def allowed(self, rule_name: str, line: int) -> bool:
+        """Is `rule_name` suppressed at `line` (same line or the one
+        above), with a written reason?"""
+        for at in (line, line - 1):
+            for r, reason in self.allows.get(at, ()):
+                if r == rule_name and reason:
+                    return True
+        return False
+
+    def window(self, line: int, radius: int) -> str:
+        """Source text of lines [line-radius, line+radius] (1-based)."""
+        lo = max(0, line - 1 - radius)
+        return "\n".join(self.lines[lo:line + radius])
+
+
+class Context:
+    """Everything a rule pass may look at.
+
+    `pkg_root` is the python tree being linted (normally
+    `<repo>/paddle_tpu`); `repo_root` holds the documentation files some
+    passes cross-check (README.md / COVERAGE.md) — for fixture corpora
+    the two may coincide and the docs may be absent, in which case the
+    doc passes skip silently.
+    """
+
+    def __init__(self, pkg_root: str, repo_root: Optional[str] = None):
+        self.pkg_root = os.path.abspath(pkg_root)
+        self.repo_root = os.path.abspath(repo_root or
+                                         os.path.dirname(self.pkg_root))
+        self.modules: List[Module] = []
+        self.parse_errors: List[Finding] = []
+        self._trace = None      # lazily built TraceContext
+        self._parents = {}      # module -> {child node: parent node}
+
+    # -- loading -----------------------------------------------------------
+
+    def load(self) -> "Context":
+        pkg_name = os.path.basename(self.pkg_root)
+        for dirpath, dirnames, files in os.walk(self.pkg_root):
+            dirnames[:] = sorted(d for d in dirnames
+                                 if d != "__pycache__")
+            for fn in sorted(files):
+                if not fn.endswith(".py"):
+                    continue
+                path = os.path.join(dirpath, fn)
+                rel = os.path.relpath(path, self.repo_root)
+                sub = os.path.relpath(path, self.pkg_root)
+                parts = [pkg_name] + sub[:-3].split(os.sep)
+                if parts[-1] == "__init__":
+                    parts.pop()
+                dotted = ".".join(parts)
+                with open(path, encoding="utf-8") as f:
+                    src = f.read()
+                try:
+                    self.modules.append(Module(path, rel, dotted, src))
+                except SyntaxError as e:
+                    self.parse_errors.append(Finding(
+                        "parse-error", rel, getattr(e, "lineno", 1) or 1,
+                        f"file does not parse: {e.msg}"))
+        return self
+
+    # -- shared analyses ----------------------------------------------------
+
+    def trace(self):
+        """The trace-reachability analysis, built once per context."""
+        if self._trace is None:
+            from .tracectx import TraceContext
+            self._trace = TraceContext(self)
+        return self._trace
+
+    def parents(self, mod: Module) -> dict:
+        p = self._parents.get(mod)
+        if p is None:
+            p = self._parents[mod] = parent_map(mod.tree)
+        return p
+
+
+# -- ast utilities -----------------------------------------------------------
+
+def parent_map(tree: ast.AST) -> dict:
+    """{child: parent} over the whole tree (lexical ancestry lookups)."""
+    parents = {}
+    for node in ast.walk(tree):
+        for child in ast.iter_child_nodes(node):
+            parents[child] = node
+    return parents
+
+
+def terminal_name(node: ast.AST) -> Optional[str]:
+    """Last identifier of a Name / dotted Attribute (`jax.jit` -> "jit",
+    `flag` -> "flag"); None for anything else."""
+    if isinstance(node, ast.Attribute):
+        return node.attr
+    if isinstance(node, ast.Name):
+        return node.id
+    return None
+
+
+def node_source(node: ast.AST) -> str:
+    try:
+        return ast.unparse(node)
+    except Exception:  # pragma: no cover - very old nodes
+        return "<expr>"
+
+
+def own_nodes(func_node: ast.AST, include_lambdas: bool = True):
+    """Walk a function's own BODY statements without descending into
+    nested def/async-def bodies — those are separate functions with
+    their own verdicts. Argument defaults and decorator expressions are
+    excluded too: they execute once at def time (they are the sanctioned
+    snapshot position, not an in-trace read). Lambda bodies are included
+    by default (they execute where they are called, e.g. under the
+    enclosing trace); pass include_lambdas=False when deferred execution
+    would make a statement-ordering analysis lie (use-after-donate's
+    load/store sequencing)."""
+    body = getattr(func_node, "body", None)
+    if body is None:
+        stack = list(ast.iter_child_nodes(func_node))
+    elif isinstance(body, list):
+        stack = list(body)
+    else:
+        stack = [body]  # Lambda: body is a single expression
+    while stack:
+        node = stack.pop()
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        if not include_lambdas and isinstance(node, ast.Lambda):
+            continue
+        yield node
+        stack.extend(ast.iter_child_nodes(node))
+
+
+# -- rule registry -----------------------------------------------------------
+
+RULES: Dict[str, "Rule"] = {}
+
+
+class Rule:
+    __slots__ = ("name", "doc", "check")
+
+    def __init__(self, name: str, doc: str,
+                 check: Callable[[Context], List[Finding]]):
+        self.name = name
+        self.doc = doc
+        self.check = check
+
+
+def rule(name: str, doc: str):
+    """Decorator registering `check(ctx) -> [Finding]` under `name`."""
+    def deco(fn):
+        RULES[name] = Rule(name, doc, fn)
+        return fn
+    return deco
+
+
+def load_context(pkg_root: str, repo_root: Optional[str] = None) -> Context:
+    return Context(pkg_root, repo_root).load()
+
+
+def run_rules(ctx: Context, names=None) -> List[Finding]:
+    """Run the selected passes (default: all) and return the surviving
+    findings: parse errors first, then per-rule findings minus reasoned
+    suppressions, plus one `bad-suppression` finding for every allow()
+    that lacks a reason."""
+    out: List[Finding] = list(ctx.parse_errors)
+    # set(): a repeated --rule flag must not run a pass twice and
+    # duplicate every finding
+    selected = sorted(RULES) if names is None else sorted(set(names))
+    for n in selected:
+        if n not in RULES:
+            raise KeyError(f"unknown rule {n!r}; known: {sorted(RULES)}")
+    for n in selected:
+        for f in RULES[n].check(ctx):
+            mod = next((m for m in ctx.modules if m.rel == f.path), None)
+            if mod is not None and mod.allowed(f.rule, f.line):
+                continue
+            out.append(f)
+    for mod in ctx.modules:
+        for line, entries in sorted(mod.allows.items()):
+            for rname, reason in entries:
+                if not reason:
+                    out.append(Finding(
+                        "bad-suppression", mod.rel, line,
+                        f"allow({rname}) without a reason — every "
+                        f"suppression must say WHY (`# lint: "
+                        f"allow({rname}): <reason>`)"))
+                elif rname not in RULES and rname != "bad-suppression":
+                    out.append(Finding(
+                        "bad-suppression", mod.rel, line,
+                        f"allow({rname}) names an unknown rule "
+                        f"(known: {', '.join(sorted(RULES))})"))
+        for line in mod.malformed_allows:
+            out.append(Finding(
+                "bad-suppression", mod.rel, line,
+                "malformed allow comment (it suppresses NOTHING) — "
+                "spell it `# lint: allow(<rule>): <reason>`"))
+    out.sort(key=lambda f: (f.path, f.line, f.rule))
+    return out

@@ -1,0 +1,12 @@
+"""Rule passes. Importing this package registers every rule in
+`tracecheck.core.RULES` (each module calls the `@rule` decorator at
+import time)."""
+from __future__ import annotations
+
+from . import (flag_in_trace, flags_inventory, gauge_discipline,  # noqa: F401
+               lock_discipline, scatter_batch_dim, stats_doc,
+               use_after_donate)
+
+__all__ = ["flag_in_trace", "flags_inventory", "gauge_discipline",
+           "lock_discipline", "scatter_batch_dim", "stats_doc",
+           "use_after_donate"]

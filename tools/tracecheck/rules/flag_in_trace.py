@@ -1,0 +1,64 @@
+"""flag-in-trace: FLAGS reads inside trace-reachable bodies.
+
+Origin (CHANGES.md, PR 6): the splash-attention backward rule read
+`FLAGS_flash_block_*` at trace time; flipping the flag between the
+forward and backward trace desynced the two kernels' tile choices.
+The fix — snapshot the flag OUTSIDE the trace and thread it through as
+a static argument (`ops/splash_ops.py` "Tile sizes are snapshotted
+here") — is what this pass enforces everywhere.
+
+A `flag(...)` / `get_flags(...)` call, or a bare `FLAGS_*` name read,
+inside a function the trace-context analysis marks reachable from
+`jax.jit`/`pjit`/`shard_map`/`custom_vjp` executes ONCE per trace and
+is baked into the executable: later `set_flags` calls silently do
+nothing for already-compiled shapes, and flag-dependent *structure*
+(which kernel, which tile) can desync across separately-traced
+programs. Deliberate trace-time dispatch (the documented "python `if`
+under jit" pattern) must carry an `allow()` naming that contract.
+"""
+from __future__ import annotations
+
+import ast
+
+from ..core import Context, Finding, own_nodes, rule, terminal_name
+
+_FLAG_CALLS = {"flag", "get_flags"}
+
+
+@rule("flag-in-trace",
+      "FLAGS_* / flag() reads inside trace-reachable bodies bake the "
+      "value into the compiled executable; snapshot outside the trace "
+      "and thread as a static arg")
+def check(ctx: Context):
+    out = []
+    tc = ctx.trace()
+    # a trace-rooted lambda's body is walked twice — under the
+    # enclosing function (own_nodes includes lambda bodies) and again
+    # as its own FuncInfo — so dedup flag reads by node identity
+    seen = set()
+    for info in tc.traced_functions():
+        why = tc.why(info.key)
+        for node in own_nodes(info.node):
+            if id(node) in seen:
+                continue
+            if isinstance(node, ast.Call) and \
+                    terminal_name(node.func) in _FLAG_CALLS:
+                seen.add(id(node))
+                out.append(Finding(
+                    "flag-in-trace", info.module.rel, node.lineno,
+                    f"{ast.unparse(node.func)}(...) inside "
+                    f"trace-reachable `{info.key[1]}` ({why}): the "
+                    f"value is read once at trace time and baked into "
+                    f"the executable — snapshot it outside the traced "
+                    f"function and pass it as a static argument"))
+            elif isinstance(node, ast.Name) and \
+                    isinstance(node.ctx, ast.Load) and \
+                    node.id.startswith("FLAGS_"):
+                seen.add(id(node))
+                out.append(Finding(
+                    "flag-in-trace", info.module.rel, node.lineno,
+                    f"global `{node.id}` read inside trace-reachable "
+                    f"`{info.key[1]}` ({why}): mutable-global reads "
+                    f"under trace are frozen at trace time — thread "
+                    f"the value in as an argument"))
+    return out

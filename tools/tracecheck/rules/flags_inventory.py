@@ -1,0 +1,84 @@
+"""flags-inventory: bidirectional lint between the FLAGS registry and docs.
+
+PR 8 added 12 FLAGS_* knobs and their documentation landed only by
+convention; this pass closes that gap the same way `stats-doc` closed
+it for metrics. Code → doc: every flag registered in
+`framework/flags.py` must be mentioned in README.md or COVERAGE.md
+(the deployment-facing surfaces). Doc → code: every `FLAGS_*` token
+those documents mention must still be a registered flag — a renamed or
+deleted flag must take its doc mentions with it.
+"""
+from __future__ import annotations
+
+import ast
+import os
+import re
+from typing import Dict, List, Tuple
+
+from ..core import Context, Finding, rule
+
+_DOC_FILES = ("README.md", "COVERAGE.md")
+_TOKEN = re.compile(r"\bFLAGS_[A-Za-z0-9_]+")
+
+
+def registered_flags(flags_path: str) -> Dict[str, int]:
+    """{flag name: line} of every literal `register_flag("...")` call."""
+    with open(flags_path, encoding="utf-8") as f:
+        tree = ast.parse(f.read(), filename=flags_path)
+    out: Dict[str, int] = {}
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Call) and \
+                isinstance(node.func, ast.Name) and \
+                node.func.id == "register_flag" and node.args and \
+                isinstance(node.args[0], ast.Constant) and \
+                isinstance(node.args[0].value, str):
+            out.setdefault(node.args[0].value, node.lineno)
+    return out
+
+
+def documented_flags(repo_root: str) -> Dict[str, Tuple[str, int]]:
+    """{flag token: (doc rel path, first line mentioning it)}."""
+    out: Dict[str, Tuple[str, int]] = {}
+    for doc in _DOC_FILES:
+        path = os.path.join(repo_root, doc)
+        if not os.path.exists(path):
+            continue
+        with open(path, encoding="utf-8") as f:
+            for lineno, line in enumerate(f, 1):
+                for m in _TOKEN.finditer(line):
+                    tok = m.group(0)
+                    if tok.endswith("_"):
+                        continue  # `FLAGS_serving_*`-style family globs
+                    out.setdefault(tok, (doc, lineno))
+    return out
+
+
+@rule("flags-inventory",
+      "every FLAGS_* registered in framework/flags.py is documented in "
+      "README/COVERAGE and every documented FLAGS_* still exists")
+def check(ctx: Context):
+    flags_path = os.path.join(ctx.pkg_root, "framework", "flags.py")
+    if not os.path.exists(flags_path):
+        return []  # fixture corpora carry no flag registry
+    flags_rel = os.path.relpath(flags_path, ctx.repo_root)
+    registered = registered_flags(flags_path)
+    documented = documented_flags(ctx.repo_root)
+    if not documented and not registered:
+        return []
+    out: List[Finding] = []
+    for name, line in sorted(registered.items()):
+        if name not in documented:
+            out.append(Finding(
+                "flags-inventory", flags_rel, line,
+                f"flag `{name}` is registered here but never mentioned "
+                f"in {' or '.join(_DOC_FILES)} — add it to the "
+                f"COVERAGE.md 'Flags inventory' table (name, default, "
+                f"where read, meaning)"))
+    for name, (doc, line) in sorted(documented.items()):
+        if name not in registered:
+            out.append(Finding(
+                "flags-inventory", doc, line,
+                f"documentation mentions `{name}` but "
+                f"framework/flags.py registers no such flag — a "
+                f"rename/delete must take its doc mentions with it"))
+    return out

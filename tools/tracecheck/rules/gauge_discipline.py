@@ -1,0 +1,112 @@
+"""gauge-discipline: a stat name is a counter XOR a gauge, everywhere.
+
+Origin (CHANGES.md, PR 7): the cross-process delta relay sums counter
+deltas into the parent registry — summing a GAUGE (an absolute level:
+live HBM bytes, pages in use) across processes corrupts both sides,
+which is why `StatValue.set()`/`gauge_add()` mark the stat and the
+relay skips it. The discipline only works if a NAME is used one way
+everywhere: a single `stat_add` on a gauge-named stat un-marks nothing
+(the flag sticks) but double-counts the level into the relay, and a
+`stat_set` on a counter silently stops it relaying.
+
+The pass scans every literal/f-string stat-name call site, partitions
+names into gauge ops (`stat_set`/`stat_gauge_add`) vs counter ops
+(`stat_add`/`stat_sub`/`STAT_ADD`/`STAT_SUB`/`stat_time`), and flags
+every name used both ways. It then cross-checks COVERAGE.md's
+"Metrics inventory" Kind column (when present): a code-gauge must be
+documented as a gauge and a documented gauge must only see gauge ops
+— so the doc table and the relay's behavior can never disagree.
+"""
+from __future__ import annotations
+
+import ast
+import os
+from typing import Dict, List, Tuple
+
+from ..core import Context, Finding, rule, terminal_name
+from .stats_doc import inventory_rows, normalize_fstring_ast
+
+_GAUGE_OPS = {"stat_set", "stat_gauge_add"}
+_COUNTER_OPS = {"stat_add", "stat_sub", "STAT_ADD", "STAT_SUB",
+                "stat_time"}
+
+
+def _stat_sites(ctx: Context) -> Dict[str, Dict[str, List[Tuple[str, int]]]]:
+    """{normalized name: {"gauge": [(rel, line)], "counter": [...]}}"""
+    sites: Dict[str, Dict[str, List[Tuple[str, int]]]] = {}
+    for mod in ctx.modules:
+        if mod.rel.endswith(os.path.join("framework", "monitor.py")):
+            continue  # the registry itself defines the ops
+        for node in ast.walk(mod.tree):
+            if not isinstance(node, ast.Call) or not node.args:
+                continue
+            callee = terminal_name(node.func)
+            if callee in _GAUGE_OPS:
+                kind = "gauge"
+            elif callee in _COUNTER_OPS:
+                kind = "counter"
+            else:
+                continue
+            name = normalize_fstring_ast(node.args[0])
+            if name is None:
+                continue
+            sites.setdefault(name, {}).setdefault(kind, []).append(
+                (mod.rel, node.lineno))
+    return sites
+
+
+def documented_kinds(coverage_path: str) -> Dict[str, Tuple[str, int]]:
+    """{name: (kind cell lowercased, line)} from the COVERAGE.md
+    'Metrics inventory' table (stats_doc.inventory_rows is the one
+    parser of that table)."""
+    return {cells[0]: (cells[1].lower(), line)
+            for cells, line in inventory_rows(coverage_path)
+            if len(cells) >= 2}
+
+
+@rule("gauge-discipline",
+      "names registered via stat_set/stat_gauge_add must never be "
+      "stat_add/sub'ed (and vice versa), cross-checked against the "
+      "COVERAGE.md inventory Kind column")
+def check(ctx: Context):
+    out: List[Finding] = []
+    sites = _stat_sites(ctx)
+    for name, kinds in sorted(sites.items()):
+        if "gauge" in kinds and "counter" in kinds:
+            g = kinds["gauge"][0]
+            for rel, line in kinds["counter"]:
+                out.append(Finding(
+                    "gauge-discipline", rel, line,
+                    f"`{name}` is a gauge (stat_set/stat_gauge_add at "
+                    f"{g[0]}:{g[1]}) but is bumped with a counter op "
+                    f"here: the relay would sum a LEVEL across "
+                    f"processes — pick one discipline per name"))
+    cov = os.path.join(ctx.repo_root, "COVERAGE.md")
+    if not os.path.exists(cov):
+        return out
+    doc = documented_kinds(cov)
+    covrel = os.path.relpath(cov, ctx.repo_root)
+    for name, kinds in sorted(sites.items()):
+        entry = doc.get(name)
+        if entry is None:
+            continue  # stats-doc owns the missing-row direction
+        kind, doc_line = entry
+        if "gauge" in kinds and "gauge" not in kind:
+            rel, line = kinds["gauge"][0]
+            out.append(Finding(
+                "gauge-discipline", rel, line,
+                f"`{name}` uses gauge ops here but COVERAGE.md "
+                f"({covrel}:{doc_line}) documents it as `{kind}` — "
+                f"fix whichever side is wrong"))
+        if "counter" in kinds and "gauge" in kind and \
+                "gauge" not in kinds:
+            rel, line = kinds["counter"][0]
+            out.append(Finding(
+                "gauge-discipline", rel, line,
+                f"`{name}` is bumped only with counter ops "
+                f"(stat_add/stat_sub) but COVERAGE.md "
+                f"({covrel}:{doc_line}) documents it as `{kind}`: "
+                f"counter-op stats ARE drained and relayed across "
+                f"processes — document it as an up/down counter, or "
+                f"convert the code to stat_set/stat_gauge_add"))
+    return out

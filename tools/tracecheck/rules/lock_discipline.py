@@ -1,0 +1,174 @@
+"""lock-discipline: thread-shared engine state mutated lock-free.
+
+Origin (CHANGES.md, PR 3): the serving engine's per-lane compile
+accounting was mutated from both the dispatcher and the completer
+without a lock and double-counted traces; the fix serialized the
+replica behind `_run_lock`. The serving/profiler classes are exactly
+the multi-threaded surface (collector / lane dispatcher / lane
+completer / step loop / sampler threads + the caller's own thread),
+so this pass is scoped to `serving/` and `profiler/`.
+
+Heuristic, per class: **entry points** are (a) every method handed to
+`threading.Thread(target=...)` — one entry per thread — and (b) the
+caller's thread, covering every public method. Construction
+(`__init__` and anything reachable only from it) happens-before the
+threads start and is exempt. Contention is tracked per ATTRIBUTE (the
+PR 3 bug mutated the same counter from the dispatcher loop and the
+completer loop — two methods each reachable from only one entry, so a
+method-level rule would miss its own origin incident): every
+`self.<attr> = ...` / `self.<attr> += ...` site is attributed to the
+entry points reaching its enclosing method, and an attribute mutated
+from ≥2 distinct entries has every mutation site that is not lexically
+under a `with <something>._lock/_cv` context flagged. Mutations the
+author knows are safe (holding the lock at every call site,
+happens-before orderings) carry an `allow()` naming the protocol —
+that written reason is the point.
+"""
+from __future__ import annotations
+
+import ast
+import os
+import re
+from typing import Dict, List, Optional, Set
+
+from ..core import Context, Finding, Module, rule, terminal_name
+
+_SCOPES = ("serving", "profiler")
+_LOCKISH = re.compile(r"(?:^|[._])(?:[a-z_]*lock|cv|cond|mutex)\w*$",
+                      re.I)
+
+
+def _in_scope(ctx: Context, mod: Module) -> bool:
+    rel_pkg = os.path.relpath(mod.path, ctx.pkg_root)
+    top = rel_pkg.split(os.sep, 1)[0]
+    return top in _SCOPES
+
+
+def _self_attr_target(node: ast.AST) -> Optional[str]:
+    """'attr' when `node` is self.<attr> (or a subscript of it)."""
+    if isinstance(node, ast.Subscript):
+        return _self_attr_target(node.value)
+    if isinstance(node, ast.Attribute) and \
+            isinstance(node.value, ast.Name) and node.value.id == "self":
+        return node.attr
+    return None
+
+
+def _under_lock(mod: Module, ctx: Context, node: ast.AST) -> bool:
+    """Is `node` lexically inside a `with <lock-ish>` block? The lock
+    expression may live on any object (`self._cv`, `eng._stats_lock`,
+    `self.engine._run_lock`) — what matters is that SOME lock is held."""
+    parents = ctx.parents(mod)
+    cur = parents.get(node)
+    while cur is not None and not isinstance(
+            cur, (ast.FunctionDef, ast.AsyncFunctionDef)):
+        if isinstance(cur, (ast.With, ast.AsyncWith)):
+            for item in cur.items:
+                expr = item.context_expr
+                if isinstance(expr, ast.Call):
+                    expr = expr.func
+                name = ast.unparse(expr) if expr is not None else ""
+                if _LOCKISH.search(name):
+                    return True
+        cur = parents.get(cur)
+    return False
+
+
+class _ClassInfo:
+    def __init__(self, node: ast.ClassDef):
+        self.node = node
+        self.methods: Dict[str, ast.AST] = {
+            n.name: n for n in node.body
+            if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))}
+        self.thread_targets: Set[str] = set()
+        self.calls: Dict[str, Set[str]] = {}
+        for name, mnode in self.methods.items():
+            calls: Set[str] = set()
+            for sub in ast.walk(mnode):
+                if isinstance(sub, ast.Call):
+                    if terminal_name(sub.func) in ("Thread", "Timer"):
+                        for kw in sub.keywords:
+                            if kw.arg == "target":
+                                t = _self_attr_target(kw.value)
+                                if t in self.methods:
+                                    self.thread_targets.add(t)
+                    t = _self_attr_target(sub.func)
+                    if t in self.methods:
+                        calls.add(t)
+            self.calls[name] = calls
+
+    def reachable_from(self, seeds: Set[str]) -> Set[str]:
+        seen = set(seeds)
+        work = list(seeds)
+        while work:
+            m = work.pop()
+            for callee in self.calls.get(m, ()):
+                if callee not in seen:
+                    seen.add(callee)
+                    work.append(callee)
+        return seen
+
+
+@rule("lock-discipline",
+      "self.* mutations in serving/profiler methods reachable from "
+      "more than one thread entry point must sit under a lock context")
+def check(ctx: Context):
+    out: List[Finding] = []
+    for mod in ctx.modules:
+        if not _in_scope(ctx, mod):
+            continue
+        for cnode in ast.walk(mod.tree):
+            if not isinstance(cnode, ast.ClassDef):
+                continue
+            ci = _ClassInfo(cnode)
+            if not ci.thread_targets:
+                continue  # single-threaded class: out of scope
+            entries: Dict[str, Set[str]] = {
+                f"thread:{t}": {t} for t in ci.thread_targets}
+            public = {m for m in ci.methods
+                      if not m.startswith("_") or m in ("__enter__",
+                                                        "__exit__")}
+            if public:
+                entries["caller"] = public
+            reach: Dict[str, Set[str]] = {}
+            for entry, seeds in entries.items():
+                for m in ci.reachable_from(seeds):
+                    reach.setdefault(m, set()).add(entry)
+            # per-attribute mutation sites: attr -> entries touching it,
+            # and the (method, node, locked?) sites themselves
+            attr_entries: Dict[str, Set[str]] = {}
+            attr_sites: Dict[str, list] = {}
+            for mname, from_entries in sorted(reach.items()):
+                if mname == "__init__" or not from_entries:
+                    continue  # construction happens-before the threads
+                for sub in ast.walk(ci.methods[mname]):
+                    if isinstance(sub, (ast.Assign, ast.AugAssign)):
+                        targets = (sub.targets
+                                   if isinstance(sub, ast.Assign)
+                                   else [sub.target])
+                        for tgt in targets:
+                            attr = _self_attr_target(tgt)
+                            if attr is None:
+                                continue
+                            attr_entries.setdefault(
+                                attr, set()).update(from_entries)
+                            attr_sites.setdefault(attr, []).append(
+                                (mname, sub,
+                                 _under_lock(mod, ctx, sub)))
+            for attr, ents in sorted(attr_entries.items()):
+                if len(ents) < 2:
+                    continue
+                for mname, sub, locked in attr_sites[attr]:
+                    if locked:
+                        continue
+                    ent_list = ", ".join(sorted(ents))
+                    out.append(Finding(
+                        "lock-discipline", mod.rel, sub.lineno,
+                        f"`self.{attr}` mutated in "
+                        f"`{cnode.name}.{mname}` without a lock, but "
+                        f"the attribute is written from "
+                        f"{len(ents)} thread entry points "
+                        f"({ent_list}) — take the lock, or allow() "
+                        f"naming the happens-before/caller-holds-"
+                        f"lock protocol that makes it safe"))
+    return out

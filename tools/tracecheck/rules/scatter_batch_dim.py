@@ -1,0 +1,129 @@
+"""scatter-batch-dim: the mixed advanced-indexing batch-dim-front trap.
+
+Origin (CHANGES.md, PR 8 and again PR 9): numpy/jax advanced-indexing
+semantics move the broadcast index-block's dimensions to the FRONT of
+the result whenever the advanced indices are NON-CONTIGUOUS (separated
+by slices) — the classic instance being a scalar layer index plus
+per-row page-id arrays: `pages.at[layer, :, page_ids, offsets]` puts
+the batch dim first, silently transposing whatever is scattered or
+gathered. Found by hand twice (paged pool writes, then again in the
+int8 requant path); this pass finds it structurally.
+
+Flagged: any `.at[...]` update, and any plain subscript *gather* on a
+pool-like name (`*pages*` / `*pool*` / `*scales*`), whose index tuple
+contains ≥2 advanced (non-slice) indices at non-adjacent positions —
+UNLESS the surrounding ±4 lines or the enclosing function's docstring
+acknowledge the layout (a `moveaxis`/`transpose`/`swapaxes` call or
+the words "batch dim"). Acknowledged sites are the documented-
+transpose idiom; everything else is a latent transpose bug.
+"""
+from __future__ import annotations
+
+import ast
+import re
+from typing import List, Optional
+
+from ..core import Context, Finding, Module, rule
+
+_POOLISH = re.compile(r"(pages|pool|scales)", re.I)
+_ACK = re.compile(r"moveaxis|transpose|swapaxes|batch\s+dim", re.I)
+
+
+def _index_elements(sl: ast.AST) -> Optional[List[ast.AST]]:
+    if isinstance(sl, ast.Tuple):
+        return list(sl.elts)
+    return None
+
+
+def _advanced_positions(elts: List[ast.AST]) -> List[int]:
+    """Positions of non-slice (advanced) index elements. Ellipsis and
+    None (newaxis) conservatively end the analysis (return []), and so
+    does an all-integer-literal index tuple: with no array anywhere it
+    is BASIC indexing, which never reorders dims. (With at least one
+    array present, scalar ints join the broadcast block — that mixed
+    case is exactly the trap.)"""
+    def scalar_literal(e):
+        if isinstance(e, ast.UnaryOp) and \
+                isinstance(e.op, (ast.USub, ast.UAdd)):
+            e = e.operand  # -1 parses as UnaryOp(USub, Constant(1))
+        return isinstance(e, ast.Constant)
+
+    pos = []
+    arrayish = False
+    for i, e in enumerate(elts):
+        if isinstance(e, ast.Slice):
+            continue
+        if isinstance(e, ast.Constant) and e.value in (Ellipsis, None):
+            return []
+        if scalar_literal(e):
+            pos.append(i)  # scalar literal: advanced only alongside
+            continue       # an array
+        arrayish = True
+        pos.append(i)
+    return pos if arrayish else []
+
+
+def _enclosing_function(mod: Module, ctx: Context,
+                        node: ast.AST) -> Optional[ast.AST]:
+    parents = ctx.parents(mod)
+    cur = parents.get(node)
+    while cur is not None:
+        if isinstance(cur, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            return cur
+        cur = parents.get(cur)
+    return None
+
+
+def _acknowledged(mod: Module, ctx: Context, node: ast.Subscript) -> bool:
+    if _ACK.search(mod.window(node.lineno, 4)):
+        return True
+    fn = _enclosing_function(mod, ctx, node)
+    if fn is not None:
+        doc = ast.get_docstring(fn) or ""
+        if _ACK.search(doc):
+            return True
+    return False
+
+
+def _pool_gather_target(node: ast.Subscript) -> Optional[str]:
+    v = node.value
+    if isinstance(v, ast.Name) and _POOLISH.search(v.id):
+        return v.id
+    if isinstance(v, ast.Attribute) and _POOLISH.search(v.attr):
+        return v.attr
+    return None
+
+
+@rule("scatter-batch-dim",
+      "non-contiguous advanced indexing on .at[...] updates / paged-"
+      "pool gathers moves the batch dim to the front; require an "
+      "adjacent moveaxis or a documented transpose")
+def check(ctx: Context):
+    out = []
+    for mod in ctx.modules:
+        for node in ast.walk(mod.tree):
+            if not isinstance(node, ast.Subscript):
+                continue
+            is_at = (isinstance(node.value, ast.Attribute)
+                     and node.value.attr == "at")
+            target = None if is_at else _pool_gather_target(node)
+            if not is_at and target is None:
+                continue
+            elts = _index_elements(node.slice)
+            if not elts:
+                continue
+            adv = _advanced_positions(elts)
+            if len(adv) < 2 or adv[-1] - adv[0] + 1 == len(adv):
+                continue  # 0/1 advanced, or a contiguous block: in place
+            if _acknowledged(mod, ctx, node):
+                continue
+            what = (".at[...] update" if is_at
+                    else f"gather on `{target}`")
+            out.append(Finding(
+                "scatter-batch-dim", mod.rel, node.lineno,
+                f"{what} mixes advanced indices at non-adjacent "
+                f"positions {adv} (slices in between): numpy semantics "
+                f"move the index-block dims to the FRONT of the result "
+                f"— add the moveaxis (and a comment) next to this "
+                f"expression, or document the intended transpose"))
+    return out

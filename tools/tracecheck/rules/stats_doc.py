@@ -1,0 +1,212 @@
+"""stats-doc: bidirectional lint between stat names and COVERAGE.md.
+
+The sixth pass — `tools/check_stats.py` (PR 5) migrated into the
+framework; the standalone script remains as a CLI-compatible shim over
+the functions below.
+
+Code → doc: every STAT counter / histogram name bumped anywhere under
+the package must be documented in COVERAGE.md's "Metrics inventory"
+section. Doc → code: every inventory row must still correspond to a
+name in the code. F-string placeholders normalize to a `<token>`
+wildcard built from the expression's last identifier
+(`f"STAT_serving_lane{self.index}_batches"` →
+`STAT_serving_lane<index>_batches`).
+"""
+from __future__ import annotations
+
+import ast
+import os
+import re
+from typing import Dict, List, Optional
+
+from ..core import Context, Finding, rule
+
+_CALL = re.compile(
+    r'(?:\b(?:STAT_ADD|STAT_SUB|STAT_RESET|stat_add|stat_sub|stat_reset|'
+    r'stat_get|stat_set|stat_gauge_add|stat_time)|\bhistogram)'
+    r'\s*\(\s*(f?)"([^"]+)"')
+_PLACEHOLDER = re.compile(r"\{([^{}]*)\}")
+
+# monitor.py defines the registry; its docstrings/macro aliases are not
+# metric registrations
+_SKIP = os.path.join("framework", "monitor.py")
+
+
+def _normalize(literal: str, is_fstring: bool) -> str:
+    if not is_fstring:
+        return literal
+
+    def repl(m):
+        # strip the !conversion / :format-spec before extracting the
+        # expression's identifiers, so `{ms:.0f}` wildcards to `<ms>`
+        # exactly like the AST twin (whose FormattedValue.value never
+        # contains the spec)
+        expr = m.group(1).split("!", 1)[0].split(":", 1)[0]
+        idents = re.findall(r"[A-Za-z_][A-Za-z0-9_]*", expr)
+        return f"<{idents[-1]}>" if idents else "<v>"
+
+    return _PLACEHOLDER.sub(repl, literal)
+
+
+def normalize_fstring_ast(node: ast.AST) -> Optional[str]:
+    """AST twin of `_normalize` for passes that walk trees instead of
+    lines: a str Constant passes through, a JoinedStr's placeholders
+    become `<last-identifier>` wildcards, anything else is None."""
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return node.value
+    if isinstance(node, ast.JoinedStr):
+        parts = []
+        for v in node.values:
+            if isinstance(v, ast.Constant):
+                parts.append(str(v.value))
+            elif isinstance(v, ast.FormattedValue):
+                idents = re.findall(r"[A-Za-z_][A-Za-z0-9_]*",
+                                    ast.unparse(v.value))
+                parts.append(f"<{idents[-1]}>" if idents else "<v>")
+        return "".join(parts)
+    return None
+
+
+# -- shim-compatible API (tools/check_stats.py delegates here) ---------------
+
+def _iter_sources(pkg_root: str, repo_root: str, sources=None):
+    """(rel, source) pairs — from the preloaded {rel: source} map when
+    given (one Context load serves the whole lint run), else from disk
+    (the shim's standalone path)."""
+    if sources is not None:
+        yield from sorted(sources.items())
+        return
+    for dirpath, _, files in os.walk(pkg_root):
+        for fn in sorted(files):
+            if not fn.endswith(".py"):
+                continue
+            path = os.path.join(dirpath, fn)
+            with open(path, encoding="utf-8") as f:
+                yield os.path.relpath(path, repo_root), f.read()
+
+
+def collect_names(pkg_root: str, repo_root: str,
+                  sources=None) -> Dict[str, List[str]]:
+    """{normalized_name: [rel:line, ...]} for every literal metric name
+    registered/bumped under `pkg_root`."""
+    names: Dict[str, List[str]] = {}
+    for rel, src in _iter_sources(pkg_root, repo_root, sources):
+        if rel.endswith(_SKIP):
+            continue
+        for lineno, line in enumerate(src.splitlines(), 1):
+            for m in _CALL.finditer(line):
+                name = _normalize(m.group(2), bool(m.group(1)))
+                names.setdefault(name, []).append(f"{rel}:{lineno}")
+    return names
+
+
+def inventory_rows(coverage_path: str):
+    """[(cells, line)] for every data row of the COVERAGE.md 'Metrics
+    inventory' table (header/separator rows skipped); [] when the
+    section is absent. The ONE parser of that table — stats-doc and
+    gauge-discipline both consume it, so a format tweak cannot desync
+    them silently."""
+    with open(coverage_path, encoding="utf-8") as f:
+        text = f.read()
+    idx = text.find("### Metrics inventory")
+    if idx < 0:
+        return []
+    base_line = text[:idx].count("\n") + 1
+    out = []
+    for off, line in enumerate(text[idx:].splitlines()):
+        if off and line.startswith(("## ", "### ")):
+            break
+        s = line.strip()
+        if not s.startswith("|"):
+            continue
+        cells = [c.strip() for c in s.strip("|").split("|")]
+        if not cells or cells[0] == "Name" or \
+                set(cells[0]) <= {"-", ":"}:
+            continue
+        out.append((cells, base_line + off))
+    return out
+
+
+def documented_names(coverage_path: str) -> List[str]:
+    """Metric names listed in the COVERAGE.md 'Metrics inventory' table
+    (first cell of each data row)."""
+    return [cells[0] for cells, _ in inventory_rows(coverage_path)]
+
+
+def undocumented(pkg_root: str, repo_root: str, coverage_path: str,
+                 sources=None):
+    """[(name, sites)] of metric names missing from COVERAGE.md."""
+    with open(coverage_path, encoding="utf-8") as f:
+        text = f.read()
+    return sorted(
+        (name, sites)
+        for name, sites in collect_names(pkg_root, repo_root,
+                                         sources).items()
+        if name not in text)
+
+
+def _source_blob(pkg_root: str, repo_root: str, sources=None) -> str:
+    return "\n".join(src for _, src in
+                     _iter_sources(pkg_root, repo_root, sources))
+
+
+def stale_documented(pkg_root: str, repo_root: str,
+                     coverage_path: str, sources=None) -> List[str]:
+    """[name] of inventory rows whose metric no longer appears in the
+    code — the doc→code direction. A name missing from the call-site
+    scan gets a second chance against the raw source (some counters are
+    bumped through name tables); `<token>` wildcards match any f-string
+    placeholder."""
+    live = set(collect_names(pkg_root, repo_root, sources))
+    blob = None
+    out = []
+    for name in documented_names(coverage_path):
+        if name in live:
+            continue
+        if blob is None:
+            blob = _source_blob(pkg_root, repo_root, sources)
+        if "<" in name:
+            pat = re.compile(r"\{[^{}]*\}".join(
+                re.escape(frag)
+                for frag in re.split(r"<[^>]*>", name)))
+            if pat.search(blob):
+                continue
+        elif name in blob:
+            continue
+        out.append(name)
+    return sorted(out)
+
+
+@rule("stats-doc",
+      "every stat name bumped in code is documented in COVERAGE.md's "
+      "Metrics inventory, and every inventory row still exists in code")
+def check(ctx: Context):
+    coverage = os.path.join(ctx.repo_root, "COVERAGE.md")
+    if not os.path.exists(coverage):
+        return []  # fixture corpora carry no docs
+    sources = {m.rel: m.source for m in ctx.modules}
+    out = []
+    for name, sites in undocumented(ctx.pkg_root, ctx.repo_root,
+                                    coverage, sources):
+        rel, _, line = sites[0].rpartition(":")
+        out.append(Finding(
+            "stats-doc", rel, int(line),
+            f"metric `{name}` is bumped here but missing from the "
+            f"COVERAGE.md 'Metrics inventory' table — document it "
+            f"(f-string placeholders normalize to <token>); "
+            f"{len(sites)} site(s) total"))
+    stale = stale_documented(ctx.pkg_root, ctx.repo_root, coverage,
+                             sources)
+    if stale:
+        with open(coverage, encoding="utf-8") as f:
+            lines = f.read().splitlines()
+        covrel = os.path.relpath(coverage, ctx.repo_root)
+        for name in stale:
+            line = next((i for i, t in enumerate(lines, 1)
+                         if t.strip().startswith(f"| {name} ")), 1)
+            out.append(Finding(
+                "stats-doc", covrel, line,
+                f"COVERAGE.md inventory row `{name}` no longer "
+                f"corresponds to any metric in the code — remove the "
+                f"stale row (or restore the counter)"))
+    return out

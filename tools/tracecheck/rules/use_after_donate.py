@@ -1,0 +1,302 @@
+"""use-after-donate: referencing a buffer after a donating jit consumed it.
+
+Origin (CHANGES.md, PR 1 and PR 8): `donate_argnums` hands a buffer's
+memory to XLA — after the call the python reference points at a
+DELETED device buffer, and touching it raises (best case) or, via the
+poisoned-carry / donated-pool classes, corrupts state (worst case).
+The sanctioned idioms are: rebind the name from the call's result
+(`carry = step(carry, ...)` / `self._set_pools(out[:-1])`), or rebuild
+through the documented sync helpers (`_sync_carry`,
+`_sync_sharded_carry`, `_set_pools`, `_ensure_carry`).
+
+The pass finds, per module, every callable bound from
+`jax.jit(..., donate_argnums=...)` / `donate_argnames=...` (name or
+`self._x` attribute; int positions match positional args, str names
+match keyword args, and a non-literal spec conservatively counts EVERY
+argument), then flags any later read of a donated argument name in the
+same function body that is not preceded by a rebinding store or a
+sanctioned rebuild call.
+"""
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Set, Tuple
+
+from ..core import Context, Finding, Module, own_nodes, rule, \
+    terminal_name
+
+_JIT_NAMES = {"jit", "pjit"}
+# sentinel: "this call is not a donating call" (None and the empty set
+# are both meaningful donate specs)
+_NOT_DONATING = object()
+# calling one of these after the donating call re-establishes every
+# donated self-attribute (the documented rebuild idioms)
+_SANCTIONED_REBUILDS = ("_set_pools", "_sync_carry",
+                        "_sync_sharded_carry", "_ensure_carry",
+                        "_set_carry")
+
+
+def _literal_spec(v: ast.AST) -> Optional[Set]:
+    """Literal donate spec: a set of int positions (donate_argnums)
+    and/or str names (donate_argnames), or None when non-literal."""
+    if isinstance(v, ast.Constant) and isinstance(v.value, (int, str)):
+        return {v.value}
+    if isinstance(v, (ast.Tuple, ast.List)) and all(
+            isinstance(e, ast.Constant) and
+            isinstance(e.value, (int, str)) for e in v.elts):
+        return {e.value for e in v.elts}
+    if isinstance(v, ast.IfExp):
+        # the repo's donation-toggle idiom: `(0,) if donate else ()` —
+        # either branch may run, so the union of both is what can be
+        # donated
+        a = _literal_spec(v.body)
+        b = _literal_spec(v.orelse)
+        if a is not None and b is not None:
+            return a | b
+    return None
+
+
+def _donated_positions(call: ast.Call) -> Optional[Set]:
+    """Literal donate_argnums/donate_argnames spec (int positions and/or
+    str names), or None when non-literal (conservatively: every
+    argument)."""
+    for kw in call.keywords:
+        if kw.arg in ("donate_argnums", "donate_argnames"):
+            return _literal_spec(kw.value)
+    return set()  # no donation at all
+
+
+def _collect_donating(mod: Module, parents: dict):
+    """Donate specs, scoped: `(global, locals_by_func)`.
+
+    `global` maps module-level `x = jax.jit(...)` names, `self._x`
+    attribute bindings (the cross-method idiom — bound in __init__,
+    called elsewhere), and donating-decorated defs. `locals_by_func`
+    maps each function node to ITS `x = jax.jit(...)` Name bindings —
+    two functions reusing the same local name must not clobber each
+    other's specs (that false-negatives the exact bug class this rule
+    exists for). A local binding records even an empty spec, so a
+    non-donating local `step` shadows a donating global one."""
+    glob: Dict[str, Optional[Set]] = {}
+    locs: Dict[ast.AST, Dict[str, Optional[Set]]] = {}
+
+    def enclosing_func(node):
+        cur = parents.get(node)
+        while cur is not None and not isinstance(
+                cur, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            cur = parents.get(cur)
+        return cur
+
+    for node in ast.walk(mod.tree):
+        if isinstance(node, ast.Assign) and isinstance(node.value,
+                                                       ast.Call):
+            call = node.value
+            if terminal_name(call.func) not in _JIT_NAMES:
+                continue
+            spec = _donated_positions(call)
+            fn = enclosing_func(node)
+            for tgt in node.targets:
+                name = terminal_name(tgt)
+                if not name:
+                    continue
+                if fn is not None and isinstance(tgt, ast.Name):
+                    locs.setdefault(fn, {})[name] = spec
+                elif spec is None or spec:
+                    glob[name] = spec
+        elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            for dec in node.decorator_list:
+                call = dec if isinstance(dec, ast.Call) else None
+                if call is None:
+                    continue
+                f = call.func
+                spec = None
+                if terminal_name(f) in _JIT_NAMES:
+                    spec = _donated_positions(call)
+                elif terminal_name(f) == "partial" and call.args and \
+                        terminal_name(call.args[0]) in _JIT_NAMES:
+                    spec = _donated_positions(call)
+                else:
+                    continue
+                if spec is None or spec:
+                    glob[node.name] = spec
+    return glob, locs
+
+
+def _ref_repr(node: ast.AST) -> Optional[str]:
+    """'name' or 'self.attr' for trackable argument expressions."""
+    if isinstance(node, ast.Name):
+        return node.id
+    if isinstance(node, ast.Attribute) and \
+            isinstance(node.value, ast.Name) and node.value.id == "self":
+        return f"self.{node.attr}"
+    return None
+
+
+def _enclosing_loop(parents: dict, node: ast.AST,
+                    fnode: ast.AST) -> Optional[ast.AST]:
+    cur = parents.get(node)
+    while cur is not None and cur is not fnode:
+        if isinstance(cur, (ast.For, ast.AsyncFor, ast.While)):
+            return cur
+        cur = parents.get(cur)
+    return None
+
+
+def _check_function(mod: Module, fnode: ast.AST, donating: Dict,
+                    parents: dict) -> List[Finding]:
+    out: List[Finding] = []
+    nodes = sorted(own_nodes(fnode, include_lambdas=False),
+                   key=lambda n:
+                   (getattr(n, "lineno", 0), getattr(n, "col_offset", 0)))
+
+    # stores / rebuild calls by line, to clear tracked names
+    stores: List[Tuple[int, str]] = []
+    rebuilds: List[int] = []
+    loads: List[Tuple[int, str, ast.AST]] = []
+    donate_calls: List[tuple] = []
+
+    for node in nodes:
+        if isinstance(node, (ast.Name, ast.Attribute)):
+            r = _ref_repr(node)
+            if r is None:
+                continue
+            if isinstance(node.ctx, ast.Store):
+                stores.append((node.lineno, r))
+            elif isinstance(node.ctx, ast.Load):
+                loads.append((node.lineno, r, node))
+        elif isinstance(node, ast.Call):
+            callee = terminal_name(node.func)
+            if callee in _SANCTIONED_REBUILDS:
+                rebuilds.append(node.lineno)
+            spec = donating.get(callee, _NOT_DONATING)
+            if spec is _NOT_DONATING and isinstance(node.func, ast.Call) \
+                    and terminal_name(node.func.func) in _JIT_NAMES:
+                # inline donating jit called in place —
+                # `jax.jit(f, donate_argnums=(0,))(carry, x)` — donates
+                # without ever binding a name
+                s = _donated_positions(node.func)
+                if s is None or s:
+                    spec, callee = s, "jax.jit(...)"
+            if spec is not _NOT_DONATING:
+                tracked = []
+                starred = False
+                for i, arg in enumerate(node.args):
+                    if isinstance(arg, ast.Starred):
+                        # runtime positions of everything after a
+                        # *splat are unknowable — stop matching int
+                        # positions rather than mis-attribute donation
+                        starred = True
+                        continue
+                    if spec is not None and (starred or i not in spec):
+                        continue
+                    r = _ref_repr(arg)
+                    if r is not None:
+                        tracked.append(r)
+                for kw in node.keywords:
+                    # donate_argnames arguments are conventionally
+                    # passed by keyword
+                    if kw.arg is None:
+                        continue  # **kwargs
+                    if spec is not None and kw.arg not in spec:
+                        continue
+                    r = _ref_repr(kw.value)
+                    if r is not None:
+                        tracked.append(r)
+                if tracked:
+                    # a multi-line call's own argument loads sit on
+                    # later lines than the call head — never "after"
+                    own = {id(n) for n in ast.walk(node)}
+                    donate_calls.append(
+                        (node.lineno, node.col_offset, callee, tracked,
+                         own, node))
+
+    for call_line, call_col, callee, tracked, call_nodes, cnode \
+            in donate_calls:
+        loop = _enclosing_loop(parents, cnode, fnode)
+        for name in tracked:
+            if loop is not None:
+                # loop-carried: iteration N+1 reads whatever the name
+                # held when iteration N donated it — unless SOME store
+                # (or rebuild, for self attrs) inside the loop rebinds
+                lo = loop.lineno
+                hi = getattr(loop, "end_lineno", call_line)
+                healed = any(lo <= s_line <= hi and s_name == name
+                             for s_line, s_name in stores)
+                if not healed and name.startswith("self."):
+                    healed = any(lo <= rl <= hi for rl in rebuilds)
+                if not healed:
+                    out.append(Finding(
+                        "use-after-donate", mod.rel, call_line,
+                        f"`{name}` is donated into `{callee}(...)` "
+                        f"inside a loop but never rebound in the loop "
+                        f"body: the next iteration reads a deleted "
+                        f"buffer — rebind the name from the call's "
+                        f"result each iteration"))
+                    continue
+            for load_line, r, lnode in loads:
+                after = load_line > call_line or (
+                    load_line == call_line and
+                    lnode.col_offset > call_col)
+                if r != name or not after or \
+                        id(lnode) in call_nodes:
+                    continue
+                # strictly BEFORE the load's line: python evaluates a
+                # statement's RHS before its own store, so
+                # `step(carry, x)` followed by `carry = carry + 1`
+                # reads the deleted buffer even though the line also
+                # rebinds the name (the call's own-line assignment
+                # `carry = step(carry, ...)` still heals — its store
+                # sits on call_line, before any later load)
+                healed = any(
+                    call_line <= s_line < load_line and s_name == name
+                    for s_line, s_name in stores)
+                if not healed and name.startswith("self."):
+                    healed = any(call_line <= rl < load_line
+                                 for rl in rebuilds)
+                if healed:
+                    break  # rebound before (or at) this use — later
+                    # uses read the rebuilt value, stop tracking
+                out.append(Finding(
+                    "use-after-donate", mod.rel, load_line,
+                    f"`{name}` was donated into `{callee}(...)` at "
+                    f"line {call_line} and read again here: after "
+                    f"donation the buffer is deleted — rebind the name "
+                    f"from the call's result (or rebuild via "
+                    f"{'/'.join(_SANCTIONED_REBUILDS[:2])}) before any "
+                    f"further use"))
+                break  # one finding per donated name per call
+    return out
+
+
+@rule("use-after-donate",
+      "a name passed through a donating jit call must not be read "
+      "afterward except via the sanctioned rebuild idioms")
+def check(ctx: Context):
+    out: List[Finding] = []
+    for mod in ctx.modules:
+        parents = ctx.parents(mod)
+        glob, locs = _collect_donating(mod, parents)
+        # a module with no bound donating jit can still donate through
+        # an inline `jax.jit(..., donate_argnums=...)(args)` call
+        may_inline = "donate_arg" in mod.source
+        if not glob and not locs and not may_inline:
+            continue
+        for node in ast.walk(mod.tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                # lexical scoping: a factory's `jit_step = jax.jit(...)`
+                # is visible to the closures nested inside it
+                chain, cur = [], node
+                while cur is not None:
+                    if isinstance(cur, (ast.FunctionDef,
+                                        ast.AsyncFunctionDef)):
+                        chain.append(cur)
+                    cur = parents.get(cur)
+                eff = dict(glob)
+                for fn in reversed(chain):  # innermost wins
+                    eff.update(locs.get(fn, {}))
+                eff = {k: v for k, v in eff.items()
+                       if v is None or v}  # empty spec = not donating
+                if eff or may_inline:
+                    out.extend(_check_function(mod, node, eff,
+                                               parents))
+    return out

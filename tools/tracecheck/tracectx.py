@@ -1,0 +1,282 @@
+"""Trace-context inference: which functions run under an XLA trace?
+
+A function is **trace-reachable** when its body executes at
+`jax.jit` / `pjit` / `shard_map` / `custom_vjp` (& friends) trace time
+— directly (it is the traced callable: decorated, passed as an
+argument to a wrapper, or registered via `.defvjp`) or transitively
+(it is called from a trace-reachable function, across modules via the
+import graph).
+
+Python-level reads inside such bodies happen ONCE, at trace time, and
+are baked into the compiled executable — the PR 6 bwd-rule desync bug
+class the `flag-in-trace` pass exists for.
+
+The analysis is static and deliberately over-approximate (an edge for
+every plausible call target): for a linter, a false trace mark costs
+one reviewed `allow()`, while a missed mark costs a silent numerics
+bug.
+"""
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Set, Tuple
+
+from .core import Context, Module, terminal_name
+
+# callables whose function argument runs under trace
+TRACE_WRAPPERS = {
+    "jit", "pjit", "shard_map", "custom_vjp", "custom_jvp",
+    "pallas_call", "vmap", "pmap", "grad", "value_and_grad",
+    "checkpoint", "remat",
+}
+# attribute calls registering traced callables: fn.defvjp(fwd, bwd)
+TRACE_REGISTER_METHODS = {"defvjp", "defjvp", "def_fwd", "def_bwd"}
+
+FuncKey = Tuple[str, str]  # (module dotted name, qualname)
+
+
+class FuncInfo:
+    __slots__ = ("key", "module", "node", "class_name")
+
+    def __init__(self, key: FuncKey, module: Module, node: ast.AST,
+                 class_name: Optional[str]):
+        self.key = key
+        self.module = module
+        self.node = node            # FunctionDef / AsyncFunctionDef / Lambda
+        self.class_name = class_name
+
+    @property
+    def name(self) -> str:
+        return self.key[1].rsplit(".", 1)[-1]
+
+
+def _wrapper_call_name(func: ast.AST) -> Optional[str]:
+    """Terminal callee name if it is a trace wrapper; handles
+    `functools.partial(jax.jit, ...)` used as a decorator/value."""
+    t = terminal_name(func)
+    if t in TRACE_WRAPPERS:
+        return t
+    return None
+
+
+class TraceContext:
+    """Reachability over the (approximate) call graph, seeded at every
+    traced callable."""
+
+    def __init__(self, ctx: Context):
+        self.ctx = ctx
+        self.funcs: Dict[FuncKey, FuncInfo] = {}
+        # (module, bare name) -> [FuncKey] for intra-module resolution
+        self._by_name: Dict[Tuple[str, str], List[FuncKey]] = {}
+        # (module, ClassName, method) -> FuncKey
+        self._methods: Dict[Tuple[str, str, str], FuncKey] = {}
+        # per module: local name -> (target module dotted, target name)
+        self._imports: Dict[str, Dict[str, Tuple[str, Optional[str]]]] = {}
+        self.edges: Dict[FuncKey, Set[FuncKey]] = {}
+        self.roots: Dict[FuncKey, str] = {}   # key -> how it got traced
+        self.reached: Dict[FuncKey, str] = {}  # key -> via (root or caller)
+        for mod in ctx.modules:
+            self._collect_funcs(mod)
+            self._collect_imports(mod)
+        for mod in ctx.modules:
+            self._collect_roots_and_edges(mod)
+        self._propagate()
+
+    # -- collection ---------------------------------------------------------
+
+    def _collect_funcs(self, mod: Module):
+        def visit(node, qual: List[str], cls: Optional[str]):
+            for child in ast.iter_child_nodes(node):
+                if isinstance(child, (ast.FunctionDef,
+                                      ast.AsyncFunctionDef)):
+                    q = qual + [child.name]
+                    key = (mod.dotted, ".".join(q))
+                    info = FuncInfo(key, mod, child, cls)
+                    self.funcs[key] = info
+                    self._by_name.setdefault(
+                        (mod.dotted, child.name), []).append(key)
+                    if cls is not None and len(q) >= 2 and q[-2] == cls:
+                        self._methods[(mod.dotted, cls, child.name)] = key
+                    visit(child, q, cls)
+                elif isinstance(child, ast.ClassDef):
+                    visit(child, qual + [child.name], child.name)
+                else:
+                    visit(child, qual, cls)
+        visit(mod.tree, [], None)
+
+    def _collect_imports(self, mod: Module):
+        table: Dict[str, Tuple[str, Optional[str]]] = {}
+        pkg_parts = mod.dotted.split(".")
+        # package of this module (strip the module leaf for non-inits)
+        is_init = mod.path.endswith("__init__.py")
+        base = pkg_parts if is_init else pkg_parts[:-1]
+        for node in ast.walk(mod.tree):
+            if isinstance(node, ast.Import):
+                for alias in node.names:
+                    table[alias.asname or alias.name.split(".")[0]] = (
+                        alias.name, None)
+            elif isinstance(node, ast.ImportFrom):
+                if node.level:
+                    anchor = base[:len(base) - (node.level - 1)] \
+                        if node.level > 1 else list(base)
+                    target = ".".join(anchor + (node.module or "")
+                                      .split(".")).strip(".")
+                else:
+                    target = node.module or ""
+                for alias in node.names:
+                    if alias.name == "*":
+                        continue
+                    table[alias.asname or alias.name] = (target, alias.name)
+        self._imports[mod.dotted] = table
+
+    # -- resolution ---------------------------------------------------------
+
+    def _resolve(self, mod: Module, scope_qual: str,
+                 node: ast.AST) -> List[FuncKey]:
+        """Candidate FuncKeys a reference/call target may mean."""
+        out: List[FuncKey] = []
+        if isinstance(node, ast.Name):
+            # nested/sibling/module-level function in this module
+            for key in self._by_name.get((mod.dotted, node.id), ()):
+                out.append(key)
+            imp = self._imports.get(mod.dotted, {}).get(node.id)
+            if imp:
+                tmod, tname = imp
+                if tname is not None:  # bare module imports aren't funcs
+                    out.extend(self._by_name.get((tmod, tname), ()))
+        elif isinstance(node, ast.Attribute):
+            base = node.value
+            if isinstance(base, ast.Name):
+                if base.id in ("self", "cls"):
+                    # method on the enclosing class
+                    cls = self._enclosing_class(mod, scope_qual)
+                    if cls:
+                        key = self._methods.get(
+                            (mod.dotted, cls, node.attr))
+                        if key:
+                            out.append(key)
+                else:
+                    imp = self._imports.get(mod.dotted, {}).get(base.id)
+                    if imp:
+                        tmod, tname = imp
+                        target = tmod if tname is None else \
+                            (f"{tmod}.{tname}" if tmod else tname)
+                        out.extend(self._by_name.get(
+                            (target, node.attr), ()))
+        return out
+
+    def _enclosing_class(self, mod: Module, qual: str) -> Optional[str]:
+        key = (mod.dotted, qual)
+        info = self.funcs.get(key)
+        return info.class_name if info else None
+
+    # -- roots + edges ------------------------------------------------------
+
+    def _mark_root(self, keys: List[FuncKey], how: str):
+        for k in keys:
+            self.roots.setdefault(k, how)
+
+    def _lambda_info(self, mod: Module, node: ast.Lambda) -> FuncInfo:
+        key = (mod.dotted, f"<lambda:{node.lineno}>")
+        info = self.funcs.get(key)
+        if info is None:
+            info = FuncInfo(key, mod, node, None)
+            self.funcs[key] = info
+        return info
+
+    def _collect_roots_and_edges(self, mod: Module):
+        # decorator roots
+        for key, info in list(self.funcs.items()):
+            if key[0] != mod.dotted or isinstance(info.node, ast.Lambda):
+                continue
+            for dec in getattr(info.node, "decorator_list", ()):
+                name = _wrapper_call_name(dec)
+                if name is None and isinstance(dec, ast.Call):
+                    name = _wrapper_call_name(dec.func)
+                    if name is None and terminal_name(dec.func) == \
+                            "partial" and dec.args:
+                        name = _wrapper_call_name(dec.args[0])
+                if name:
+                    self._mark_root([key], f"@{name}")
+
+        # call-argument roots + call edges, per enclosing function
+        for key, info in [(k, i) for k, i in self.funcs.items()
+                          if k[0] == mod.dotted]:
+            self._scan_body(mod, key, info.node)
+        # module-level statements (outside any def) also create roots,
+        # e.g. `fn = jax.jit(helper)` at import time
+        self._scan_body(mod, None, mod.tree, module_level=True)
+
+    def _scan_body(self, mod: Module, key: Optional[FuncKey],
+                   func_node: ast.AST, module_level: bool = False):
+        """Walk one function's (or the module top-level's) own
+        statements — NOT nested function bodies, which have their own
+        FuncInfo — collecting trace roots and call edges."""
+        qual = key[1] if key else ""
+
+        def iter_own(node):
+            for child in ast.iter_child_nodes(node):
+                if isinstance(child, (ast.FunctionDef,
+                                      ast.AsyncFunctionDef)):
+                    # never descend: every def is scanned under its own
+                    # FuncKey
+                    continue
+                if isinstance(child, ast.ClassDef) and module_level:
+                    # class bodies' methods have their own keys; but
+                    # class-level statements may still build roots
+                    yield from iter_own(child)
+                    continue
+                yield child
+                yield from iter_own(child)
+
+        for node in iter_own(func_node):
+            if not isinstance(node, ast.Call):
+                continue
+            wrapper = _wrapper_call_name(node.func)
+            reg = (isinstance(node.func, ast.Attribute)
+                   and node.func.attr in TRACE_REGISTER_METHODS)
+            if wrapper or reg:
+                how = (f"passed to {wrapper}" if wrapper
+                       else f"registered via .{node.func.attr}")
+                for arg in node.args:
+                    if isinstance(arg, ast.Call) and \
+                            terminal_name(arg.func) == "partial" and \
+                            arg.args:
+                        # jit(partial(helper, ...)) traces helper
+                        arg = arg.args[0]
+                    if isinstance(arg, ast.Lambda):
+                        info = self._lambda_info(mod, arg)
+                        self._mark_root([info.key], how)
+                        self._scan_body(mod, info.key, arg)
+                    else:
+                        targets = self._resolve(mod, qual, arg)
+                        self._mark_root(targets, how)
+            if key is not None:
+                for tgt in self._resolve(mod, qual, node.func):
+                    self.edges.setdefault(key, set()).add(tgt)
+
+    # -- propagation --------------------------------------------------------
+
+    def _propagate(self):
+        work = []
+        for k, how in self.roots.items():
+            self.reached[k] = how
+            work.append(k)
+        while work:
+            k = work.pop()
+            for tgt in self.edges.get(k, ()):
+                if tgt not in self.reached:
+                    self.reached[tgt] = f"called from {k[1]} ({k[0]})"
+                    work.append(tgt)
+
+    # -- queries ------------------------------------------------------------
+
+    def traced_functions(self) -> List[FuncInfo]:
+        return [self.funcs[k] for k in sorted(self.reached)
+                if k in self.funcs]
+
+    def why(self, key: FuncKey) -> str:
+        return self.reached.get(key, "")
+
+    def is_traced(self, mod: Module, qualname: str) -> bool:
+        return (mod.dotted, qualname) in self.reached
