@@ -116,6 +116,45 @@ register_flag("FLAGS_gen_request_timeout_ms", 30000.0,
               "enforced while queued AND before every decode step — an "
               "expired sequence is cancelled mid-decode, its pages freed, "
               "only its own future fails (0 disables)")
+register_flag("FLAGS_gen_step_log", True,
+              "serving.GenerationEngine: record one compact scheduler "
+              "record per engine iteration into the bounded per-engine "
+              "step ring (profiler/step_log.py; /steps, chrome counter "
+              "tracks, engine_step_ms/gen_queue_age_ms histograms); off "
+              "removes the per-iteration accounting entirely "
+              "(bench.py --mode generation A/Bs it, <2% gate)")
+register_flag("FLAGS_gen_step_log_size", 4096,
+              "per-engine step-ring capacity in records; the oldest "
+              "record is overwritten (same bounding discipline as "
+              "FLAGS_trace_ring_size)")
+register_flag("FLAGS_gen_audit_log", "",
+              "optional JSONL sink for the generation scheduler's "
+              "decision audit log (profiler/audit.py): every "
+              "admit/defer/evict/expire/poison decision appends one "
+              "reason-coded line to this path; '' keeps the bounded "
+              "in-memory ring only")
+register_flag("FLAGS_slo_ttft_p99_ms", 0.0,
+              "SLO objective: generative time-to-first-token p99 target "
+              "in ms — at most 1% of requests in a window may exceed it "
+              "(profiler/slo.py burn rates, /slo, Prometheus gauges); "
+              "0 disables the objective")
+register_flag("FLAGS_slo_tpot_p99_ms", 0.0,
+              "SLO objective: generative time-per-output-token p99 "
+              "target in ms (same 1% budget semantics); 0 disables")
+register_flag("FLAGS_slo_error_rate", 0.0,
+              "SLO objective: max fraction of requests that may fail "
+              "(timeout/poison/engine death) per rolling window; "
+              "0 disables")
+register_flag("FLAGS_slo_windows_s", "60,300",
+              "comma-separated rolling-window lengths (seconds) the SLO "
+              "burn rates are evaluated over — shortest window first "
+              "(the fast-burn window readiness shedding keys on)")
+register_flag("FLAGS_slo_max_burn_rate", 0.0,
+              "fold SLO burn into /readyz: an engine reports not-ready "
+              "while any objective's fast-window burn rate is >= this "
+              "value, so the router sheds load BEFORE the error budget "
+              "is gone (0 never sheds; 1.0 = shedding exactly at "
+              "budget-burn speed)")
 register_flag("FLAGS_train_step_donate", True,
               "donate the (params, buffers, opt_state) carry into the jitted "
               "train step so XLA updates parameters in place instead of "

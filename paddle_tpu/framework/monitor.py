@@ -19,7 +19,62 @@ __all__ = ["StatValue", "stat_add", "stat_sub", "stat_reset", "stat_get",
            "STAT_ADD", "STAT_SUB",
            "STAT_RESET", "StatHistogram", "histogram", "all_histograms",
            "registered_histograms", "reset_all_stats", "drain_deltas",
-           "merge_deltas"]
+           "merge_deltas", "register_gauge", "gauge_kind", "is_gauge_name"]
+
+
+# -- gauge-name registry ----------------------------------------------------
+#
+# The ONE place a stat name's gauge-ness is recorded (ISSUE 11 satellite:
+# the exporter's suffix list and the relay's per-instance flag used to
+# drift independently). Two kinds:
+#
+#   "level"  — an absolute level (live HBM bytes, MFU, pages in use):
+#              rendered as a Prometheus gauge AND skipped by the
+#              cross-process delta relay (summing levels across processes
+#              corrupts both sides). `stat_set`/`stat_gauge_add` mark
+#              their name "level" automatically.
+#   "updown" — a counter that legitimately moves both ways (queue
+#              depths): rendered as a Prometheus gauge but RELAYED —
+#              stat_add/stat_sub deltas sum correctly across processes.
+#              Registered explicitly by the owning module.
+#
+# The Prometheus exporter classifies via `gauge_kind(name)`; the relay
+# skips exactly the "level" kind. A name in neither bucket is a plain
+# monotone counter.
+
+_gauge_kinds: Dict[str, str] = {}
+
+
+def register_gauge(name: str, updown: bool = False) -> None:
+    """Declare `name` a gauge for the Prometheus exporter. updown=True
+    keeps it in the cross-process relay (bidirectional counter);
+    updown=False (a pure level) also excludes it from the relay — though
+    level gauges normally self-register through stat_set/gauge_add."""
+    _gauge_kinds[name] = "updown" if updown else "level"
+
+
+def _note_level_gauge(name: str) -> None:
+    # stat_set/gauge_add call sites are by definition levels; an updown
+    # registration wins (it was an explicit owner decision)
+    if _gauge_kinds.get(name) != "updown":
+        _gauge_kinds[name] = "level"
+
+
+def gauge_kind(name: str):
+    """"level" / "updown" / None for `name` — the single source of truth
+    the exporter and the relay both read."""
+    k = _gauge_kinds.get(name)
+    if k is not None:
+        return k
+    s = _registry._stats.get(name)
+    if s is not None and s.gauge:
+        return "level"
+    return None
+
+
+def is_gauge_name(name: str) -> bool:
+    """Should `name` render as a Prometheus gauge?"""
+    return gauge_kind(name) is not None
 
 
 class StatValue:
@@ -55,7 +110,8 @@ class StatValue:
         with self._lock:
             self._v = int(v)
             self.gauge = True
-            return self._v
+        _note_level_gauge(self.name)
+        return self._v
 
     def gauge_add(self, n: int) -> int:
         """Atomically move a gauge LEVEL by a delta (resource-residency
@@ -65,7 +121,9 @@ class StatValue:
         with self._lock:
             self._v += int(n)
             self.gauge = True
-            return self._v
+            v = self._v
+        _note_level_gauge(self.name)
+        return v
 
     def drain(self) -> int:
         """Atomically read-and-zero (the cross-process delta relay: a
@@ -303,16 +361,19 @@ def drain_deltas():
     DataLoader worker calls this per shipped batch so ANY stat bumped in
     the worker process — packing counters, user collate_fn counters,
     histograms — reaches the trainer's registry instead of dying with
-    the fork's private copy. Gauges (anything touched via `stat_set`)
-    are levels, not totals: they stay process-local and are neither
-    drained nor merged — summing a worker's level into the parent would
-    corrupt both sides."""
+    the fork's private copy. "level" gauges (anything touched via
+    `stat_set`) stay process-local and are neither drained nor merged —
+    summing a worker's level into the parent would corrupt both sides.
+    The gauge registry is authoritative: a name registered "updown"
+    relays as deltas even if some code path also flipped the
+    per-instance gauge flag on it."""
     with _registry._lock:
         stats = list(_registry._stats.values())
         hists = list(_registry._hists.items())
     out_s = {}
     for s in stats:
-        if s.gauge:
+        kind = _gauge_kinds.get(s.name)
+        if kind == "level" or (kind is None and s.gauge):
             continue
         v = s.drain()
         if v:

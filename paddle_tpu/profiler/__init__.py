@@ -161,8 +161,11 @@ def export_chrome_tracing(path: str):
     device_tracer-style per-stream lanes)."""
     tracer.sample_counters()  # at least one sample → counter tracks render
     since = tracer.session_start() or None
+    trace = tracer.chrome_trace(since=since)
+    from . import step_log
+    trace["traceEvents"].extend(step_log.chrome_counter_events(since))
     with open(path, "w") as f:
-        json.dump(tracer.chrome_trace(since=since), f)
+        json.dump(trace, f)
 
 
 @contextlib.contextmanager

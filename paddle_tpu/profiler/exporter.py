@@ -17,8 +17,15 @@ Endpoints (`MetricsServer`, 127.0.0.1, daemon threads, zero deps):
   breakdown), device-telemetry snapshot, trace-ring state, and the
   flight recorder's last-dump summaries (reason, timestamp, path) so
   operators see recent postmortems without filesystem access.
+- `/steps` — JSON of every generation engine's scheduler step ring
+  (per-iteration admitted/freed/expired counts, queue depth + oldest
+  age, page occupancy, prefill-vs-decode wall) plus the decision-audit
+  tail — the input of `tools/engine_report.py`.
+- `/slo` — SLO objectives, per-engine multi-window burn rates and
+  violated flags (`profiler/slo.py`).
 - `/trace` — the current chrome trace (same payload
-  `export_chrome_tracing` writes), so a live timeline is one curl away.
+  `export_chrome_tracing` writes, scheduler counter tracks included),
+  so a live timeline is one curl away.
 - `/healthz` — liveness: 200 whenever the process can answer.
 - `/readyz` — readiness: 200 iff ≥1 registered engine is warmed up,
   has a live lane, is not draining, and its queue is below the
@@ -42,24 +49,23 @@ from typing import Optional
 
 from ..framework import monitor
 from ..framework.flags import flag
-from . import device_telemetry, flight_recorder, tracer
+from . import device_telemetry, flight_recorder, slo, step_log, tracer
 
 __all__ = ["render_prometheus", "MetricsServer", "start_metrics_server",
            "register_engine", "unregister_engine", "stats_payload",
            "readiness_payload"]
 
 _PREFIX = "paddle_tpu_"
-# up-down stats: current level, not a monotone total → Prometheus gauge
-_GAUGES = {"STAT_serving_queue_depth", "STAT_train_step_flops",
-           "STAT_train_mfu_bp", "STAT_kv_pages_inuse",
-           "STAT_gen_queue_depth", "STAT_kv_cache_hbm_bytes",
-           "STAT_quant_weight_hbm_bytes"}
-# device-telemetry levels set via stat_set (per-device ids vary)
-_GAUGE_SUFFIXES = ("_hbm_bytes_in_use", "_hbm_bytes_limit")
 
 
 def _is_gauge(name: str) -> bool:
-    return name in _GAUGES or name.endswith(_GAUGE_SUFFIXES)
+    # monitor is the single registry of gauge names (ISSUE 11): level
+    # gauges self-register through stat_set/stat_gauge_add, up/down
+    # counters register explicitly via monitor.register_gauge(...,
+    # updown=True) — the exporter and the mp relay's skip rule read the
+    # same table, so a gauge added in one place can't be mis-typed in
+    # the other
+    return monitor.is_gauge_name(name)
 
 
 def _metric_name(name: str) -> str:
@@ -75,6 +81,14 @@ def render_prometheus() -> str:
     histogram (reference StatRegistry publish, Prometheus-shaped)."""
     try:  # refresh HBM/MFU gauges at scrape time (no-op off-accelerator)
         device_telemetry.sample()
+    except Exception:
+        pass
+    try:  # refresh SLO burn-rate gauges the same way (no-op when off)
+        if slo.enabled():
+            slo.evaluate()
+        else:
+            slo.clear_gauges()  # disabling an objective must also stop
+            # its last burn value from rendering forever
     except Exception:
         pass
     lines = []
@@ -206,10 +220,21 @@ class _Handler(BaseHTTPRequestHandler):
             elif path == "/stats":
                 body = json.dumps(stats_payload(), default=str).encode()
                 ctype = "application/json"
+            elif path == "/steps":
+                body = json.dumps(step_log.steps_payload(),
+                                  default=str).encode()
+                ctype = "application/json"
+            elif path == "/slo":
+                body = json.dumps(slo.payload(), default=str).encode()
+                ctype = "application/json"
             elif path == "/trace":
                 tracer.sample_counters()
-                body = json.dumps(tracer.chrome_trace(),
-                                  default=str).encode()
+                trace = tracer.chrome_trace()
+                # scheduler state as counter tracks under the request
+                # timeline (step ring → "C" events)
+                trace["traceEvents"].extend(
+                    step_log.chrome_counter_events())
+                body = json.dumps(trace, default=str).encode()
                 ctype = "application/json"
             elif path == "/healthz":
                 body = json.dumps({"status": "ok",
@@ -222,7 +247,8 @@ class _Handler(BaseHTTPRequestHandler):
                 ctype = "application/json"
             else:
                 self.send_error(404, "unknown endpoint (have /metrics "
-                                     "/stats /trace /healthz /readyz)")
+                                     "/stats /steps /slo /trace "
+                                     "/healthz /readyz)")
                 return
         except Exception as e:  # noqa: BLE001 — a scrape never kills us
             self.send_error(500, repr(e))

@@ -237,6 +237,12 @@ class GenSpan:
         ttft_h.observe(max(0.0, ttft))
         if n_tokens > 1:
             tpot_h.observe(max(0.0, tpot))
+        # rolling-window SLO samples ride the same resolve path (no-ops
+        # until an FLAGS_slo_* objective is configured)
+        from . import slo
+        slo.observe_ttft(self.engine, max(0.0, ttft))
+        if n_tokens > 1:
+            slo.observe_tpot(self.engine, max(0.0, tpot))
         e2e = (s.get("resolved", last) - s["queued"]) * 1000.0
         tracer.instant(
             f"reqspan:{self.rid}:{self.engine}:slot{self.slot}:"

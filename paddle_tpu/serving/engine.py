@@ -39,6 +39,11 @@ from ..profiler import (RecordEvent, device_telemetry, exporter,
 
 __all__ = ["EngineConfig", "InferenceEngine"]
 
+# intake depth moves both ways: Prometheus gauge, but its stat_add/
+# stat_sub deltas still relay across processes (monitor is the single
+# registry of gauge names — ISSUE 11)
+monitor.register_gauge("STAT_serving_queue_depth", updown=True)
+
 
 def _now_ms() -> float:
     return time.perf_counter() * 1000.0

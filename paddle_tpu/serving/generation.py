@@ -60,9 +60,15 @@ from ..framework.errors import (ExecutionTimeoutError, FatalError,
                                 InvalidArgumentError,
                                 ResourceExhaustedError, UnavailableError)
 from ..framework.flags import flag
-from ..profiler import (RecordEvent, device_telemetry, exporter,
-                        flight_recorder, spans)
+from ..profiler import (RecordEvent, audit, device_telemetry, exporter,
+                        flight_recorder, slo, spans, step_log)
 from .kv_cache import TRASH_PAGE, PagedKVCache
+
+# the intake queue legitimately moves both ways; registering it as an
+# "updown" gauge makes the exporter render a Prometheus gauge while the
+# cross-process relay keeps summing its stat_add/stat_sub deltas
+# (monitor is the single registry of gauge names — ISSUE 11)
+monitor.register_gauge("STAT_gen_queue_depth", updown=True)
 
 __all__ = ["GenerationConfig", "GenerationEngine"]
 
@@ -128,7 +134,8 @@ class GenerationConfig:
 class _GenRequest:
     __slots__ = ("rid", "prompt", "max_new", "eos", "do_sample",
                  "temperature", "future", "deadline_ms", "t_enqueue_ms",
-                 "span", "slot", "pt_row", "toks", "next_pos", "ordinal")
+                 "span", "slot", "pt_row", "toks", "next_pos", "ordinal",
+                 "defer_logged")
 
     _ids = itertools.count(1)
 
@@ -149,6 +156,7 @@ class _GenRequest:
         self.toks: List[int] = []       # generated tokens (eos included)
         self.next_pos = 0               # cache position the NEXT step writes
         self.ordinal = 0                # engine-local submit ordinal
+        self.defer_logged = set()       # audit DEFER_* causes noted once
 
 
 class GenerationEngine:
@@ -238,6 +246,9 @@ class GenerationEngine:
             [None] * self._cfg.max_slots
         self._closed = False
         self._abort = False
+        # futures whose resolution is held until this iteration's
+        # step-ring record lands (step-thread only; see _resolve_later)
+        self._resolve_q: List[tuple] = []
         self._warmed = False
         self._steps_total = 0
         self._prefills_total = 0
@@ -249,6 +260,17 @@ class GenerationEngine:
         self._pre_step_hook = None     # test seam: runs on the step thread
         self._hist = monitor.histogram(f"{name}_request_ms")
         self._base_key = None          # PRNGKey, built lazily on first use
+        # scheduler X-ray (ISSUE 11): decision audit ring (always on —
+        # one deque append per decision) + per-iteration step ring
+        # (FLAGS_gen_step_log; snapshot at construction so one engine's
+        # A/B arm can't half-enable the other's)
+        self._audit = audit.AuditLog(name)
+        self._step_log = (step_log.StepLog(name)
+                          if step_log.enabled() else None)
+        self._iters = 0
+        self._it = {"admitted": 0, "completed": 0, "expired": 0,
+                    "poisoned": 0, "aborted": 0, "freed": 0,
+                    "prefill_ms": 0.0, "decode_ms": 0.0}
 
         self._build_programs()
         flight_recorder.touch()
@@ -268,6 +290,8 @@ class GenerationEngine:
                 metrics_port)
         except Exception:
             exporter.unregister_engine(self)
+            if self._step_log is not None:
+                step_log.unregister(self._step_log)
             raise
 
     # -- jitted programs ---------------------------------------------------
@@ -492,26 +516,36 @@ class GenerationEngine:
             t = _now_ms()
             tmo = (self._cfg.request_timeout_ms if timeout_ms is None
                    else float(timeout_ms))
+            reject_depth = None
             with self._cv:
                 if self._closed:
                     raise UnavailableError(
                         f"{self.name}: engine is shut down")
                 if len(self._queue) >= self._cfg.max_queue_depth:
-                    monitor.stat_add("STAT_gen_rejected")
-                    raise EngineOverloaded(
-                        f"{self.name}: queue depth "
-                        f"{self._cfg.max_queue_depth} reached; shed load "
-                        f"or raise FLAGS_gen_max_queue_depth")
-                req = _GenRequest(
-                    prompt, max_new, eos_token_id, bool(do_sample),
-                    float(temperature), Future(),
-                    None if not tmo else t + tmo, t,
-                    spans.start_gen(self.name))
-                self._req_seq += 1
-                req.ordinal = self._req_seq
-                self._queue.append(req)
-                monitor.stat_add("STAT_gen_queue_depth")
-                self._cv.notify_all()
+                    reject_depth = len(self._queue)
+                else:
+                    req = _GenRequest(
+                        prompt, max_new, eos_token_id, bool(do_sample),
+                        float(temperature), Future(),
+                        None if not tmo else t + tmo, t,
+                        spans.start_gen(self.name))
+                    self._req_seq += 1
+                    req.ordinal = self._req_seq
+                    self._queue.append(req)
+                    monitor.stat_add("STAT_gen_queue_depth")
+                    self._cv.notify_all()
+            if reject_depth is not None:
+                # audited OUTSIDE the lock: the JSONL sink's disk write
+                # must not stall the step thread behind rejecting
+                # clients, and rejections spike exactly under overload
+                monitor.stat_add("STAT_gen_rejected")
+                self._audit.audit("REJECT_QUEUE_FULL",
+                                  queue_depth=reject_depth)
+                self._audit.flush_sink()
+                raise EngineOverloaded(
+                    f"{self.name}: queue depth "
+                    f"{self._cfg.max_queue_depth} reached; shed load "
+                    f"or raise FLAGS_gen_max_queue_depth")
             monitor.stat_add("STAT_gen_requests")
             return req.future
 
@@ -534,15 +568,29 @@ class GenerationEngine:
                     if self._closed and self._abort:
                         self._evict_all(UnavailableError(
                             f"{self.name}: engine shut down"))
+                        # flush the aborted/freed counts: the ring's
+                        # sums must reconcile even on the abort exit
+                        # (self._cv is an RLock-backed Condition, so
+                        # re-acquiring inside is fine)
+                        self._record_iteration()
+                        self._flush_resolutions()
                         return
                     if (self._closed and not self._queue
                             and self._num_active() == 0):
                         return
                 self._admit()
                 self._expire_active()
+                stepped = False
                 if self._num_active():
                     self._step()
-                else:
+                    stepped = True
+                self._record_iteration()
+                # sink before resolutions: a caller woken by result()
+                # may immediately read the JSONL — its own event must
+                # already be on disk (no lock held here)
+                self._audit.flush_sink()
+                self._flush_resolutions()
+                if not stepped:
                     with self._cv:
                         if (self._queue and self._num_active() == 0
                                 and not self._abort):
@@ -553,7 +601,68 @@ class GenerationEngine:
             self._die(e)
             raise
 
+    def _record_iteration(self):
+        """One compact scheduler record per engine iteration (ISSUE 11):
+        decision counts taken this pass, queue pressure, page-pool
+        occupancy, prefill-vs-decode wall. Pure host bookkeeping — one
+        ring append plus two histogram observes, no device syncs beyond
+        what the iteration already did. The per-iteration counter dict
+        is zeroed whether or not the ring is on, so an A/B flag flip
+        can't leak one arm's counts into the other."""
+        it, self._it = self._it, {
+            "admitted": 0, "completed": 0, "expired": 0, "poisoned": 0,
+            "aborted": 0, "freed": 0, "prefill_ms": 0.0,
+            "decode_ms": 0.0}
+        if self._step_log is None:
+            return
+        self._iters += 1
+        with self._cv:
+            depth = len(self._queue)
+            oldest = (self._queue[0].t_enqueue_ms if self._queue
+                      else None)
+            live = self._num_active()
+        rec = step_log.StepRecord(
+            it=self._iters, step=self._steps_total,
+            t=time.perf_counter(), live=live,
+            queue_depth=depth,
+            oldest_age_ms=round(_now_ms() - oldest, 3)
+            if oldest is not None else 0.0,
+            pages_in_use=self._cache.pages_in_use,
+            free_pages=self._cache.free_pages,
+            admitted=it["admitted"], completed=it["completed"],
+            expired=it["expired"], poisoned=it["poisoned"],
+            aborted=it["aborted"], freed=it["freed"],
+            prefill_ms=round(it["prefill_ms"], 3),
+            decode_ms=round(it["decode_ms"], 3))
+        self._step_log.record(rec)
+
+    def _resolve_later(self, fut, result=None, exc=None):
+        """Hold a future's resolution until after this iteration's
+        _record_iteration(): a caller woken by result() must observe a
+        step ring / audit tail that already includes its own outcome —
+        resolving mid-iteration let a reader hit /steps before the
+        record landed and see counts that don't reconcile."""
+        self._resolve_q.append((fut, result, exc))
+
+    def _flush_resolutions(self):
+        q, self._resolve_q = self._resolve_q, []
+        for fut, result, exc in q:
+            try:
+                if exc is not None:
+                    fut.set_exception(exc)
+                else:
+                    fut.set_result(result)
+            except Exception:  # racing caller-side cancel pre-admission
+                pass
+
     def _die(self, e: BaseException):
+        try:
+            # flush whatever the dying iteration already counted, so
+            # the dump's step_log_tail reconciles with the audit tail
+            self._record_iteration()
+            self._flush_resolutions()
+        except Exception:
+            pass
         stranded = []
         with self._cv:
             self._closed = True
@@ -570,12 +679,21 @@ class GenerationEngine:
                 req.future.set_exception(err)
             except Exception:
                 pass
+            self._audit.audit("ENGINE_DIED", rid=req.rid,
+                              error=repr(e))
+            slo.observe_request(self.name, ok=False)
+        self._audit.flush_sink()
         flight_recorder.dump("gen_engine_death", {
             "engine": self.name, "error": repr(e),
             "stranded_requests": len(stranded),
             "active_sequences": len(active),
             "inflight_spans": [r.span.to_dict() for r in active
-                               if r.span is not None][:64]})
+                               if r.span is not None][:64],
+            # the scheduler state that LED here: last step-ring records
+            # + the decision-audit tail with reason codes (ISSUE 11)
+            "step_log_tail": (self._step_log.tail(32)
+                              if self._step_log is not None else []),
+            "audit_tail": self._audit.tail(64)})
 
     # -- admission ---------------------------------------------------------
 
@@ -596,10 +714,24 @@ class GenerationEngine:
                 slot = next((i for i, r in enumerate(self._slots)
                              if r is None), None)
                 if slot is None:
+                    # once per request per cause: a full batch defers
+                    # the head every iteration, and a per-iteration
+                    # event would drown the audit ring in repeats
+                    if "slots" not in req.defer_logged:
+                        req.defer_logged.add("slots")
+                        self._audit.audit(
+                            "DEFER_SLOTS", rid=req.rid,
+                            queue_depth=len(self._queue))
                     return
                 total = int(req.prompt.size) + req.max_new
                 if not self._cache.can_admit(total):
                     monitor.stat_add("STAT_gen_admit_blocked")
+                    if "pages" not in req.defer_logged:
+                        req.defer_logged.add("pages")
+                        self._audit.audit(
+                            "DEFER_PAGES", rid=req.rid,
+                            need_pages=self._cache.pages_needed(total),
+                            free_pages=self._cache.free_pages)
                     if not self._exhaust_dumped:
                         self._exhaust_dumped = True
                         flight_recorder.dump("gen_allocator_exhausted", {
@@ -607,15 +739,25 @@ class GenerationEngine:
                             "need_pages":
                                 self._cache.pages_needed(total),
                             "cache": self._cache.stats(),
-                            "queue_depth": len(self._queue)})
+                            "queue_depth": len(self._queue),
+                            "step_log_tail":
+                                (self._step_log.tail(32)
+                                 if self._step_log is not None else []),
+                            "audit_tail": self._audit.tail(64)})
                     return
                 self._queue.popleft()
                 monitor.stat_sub("STAT_gen_queue_depth")
                 if not req.future.set_running_or_notify_cancel():
+                    self._audit.audit("CANCELLED", rid=req.rid)
                     continue
                 req.slot = slot
                 req.pt_row = self._cache.alloc(req.rid, total)
                 self._slots[slot] = req
+                self._it["admitted"] += 1
+                self._audit.audit(
+                    "ADMIT", rid=req.rid, slot=slot,
+                    pages=self._cache.pages_needed(total),
+                    queued_ms=round(_now_ms() - req.t_enqueue_ms, 3))
                 if req.span is not None:
                     req.span.slot = slot
                     req.span.stamp("admitted")
@@ -630,15 +772,18 @@ class GenerationEngine:
             if req.deadline_ms is not None and t > req.deadline_ms:
                 monitor.stat_sub("STAT_gen_queue_depth")
                 monitor.stat_add("STAT_gen_timeouts")
-                try:
-                    req.future.set_exception(ExecutionTimeoutError(
-                        f"{self.name}: request expired after "
-                        f"{t - req.t_enqueue_ms:.1f}ms in queue"))
-                except Exception:
-                    pass
+                self._it["expired"] += 1
+                self._audit.audit(
+                    "EXPIRE_QUEUED", rid=req.rid,
+                    queued_ms=round(t - req.t_enqueue_ms, 3))
+                slo.observe_request(self.name, ok=False)
+                self._resolve_later(req.future, exc=ExecutionTimeoutError(
+                    f"{self.name}: request expired after "
+                    f"{t - req.t_enqueue_ms:.1f}ms in queue"))
                 continue
             if req.future.cancelled():
                 monitor.stat_sub("STAT_gen_queue_depth")
+                self._audit.audit("CANCELLED", rid=req.rid)
                 continue
             live.append(req)
         self._queue = live
@@ -664,6 +809,7 @@ class GenerationEngine:
         bucket = self._bucket_for(S)
         ids = np.zeros((1, bucket), np.int32)
         ids[0, :S] = req.prompt
+        t0 = _now_ms()
         with RecordEvent(f"generation::prefill[b={bucket}]"):
             with self._dev_ctx():
                 out = self._prefill_jit(
@@ -671,18 +817,23 @@ class GenerationEngine:
                     np.int32(S))
             self._set_pools(out[:-1])
             lg = np.asarray(out[-1])
+        self._it["prefill_ms"] += _now_ms() - t0
         if not np.all(np.isfinite(lg)):
             monitor.stat_add("STAT_gen_poisoned")
+            self._it["poisoned"] += 1
+            self._audit.audit("POISON_PREFILL", rid=req.rid,
+                              bucket=bucket)
+            slo.observe_request(self.name, ok=False)
             flight_recorder.dump("gen_poisoned_sequence", {
                 "engine": self.name, "rid": req.rid, "stage": "prefill",
-                "bucket": bucket, "error": "non-finite prefill logits"})
+                "bucket": bucket, "error": "non-finite prefill logits",
+                "step_log_tail": (self._step_log.tail(32)
+                                  if self._step_log is not None else []),
+                "audit_tail": self._audit.tail(64)})
             self._release(req)
-            try:
-                req.future.set_exception(FatalError(
-                    f"{self.name}: non-finite prefill logits for request "
-                    f"{req.rid} (poisoned prompt or weights)"))
-            except Exception:
-                pass
+            self._resolve_later(req.future, exc=FatalError(
+                f"{self.name}: non-finite prefill logits for request "
+                f"{req.rid} (poisoned prompt or weights)"))
             return
         self._prefills_total += 1
         monitor.stat_add("STAT_gen_prefills")
@@ -751,11 +902,13 @@ class GenerationEngine:
         if self._pre_step_hook is not None:
             self._pre_step_hook(self)
         args = self._step_arrays()
+        t0 = _now_ms()
         with RecordEvent(f"generation::step[m={self._cfg.max_slots}]"):
             out = self._decode_call(self._W, *self._pools(), *args)
             nxt = np.asarray(out[-2])
             bad = np.asarray(out[-1])
         self._set_pools(out[:-2])
+        self._it["decode_ms"] += _now_ms() - t0
         self._steps_total += 1
         monitor.stat_add("STAT_gen_steps")
         for i, req in enumerate(self._slots):
@@ -766,10 +919,18 @@ class GenerationEngine:
                 # are zeroed before reuse so the NaN cannot reach the
                 # next owner's masked attention
                 monitor.stat_add("STAT_gen_poisoned")
+                self._it["poisoned"] += 1
+                self._audit.audit("POISON_DECODE", rid=req.rid, slot=i,
+                                  generated=len(req.toks))
+                slo.observe_request(self.name, ok=False)
                 flight_recorder.dump("gen_poisoned_sequence", {
                     "engine": self.name, "rid": req.rid, "stage": "decode",
                     "slot": i, "generated": len(req.toks),
-                    "error": "non-finite decode logits"})
+                    "error": "non-finite decode logits",
+                    "step_log_tail": (self._step_log.tail(32)
+                                      if self._step_log is not None
+                                      else []),
+                    "audit_tail": self._audit.tail(64)})
                 self._evict(req, FatalError(
                     f"{self.name}: sequence {req.rid} produced "
                     f"non-finite logits at step {len(req.toks)}"))
@@ -797,6 +958,12 @@ class GenerationEngine:
                 continue
             if t > req.deadline_ms:
                 monitor.stat_add("STAT_gen_timeouts")
+                self._it["expired"] += 1
+                self._audit.audit(
+                    "EXPIRE_DECODE", rid=req.rid, slot=req.slot,
+                    generated=len(req.toks),
+                    age_ms=round(t - req.t_enqueue_ms, 3))
+                slo.observe_request(self.name, ok=False)
                 self._evict(req, ExecutionTimeoutError(
                     f"{self.name}: request {req.rid} expired after "
                     f"{t - req.t_enqueue_ms:.1f}ms with "
@@ -814,6 +981,7 @@ class GenerationEngine:
             self._exhaust_dumped = False  # pages freed: new episode
         if req.slot is not None and self._slots[req.slot] is req:
             self._slots[req.slot] = None
+            self._it["freed"] += 1
         with self._cv:
             self._cv.notify_all()
 
@@ -828,44 +996,56 @@ class GenerationEngine:
             # (a timeout, NOT a completion — the two counters partition
             # the finished-naturally outcomes)
             monitor.stat_add("STAT_gen_timeouts")
-            try:
-                req.future.set_exception(ExecutionTimeoutError(
-                    f"{self.name}: request expired after "
-                    f"{t_done - req.t_enqueue_ms:.1f}ms"))
-            except Exception:
-                pass
+            self._it["expired"] += 1
+            self._audit.audit("EXPIRE_LATE", rid=req.rid,
+                              generated=len(req.toks))
+            slo.observe_request(self.name, ok=False)
+            self._resolve_later(req.future, exc=ExecutionTimeoutError(
+                f"{self.name}: request expired after "
+                f"{t_done - req.t_enqueue_ms:.1f}ms"))
             return
-        try:
-            req.future.set_result(out)
-        except Exception:  # racing caller-side cancel
-            pass
-        else:
-            monitor.stat_add("STAT_gen_completions")  # delivered results
-            if req.span is not None:
-                req.span.stamp("resolved")
-                req.span.finish(len(req.toks))
+        # delivery cannot fail: _admit claimed the future via
+        # set_running_or_notify_cancel, so a caller-side cancel is no
+        # longer possible — count now, resolve after the ring record
+        self._resolve_later(req.future, result=out)
+        monitor.stat_add("STAT_gen_completions")  # delivered results
+        self._it["completed"] += 1
+        self._audit.audit(
+            "COMPLETE_EOS" if (req.eos is not None
+                               and req.toks
+                               and req.toks[-1] == req.eos)
+            else "COMPLETE_MAX_NEW",
+            rid=req.rid, generated=len(req.toks),
+            e2e_ms=round(t_done - req.t_enqueue_ms, 3))
+        slo.observe_request(self.name, ok=True)
+        if req.span is not None:
+            req.span.stamp("resolved")
+            req.span.finish(len(req.toks))
 
     def _evict(self, req: _GenRequest, err: BaseException):
         """Cancel a LIVE sequence mid-decode: free + zero its pages,
         fail only its own future."""
         self._release(req)
         monitor.stat_add("STAT_gen_evictions")
-        try:
-            req.future.set_exception(err)
-        except Exception:
-            pass
+        self._resolve_later(req.future, exc=err)
 
     def _evict_all(self, err: BaseException):
         for req in list(self._slots):
             if req is not None:
+                # deliberate operator action (shutdown/abort): audited
+                # but NOT an SLO error — a drain must not burn the
+                # error budget of the replicas still serving
+                self._it["aborted"] += 1
+                self._audit.audit("EVICT_SHUTDOWN", rid=req.rid,
+                                  generated=len(req.toks))
                 self._evict(req, err)
 
     # -- lifecycle / introspection -----------------------------------------
 
     def stats(self) -> dict:
-        """Engine snapshot: per-slot state, page-pool occupancy, the
-        exact compile ledger, token/step totals, and the TTFT/TPOT +
-        end-to-end latency histograms."""
+        """Engine snapshot: per-slot state, page-pool occupancy + KV
+        introspection, the exact compile ledger, token/step totals, and
+        the TTFT/TPOT + end-to-end latency histograms."""
         with self._cv:
             depth = len(self._queue)
             slots = [{"slot": i,
@@ -874,6 +1054,8 @@ class GenerationEngine:
                       "prompt_len": int(r.prompt.size)
                       if r is not None else 0}
                      for i, r in enumerate(self._slots)]
+            slot_of = {r.rid: i for i, r in enumerate(self._slots)
+                       if r is not None}
             ledger = dict(self._ledger)
             steps, prefills, tokens = (self._steps_total,
                                        self._prefills_total,
@@ -882,14 +1064,45 @@ class GenerationEngine:
             "slots": slots,
             "queue_depth": depth,
             "pages": self._cache.stats(),
+            "kv": self._kv_introspection(slot_of),
             "compiles": ledger,
             "steps": steps,
             "prefills": prefills,
             "tokens": tokens,
+            "step_log": {
+                "enabled": self._step_log is not None,
+                "recorded": (self._step_log.recorded
+                             if self._step_log is not None else 0),
+                "audit_events": self._audit.recorded,
+            },
             "latency_ms": self._hist.snapshot(),
             "ttft_ms": monitor.histogram("ttft_ms").snapshot(),
             "tpot_ms": monitor.histogram("tpot_ms").snapshot(),
         }
+
+    def _kv_introspection(self, slot_of=None) -> dict:
+        """`stats()["kv"]`: pool stats + watermarks, the per-sequence
+        page-ownership map (joined to decode slots), and the admission-
+        headroom estimate for this engine's representative request
+        shapes — one `can_admit` count per (prefill bucket + default
+        max-new) total, the per-replica pressure surface the router
+        tier compares (ISSUE 11)."""
+        out = dict(self._cache.stats())
+        owners = self._cache.owners()
+        if slot_of is None:
+            with self._cv:
+                slot_of = {r.rid: i for i, r in enumerate(self._slots)
+                           if r is not None}
+        out["owners"] = [
+            {"rid": rid, "slot": slot_of.get(rid), "pages": pages}
+            for rid, pages in sorted(owners.items())]
+        shapes = {b + self._cfg.max_new_tokens
+                  for b in self._cfg.prefill_buckets}
+        out["admit_headroom"] = {
+            str(tokens): n
+            for tokens, n in sorted(
+                self._cache.headroom(sorted(shapes)).items())}
+        return out
 
     def health(self) -> dict:
         """`/readyz` verdict, same shape as InferenceEngine.health() so
@@ -911,7 +1124,11 @@ class GenerationEngine:
         elif depth >= limit:
             reason = "queue at rejection threshold"
         else:
-            reason = "ok"
+            # SLO folding (ISSUE 11): with FLAGS_slo_max_burn_rate set,
+            # a replica burning its error budget too fast reports
+            # not-ready so the router sheds load BEFORE the budget is
+            # gone — the pre-emptive drain surface
+            reason = slo.shed_verdict(self.name) or "ok"
         return {"ready": reason == "ok", "reason": reason,
                 "warmup_complete": warmed, "draining": draining,
                 "live_lanes": live, "queue_depth": depth,
@@ -923,6 +1140,7 @@ class GenerationEngine:
         """Stop intake; by default every queued + live sequence finishes
         before the step loop exits. drain=False fails pending futures
         fast (live sequences are evicted, pages freed)."""
+        dropped = []
         with self._cv:
             self._closed = True
             if not drain:
@@ -930,16 +1148,28 @@ class GenerationEngine:
                 while self._queue:
                     req = self._queue.popleft()
                     monitor.stat_sub("STAT_gen_queue_depth")
+                    dropped.append(req)
                     try:
                         req.future.set_exception(UnavailableError(
                             f"{self.name}: engine shut down"))
                     except Exception:
                         pass
             self._cv.notify_all()
+        for req in dropped:
+            # audited OUTSIDE the lock (disk sink); queued drops get
+            # their own code so the step ring's aborted count still
+            # reconciles exactly with the live EVICT_SHUTDOWN events
+            self._audit.audit("EVICT_SHUTDOWN_QUEUED", rid=req.rid,
+                              queued_ms=round(_now_ms()
+                                              - req.t_enqueue_ms, 3))
         t = getattr(self, "_thread", None)
         if t is not None:
             t.join(timeout_s)
         exporter.unregister_engine(self)
+        if self._step_log is not None:
+            step_log.unregister(self._step_log)
+        self._audit.close()
+        slo.forget(self.name)
         if getattr(self, "_owns_metrics_server", False) \
                 and self.metrics_server is not None:
             self.metrics_server.close()

@@ -104,6 +104,11 @@ class PagedKVCache:
         # hot pool keeps touching the same HBM region
         self._free: List[int] = list(range(self.num_pages - 1, 0, -1))
         self._owned: Dict[int, List[int]] = {}  # seq id -> pages
+        # free-list watermarks since construction: the low-water mark is
+        # "how close did this pool ever get to exhaustion" — the
+        # capacity-planning number /stats surfaces (ISSUE 11)
+        self._free_low_water = len(self._free)
+        self._free_high_water = len(self._free)
         monitor.stat_set("STAT_kv_pages_inuse", 0)
         b = self.hbm_bytes()
         _note_pool_bytes(b)
@@ -192,6 +197,7 @@ class PagedKVCache:
                 f"{len(self._free)} free of {self.usable_pages}")
         pages = [self._free.pop() for _ in range(need)]
         self._owned[seq_id] = pages
+        self._free_low_water = min(self._free_low_water, len(self._free))
         monitor.stat_set("STAT_kv_pages_inuse", self.pages_in_use)
         row = np.full((self.pages_per_seq,), TRASH_PAGE, np.int32)
         row[:need] = pages
@@ -204,12 +210,47 @@ class PagedKVCache:
         no-op."""
         pages = self._owned.pop(seq_id, [])
         self._free.extend(pages)
+        self._free_high_water = max(self._free_high_water,
+                                    len(self._free))
         monitor.stat_set("STAT_kv_pages_inuse", self.pages_in_use)
         return pages
 
     def owned(self, seq_id: int) -> Optional[List[int]]:
         pages = self._owned.get(seq_id)
         return list(pages) if pages is not None else None
+
+    def owners(self) -> Dict[int, List[int]]:
+        """Page-ownership map `{seq_id: [page, ...]}` — which physical
+        pages each live sequence holds (KV-pool introspection; the
+        engine joins it against its slot table for `stats()["kv"]`).
+
+        Read from scraper threads while the step thread allocs/frees:
+        iterate a key snapshot + per-key atomic gets (each a single
+        GIL-atomic dict op) instead of `.items()`, which would raise
+        `dictionary changed size during iteration` mid-scrape. A page
+        list is never mutated after alloc, so copying it is safe."""
+        out = {}
+        for sid in list(self._owned):
+            pages = self._owned.get(sid)
+            if pages is not None:
+                out[sid] = list(pages)
+        return out
+
+    def headroom(self, token_counts) -> Dict[int, int]:
+        """Admission-headroom estimate: for each representative request
+        size (total tokens = prompt + max_new), how many MORE such
+        requests `can_admit` would accept RIGHT NOW from the free list
+        alone (0 when the shape can never fit the page table). The
+        router tier compares this across replicas to place work."""
+        out = {}
+        free = len(self._free)
+        for tokens in token_counts:
+            need = self.pages_needed(tokens)
+            if need > self.pages_per_seq or need <= 0:
+                out[int(tokens)] = 0
+            else:
+                out[int(tokens)] = free // need
+        return out
 
     def zero_rows(self, pages: List[int]) -> np.ndarray:
         """Fixed-width page-id row for the engine's jitted zeroing
@@ -231,4 +272,6 @@ class PagedKVCache:
             "sequences": len(self._owned),
             "occupancy": round(self.pages_in_use
                                / max(1, self.usable_pages), 4),
+            "free_low_water": self._free_low_water,
+            "free_high_water": self._free_high_water,
         }

@@ -121,6 +121,19 @@ def test_flags_inventory_corpus():
     assert not any("FLAGS_fix_documented" in f.message for f in fs)
 
 
+def test_audit_reasons_corpus():
+    fs = run_fixture("audit_reasons", ["audit-reasons"])
+    assert {f.rule for f in fs} == {"audit-reasons"}
+    undoc = [f for f in fs if "FIX_UNDOCUMENTED_CODE" in f.message]
+    stale = [f for f in fs if "FIX_STALE_CODE" in f.message]
+    assert len(fs) == 2 and undoc and stale
+    assert undoc[0].path.endswith("bad.py")
+    assert stale[0].path == "COVERAGE.md"
+    # the documented codes — including both IfExp branches — are clean
+    for code in ("FIX_DOC_ADMIT", "FIX_DOC_EOS", "FIX_DOC_BUDGET"):
+        assert not any(code in f.message for f in fs)
+
+
 def test_stats_doc_corpus():
     fs = run_fixture("stats_doc", ["stats-doc"])
     assert {f.rule for f in fs} == {"stats-doc"}
@@ -264,10 +277,10 @@ def test_cli_subprocess_contract():
     assert {f["rule"] for f in payload["findings"]} == {"use-after-donate"}
 
 
-def test_list_rules_names_all_seven(capsys):
+def test_list_rules_names_all_eight(capsys):
     assert lint_main()(["--list-rules"]) == 0
     out = capsys.readouterr().out
     for name in ("flag-in-trace", "use-after-donate", "scatter-batch-dim",
                  "gauge-discipline", "lock-discipline", "flags-inventory",
-                 "stats-doc"):
+                 "stats-doc", "audit-reasons"):
         assert name in out

@@ -1,0 +1,15 @@
+"""Seeded audit-reasons violation: a reason code emitted by the
+scheduler but absent from the fixture COVERAGE.md reason table (the
+table also carries a stale row no call site emits)."""
+
+
+class _Log:
+    def audit(self, reason, **detail):
+        pass
+
+
+log = _Log()
+
+
+def schedule():
+    log.audit("FIX_UNDOCUMENTED_CODE", rid=1)  # BAD: no table row

@@ -1,0 +1,18 @@
+"""Good twins: documented codes, including the conditional-expression
+emission form (both branches are vocabulary)."""
+
+
+class _Log:
+    def audit(self, reason, **detail):
+        pass
+
+
+log = _Log()
+
+
+def finish(hit_eos):
+    log.audit("FIX_DOC_EOS" if hit_eos else "FIX_DOC_BUDGET", rid=2)
+
+
+def admit():
+    log.audit("FIX_DOC_ADMIT", rid=3, slot=0)

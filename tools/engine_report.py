@@ -1,0 +1,186 @@
+#!/usr/bin/env python
+"""Render a generation engine's scheduler X-ray as a human timeline.
+
+    curl -s localhost:9100/steps > steps.json
+    python tools/engine_report.py steps.json
+    python tools/engine_report.py steps.json --engine gen0 --last 40
+    python tools/engine_report.py flightrec-...-gen_engine_death.json
+
+Input is either a `/steps` payload (profiler/step_log.steps_payload:
+per-engine iteration records + decision-audit tail) or a flight-recorder
+dump whose `extra` carries `step_log_tail`/`audit_tail` (engine death,
+poison, allocator exhaustion). The report shows, per iteration: decode
+slots in use (as a bar), scheduler decisions (admit/complete/expire/
+poison/abort), queue depth + oldest-request age, page-pool occupancy,
+and prefill-vs-decode wall — then the audit tail with reason codes, so
+"why did this request wait/die" reads straight off the artifact.
+
+`--json` emits the parsed + summarized structure for scripting.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from typing import List, Optional
+
+
+def load_payload(path: str) -> dict:
+    """Normalize either input shape to {engine: {"records", "audit"}}."""
+    with open(path) as f:
+        raw = json.load(f)
+    if "engines" in raw:  # /steps payload
+        return {name: {"records": e.get("records", []),
+                       "audit": e.get("audit", []),
+                       "recorded_total": e.get("recorded_total"),
+                       "ring_capacity": e.get("ring_capacity")}
+                for name, e in raw["engines"].items()}
+    extra = raw.get("extra", {})
+    if "step_log_tail" in extra or "audit_tail" in extra:
+        name = extra.get("engine", raw.get("reason", "engine"))
+        return {name: {"records": extra.get("step_log_tail", []),
+                       "audit": extra.get("audit_tail", []),
+                       "recorded_total": None, "ring_capacity": None,
+                       "dump_reason": raw.get("reason")}}
+    raise SystemExit(
+        f"{path}: neither a /steps payload (no 'engines' key) nor a "
+        f"flight-recorder dump with step_log_tail/audit_tail")
+
+
+def summarize(records: List[dict]) -> dict:
+    """Aggregate decision totals + peaks over the retained window."""
+    if not records:
+        return {"iterations": 0}
+    tot = {k: sum(r.get(k, 0) for r in records)
+           for k in ("admitted", "completed", "expired", "poisoned",
+                     "aborted", "freed")}
+    return {
+        "iterations": len(records),
+        "decode_steps": sum(1 for r in records
+                            if r.get("decode_ms", 0) > 0),
+        **tot,
+        "peak_live": max(r.get("live", 0) for r in records),
+        "peak_queue_depth": max(r.get("queue_depth", 0)
+                                for r in records),
+        "peak_oldest_age_ms": round(max(r.get("oldest_age_ms", 0.0)
+                                        for r in records), 3),
+        "peak_pages_in_use": max(r.get("pages_in_use", 0)
+                                 for r in records),
+        "min_free_pages": min(r.get("free_pages", 0) for r in records),
+        "prefill_ms_total": round(sum(r.get("prefill_ms", 0.0)
+                                      for r in records), 3),
+        "decode_ms_total": round(sum(r.get("decode_ms", 0.0)
+                                     for r in records), 3),
+    }
+
+
+def _bar(n: int, peak: int, width: int = 8) -> str:
+    peak = max(peak, 1)
+    fill = round(width * min(n, peak) / peak)
+    return "#" * fill + "." * (width - fill)
+
+
+def render(name: str, eng: dict, last: int = 0,
+           file=None) -> None:
+    out = file or sys.stdout
+    records = eng["records"]
+    if last > 0:
+        records = records[-last:]
+    summ = summarize(records)
+    print(f"== engine {name} ==", file=out)
+    if eng.get("dump_reason"):
+        print(f"   (from flight dump: {eng['dump_reason']})", file=out)
+    if not records:
+        print("   no step records (FLAGS_gen_step_log off, or the "
+              "engine never iterated)", file=out)
+    else:
+        peak_live = summ["peak_live"]
+        print(f"   {summ['iterations']} iterations retained "
+              f"({summ['decode_steps']} decode steps): "
+              f"admitted {summ['admitted']}, completed "
+              f"{summ['completed']}, expired {summ['expired']}, "
+              f"poisoned {summ['poisoned']}, aborted "
+              f"{summ['aborted']}", file=out)
+        print(f"   peak live {peak_live}, peak queue "
+              f"{summ['peak_queue_depth']} (oldest "
+              f"{summ['peak_oldest_age_ms']}ms), peak pages "
+              f"{summ['peak_pages_in_use']}, min free pages "
+              f"{summ['min_free_pages']}", file=out)
+        hdr = (f"   {'it':>6} {'step':>6} {'slots':<10} {'adm':>3} "
+               f"{'done':>4} {'exp':>3} {'psn':>3} {'abt':>3} "
+               f"{'queue':>5} {'age_ms':>8} {'pages':>5} {'free':>5} "
+               f"{'prefill':>8} {'decode':>8}")
+        print(hdr, file=out)
+        for r in records:
+            print(f"   {r.get('it', 0):>6} {r.get('step', 0):>6} "
+                  f"[{_bar(r.get('live', 0), peak_live)}] "
+                  f"{r.get('admitted', 0):>3} "
+                  f"{r.get('completed', 0):>4} "
+                  f"{r.get('expired', 0):>3} "
+                  f"{r.get('poisoned', 0):>3} "
+                  f"{r.get('aborted', 0):>3} "
+                  f"{r.get('queue_depth', 0):>5} "
+                  f"{r.get('oldest_age_ms', 0.0):>8.1f} "
+                  f"{r.get('pages_in_use', 0):>5} "
+                  f"{r.get('free_pages', 0):>5} "
+                  f"{r.get('prefill_ms', 0.0):>7.1f}ms "
+                  f"{r.get('decode_ms', 0.0):>7.1f}ms", file=out)
+    audit = eng.get("audit", [])
+    if last > 0:
+        audit = audit[-last:]
+    print(f"   -- decision audit ({len(audit)} events) --", file=out)
+    for ev in audit:
+        extra = {k: v for k, v in ev.items()
+                 if k not in ("t", "engine", "reason", "rid")}
+        detail = (" " + " ".join(f"{k}={v}" for k, v in
+                                 sorted(extra.items()))) if extra else ""
+        rid = ev.get("rid")
+        print(f"   t={ev.get('t', 0):.3f} "
+              f"{ev.get('reason', '?'):<18} "
+              f"rid={rid if rid is not None else '-':<6}{detail}",
+              file=out)
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(
+        prog="engine_report.py", description=__doc__,
+        formatter_class=argparse.RawDescriptionHelpFormatter)
+    p.add_argument("path", help="/steps payload or flight-recorder dump")
+    p.add_argument("--engine", default=None,
+                   help="only this engine (default: all)")
+    p.add_argument("--last", type=int, default=0,
+                   help="only the last N records/events (default: all)")
+    p.add_argument("--json", action="store_true",
+                   help="emit parsed records + summary as JSON")
+    args = p.parse_args(argv)
+
+    engines = load_payload(args.path)
+    if args.engine is not None:
+        if args.engine not in engines:
+            print(f"engine {args.engine!r} not in {sorted(engines)}",
+                  file=sys.stderr)
+            return 1
+        engines = {args.engine: engines[args.engine]}
+    if not engines:
+        print("no engines in payload", file=sys.stderr)
+        return 1
+
+    if args.json:
+        out = {}
+        for name, eng in engines.items():
+            recs = eng["records"][-args.last:] if args.last > 0 \
+                else eng["records"]
+            audit = eng["audit"][-args.last:] if args.last > 0 \
+                else eng["audit"]
+            out[name] = {"summary": summarize(recs), "records": recs,
+                         "audit": audit}
+        print(json.dumps(out, indent=2))
+        return 0
+
+    for name, eng in sorted(engines.items()):
+        render(name, eng, last=args.last)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

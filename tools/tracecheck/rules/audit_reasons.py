@@ -1,0 +1,103 @@
+"""audit-reasons: the scheduler's decision vocabulary stays documented.
+
+Origin (ISSUE 11): the generation engine appends reason-coded events
+(`ADMIT`, `DEFER_PAGES`, `EXPIRE_DECODE`, ...) to the decision audit
+log (`profiler/audit.py`); postmortems and the router runbook read
+those codes from COVERAGE.md's "Audit reason codes" table. An
+undocumented code is a postmortem word nobody can look up; a documented
+code the engine no longer emits is a runbook entry that can never fire.
+Same bidirectional contract as `stats-doc`, applied to the audit
+vocabulary.
+
+Code side: every call `<something>.audit("CODE", ...)` with a literal
+SCREAMING_CASE first argument anywhere under the package (the emitter
+method is named `audit` by convention; `profiler/audit.py` itself — the
+registry that defines `REASONS` and the `audit` method — is excluded
+the same way `framework/monitor.py` is excluded from stats scans).
+Doc side: the first column of the "### Audit reason codes" table.
+"""
+from __future__ import annotations
+
+import ast
+import os
+import re
+from typing import Dict, List, Tuple
+
+from ..core import Context, Finding, rule, terminal_name
+from .stats_doc import inventory_rows
+
+_SECTION = "### Audit reason codes"
+_CODE = re.compile(r"^[A-Z][A-Z0-9_]*$")
+# the registry module defines REASONS and the emitting method itself
+_SKIP = os.path.join("profiler", "audit.py")
+
+
+def emitted_codes(ctx: Context) -> Dict[str, List[Tuple[str, int]]]:
+    """{code: [(rel, line)]} for every literal `.audit("CODE", ...)`
+    call site under the package."""
+    out: Dict[str, List[Tuple[str, int]]] = {}
+    for mod in ctx.modules:
+        if mod.rel.endswith(_SKIP):
+            continue
+        for node in ast.walk(mod.tree):
+            if not isinstance(node, ast.Call) or not node.args:
+                continue
+            if terminal_name(node.func) != "audit":
+                continue
+            arg = node.args[0]
+            if isinstance(arg, ast.Constant) and \
+                    isinstance(arg.value, str) and \
+                    _CODE.match(arg.value):
+                out.setdefault(arg.value, []).append(
+                    (mod.rel, node.lineno))
+            elif isinstance(arg, ast.IfExp):
+                # `audit("A" if cond else "B", ...)` — both branches
+                # are emitted vocabulary
+                for b in (arg.body, arg.orelse):
+                    if isinstance(b, ast.Constant) and \
+                            isinstance(b.value, str) and \
+                            _CODE.match(b.value):
+                        out.setdefault(b.value, []).append(
+                            (mod.rel, node.lineno))
+    return out
+
+
+def documented_codes(coverage_path: str) -> Dict[str, int]:
+    """{code: line} from the COVERAGE.md reason table (first cell)."""
+    return {cells[0]: line
+            for cells, line in inventory_rows(coverage_path, _SECTION)
+            if cells and _CODE.match(cells[0])}
+
+
+@rule("audit-reasons",
+      "every reason code the engine's decision audit log emits is "
+      "documented in COVERAGE.md's 'Audit reason codes' table, and "
+      "every documented code is still emitted")
+def check(ctx: Context):
+    cov = os.path.join(ctx.repo_root, "COVERAGE.md")
+    if not os.path.exists(cov):
+        return []  # fixture corpora carry no docs
+    emitted = emitted_codes(ctx)
+    documented = documented_codes(cov)
+    if not emitted and not documented:
+        return []  # corpus without an audit vocabulary
+    covrel = os.path.relpath(cov, ctx.repo_root)
+    out: List[Finding] = []
+    for code, sites in sorted(emitted.items()):
+        if code not in documented:
+            rel, line = sites[0]
+            out.append(Finding(
+                "audit-reasons", rel, line,
+                f"audit reason code `{code}` is emitted here but "
+                f"missing from the COVERAGE.md '{_SECTION[4:]}' table "
+                f"— document it (postmortems read these codes); "
+                f"{len(sites)} site(s) total"))
+    for code, line in sorted(documented.items()):
+        if code not in emitted:
+            out.append(Finding(
+                "audit-reasons", covrel, line,
+                f"COVERAGE.md documents audit reason code `{code}` "
+                f"but no `.audit(\"{code}\", ...)` call site emits it "
+                f"— remove the stale row (or restore the decision "
+                f"path)"))
+    return out

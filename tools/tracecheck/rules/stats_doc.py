@@ -100,15 +100,16 @@ def collect_names(pkg_root: str, repo_root: str,
     return names
 
 
-def inventory_rows(coverage_path: str):
-    """[(cells, line)] for every data row of the COVERAGE.md 'Metrics
-    inventory' table (header/separator rows skipped); [] when the
-    section is absent. The ONE parser of that table — stats-doc and
-    gauge-discipline both consume it, so a format tweak cannot desync
-    them silently."""
+def inventory_rows(coverage_path: str,
+                   section: str = "### Metrics inventory"):
+    """[(cells, line)] for every data row of a COVERAGE.md `section`
+    table (header/separator rows skipped); [] when the section is
+    absent. The ONE parser of those tables — stats-doc,
+    gauge-discipline AND audit-reasons consume it, so a format tweak
+    cannot desync them silently."""
     with open(coverage_path, encoding="utf-8") as f:
         text = f.read()
-    idx = text.find("### Metrics inventory")
+    idx = text.find(section)
     if idx < 0:
         return []
     base_line = text[:idx].count("\n") + 1
@@ -120,7 +121,7 @@ def inventory_rows(coverage_path: str):
         if not s.startswith("|"):
             continue
         cells = [c.strip() for c in s.strip("|").split("|")]
-        if not cells or cells[0] == "Name" or \
+        if not cells or cells[0] in ("Name", "Code") or \
                 set(cells[0]) <= {"-", ":"}:
             continue
         out.append((cells, base_line + off))
