@@ -697,6 +697,98 @@ def bench_generation():
     finally:
         paddle.set_flags(prev_ring)
 
+    # ---- prefix-cache arm (ISSUE 12): N requests sharing one long
+    # system prompt, TTFT measured per request via submit_stream (time
+    # to the first streamed token). Gates: TTFT p50 >= 2x better with
+    # the prefix cache ON at equal pool bytes (same num_pages, same
+    # dtype), token-identical outputs across arms, and ZERO post-warmup
+    # compiles in either arm — prefix hits ride the warmed
+    # prefill_tail buckets, they must not mint new ones.
+    # the prefix is LONG (12 pages) relative to the tail (1 page) so
+    # prefill compute, not per-dispatch overhead, is what the cache
+    # elides — the shared-system-prompt shape the ISSUE names
+    PFX, TAIL = 12 * PAGE, PAGE
+    MAXN_P = 8 if _SMOKE else 32
+    N_PFX = 16 if _SMOKE else 32
+    paddle.seed(0)
+    cfg_p = GPTConfig(vocab_size=VOCAB, hidden_size=HID,
+                      num_layers=LAYERS, num_heads=HEADS,
+                      intermediate_size=4 * HID,
+                      max_position_embeddings=PFX + TAIL + MAXN_P,
+                      dropout=0.0)
+    net_p = GPTForCausalLM(cfg_p)
+    net_p.eval()
+    rng_p = np.random.RandomState(7)
+    sys_prompt = rng_p.randint(0, VOCAB, size=(PFX,)).astype("int64")
+    pfx_prompts = [np.concatenate([sys_prompt,
+                                   rng_p.randint(0, VOCAB, size=(TAIL,))
+                                   .astype("int64")])
+                   for _ in range(N_PFX)]
+    pages_p = SLOTS * -(-(PFX + TAIL + MAXN_P) // PAGE) \
+        + PFX // PAGE + 1
+
+    def prefix_arm(on):
+        eng = serving.GenerationEngine(
+            net_p, max_slots=SLOTS, page_size=PAGE, num_pages=pages_p,
+            prefill_buckets=(TAIL, PFX + TAIL), max_new_tokens=MAXN_P,
+            max_queue_depth=2 * N_PFX, request_timeout_ms=0,
+            prefix_cache=on,
+            name=f"bench_prefix_{'on' if on else 'off'}")
+        warm_ledger = dict(eng.stats()["compiles"])
+        start = threading.Barrier(N_PFX + 1)
+        ttfts = [None] * N_PFX
+        outs = [None] * N_PFX
+        errors = []
+
+        def client(i):
+            try:
+                start.wait()
+                t0 = time.perf_counter()
+                stream = eng.submit_stream(pfx_prompts[i],
+                                           max_new_tokens=MAXN_P)
+                next(iter(stream))           # TTFT: first streamed token
+                ttfts[i] = (time.perf_counter() - t0) * 1e3
+                for _ in stream:             # drain to completion
+                    pass
+                outs[i] = stream.result(timeout=600)
+            except Exception as e:  # noqa: BLE001
+                errors.append(e)
+
+        threads = [threading.Thread(target=client, args=(i,),
+                                    daemon=True) for i in range(N_PFX)]
+        for t in threads:
+            t.start()
+        start.wait()
+        for t in threads:
+            t.join()
+        if errors:
+            raise RuntimeError(f"{len(errors)}/{N_PFX} prefix-arm "
+                               f"clients failed: {errors[0]!r}")
+        s_arm = eng.stats()
+        eng.shutdown()
+        live_compiles = {k: v for k, v in s_arm["compiles"].items()
+                         if warm_ledger.get(k) != v}
+        p50 = sorted(ttfts)[N_PFX // 2]
+        return p50, outs, s_arm, live_compiles
+
+    ttft_on, outs_on, s_on, live_on = prefix_arm(True)
+    ttft_off, outs_off, s_off, live_off = prefix_arm(False)
+    token_identical = all(np.array_equal(a, b)
+                          for a, b in zip(outs_on, outs_off))
+    prefix_arm_extra = {
+        "requests": N_PFX,
+        "shared_prefix_tokens": PFX,
+        "tail_tokens": TAIL,
+        "pool_pages": pages_p,
+        "ttft_p50_ms_cache_on": round(ttft_on, 3),
+        "ttft_p50_ms_cache_off": round(ttft_off, 3),
+        "ttft_speedup": round(ttft_off / max(ttft_on, 1e-9), 3),
+        "token_identical_on_vs_off": token_identical,
+        "prefix_stats": s_on["kv"]["prefix"],
+        "post_warmup_compiles": {"on": live_on, "off": live_off},
+        "ledger_on": s_on["compiles"],
+    }
+
     ledger = s["compiles"]
     decode_compiles = sum(v for k, v in ledger.items()
                           if k.startswith("decode"))
@@ -723,6 +815,7 @@ def bench_generation():
         "ttft_ms": s["ttft_ms"],
         "tpot_ms": s["tpot_ms"],
         "e2e_ms": s["latency_ms"],
+        "prefix_arm": prefix_arm_extra,
     }
     return eng_tps, extra
 
@@ -1651,6 +1744,25 @@ def _run_mode(mode="train", backend=None):
                     f"REGRESSION: step-ring accounting costs "
                     f"{extra['step_log_overhead_pct']}% tokens/sec — "
                     f"above the 2% ceiling (FLAGS_gen_step_log A/B)\n")
+            parm = extra["prefix_arm"]
+            if parm["ttft_speedup"] < 2.0:
+                sys.stderr.write(
+                    f"REGRESSION: prefix cache improves shared-system-"
+                    f"prompt TTFT p50 only {parm['ttft_speedup']}x at "
+                    f"equal pool bytes — below the 2x acceptance "
+                    f"floor\n")
+            if not parm["token_identical_on_vs_off"]:
+                sys.stderr.write(
+                    "REGRESSION: greedy output differs with the prefix "
+                    "cache on vs off — cached pages must hold the same "
+                    "K/V the skipped prefill would have produced\n")
+            if parm["post_warmup_compiles"]["on"] \
+                    or parm["post_warmup_compiles"]["off"]:
+                sys.stderr.write(
+                    f"REGRESSION: prefix-arm traffic compiled after "
+                    f"warmup {parm['post_warmup_compiles']} — prefix "
+                    f"hits must ride the warmed prefill_tail buckets, "
+                    f"never mint new ones\n")
         except Exception as e:  # noqa: BLE001
             traceback.print_exc()
             _emit(headline, 0.0, "tokens/sec",

@@ -116,6 +116,14 @@ register_flag("FLAGS_gen_request_timeout_ms", 30000.0,
               "enforced while queued AND before every decode step — an "
               "expired sequence is cancelled mid-decode, its pages freed, "
               "only its own future fails (0 disables)")
+register_flag("FLAGS_gen_prefix_cache", False,
+              "serving.GenerationEngine: content-hash prefix cache over "
+              "the paged KV pools (serving/prefix_cache.py) — a request "
+              "whose prompt prefix matches a cached block chain maps "
+              "those pages read-only (copy-on-write on the one "
+              "divergent write) and prefills only the tail; refcount-0 "
+              "chains are LRU-evicted before alloc. Opt-in: off keeps "
+              "the PR 8 single-owner page semantics exactly")
 register_flag("FLAGS_gen_step_log", True,
               "serving.GenerationEngine: record one compact scheduler "
               "record per engine iteration into the bounded per-engine "

@@ -13,8 +13,8 @@ from ..nn import initializer as I
 from ..ops import creation, manipulation
 
 __all__ = ["GPTConfig", "GPTModel", "GPTForCausalLM", "MoEFeedForward",
-           "gpt_prefill", "gpt_decode_step", "gpt_logits",
-           "dense_cache_write", "dense_cache_attend"]
+           "gpt_prefill", "gpt_prefill_extend", "gpt_decode_step",
+           "gpt_logits", "dense_cache_write", "dense_cache_attend"]
 
 
 # -- shared decode math (generate() AND serving.GenerationEngine) -----------
@@ -55,6 +55,42 @@ def gpt_logits(W, h):
     return _gen_ln(h, lnfw, lnfb) @ W["wte"].T
 
 
+def _gen_block_pass(W, h, attend, *, num_heads):
+    """The ONE batched transformer-block loop both prefill flavors run:
+    LN → QKV heads → `attend(layer, q, k, v)` → output proj + MLP
+    residuals, collecting per-layer K/V. The attention expression is
+    the only thing that differs between a full prefill (causal within
+    the batch) and a tail prefill (cached context + within-tail) — it
+    lives in the caller's hook, so the `_gen_w` quant hooks, gelu
+    flavor and head-reshape discipline can never diverge between the
+    two paths. Returns `(h, ks, vs)`."""
+    import jax
+
+    B, S = h.shape[:2]
+    H = num_heads
+    E = h.shape[-1]
+    D = E // H
+    ks, vs = [], []
+    for i, (l1w, l1b, wq, bq, wk, bk, wv, bv, wo, bo, l2w, l2b,
+            w1, b1, w2, b2) in enumerate(W["blocks"]):
+        x = _gen_ln(h, l1w, l1b)
+
+        def heads(t):
+            return t.reshape(B, S, H, D).transpose(0, 2, 1, 3)
+        q = heads(x @ _gen_w(wq, x.dtype) + bq)
+        k = heads(x @ _gen_w(wk, x.dtype) + bk)
+        v = heads(x @ _gen_w(wv, x.dtype) + bv)
+        ks.append(k)
+        vs.append(v)
+        o = attend(i, q, k, v)
+        o = o.transpose(0, 2, 1, 3).reshape(B, S, E)
+        h = h + (o @ _gen_w(wo, h.dtype) + bo)
+        x2 = _gen_ln(h, l2w, l2b)
+        h = h + (jax.nn.gelu(x2 @ _gen_w(w1, h.dtype) + b1,
+                             approximate=False) @ _gen_w(w2, h.dtype) + b2)
+    return h, jnp.stack(ks), jnp.stack(vs)
+
+
 def gpt_prefill(W, ids, *, num_heads, scale):
     """One batched causal pass over the whole prompt — the MXU sees
     [B,S,E] matmuls, not S tiny ones. Returns `(h, ks, vs)`: `h` [B,S,E]
@@ -66,34 +102,41 @@ def gpt_prefill(W, ids, *, num_heads, scale):
     one compiled shape."""
     import jax
 
-    B, S = ids.shape
-    H = num_heads
+    _, S = ids.shape
     h = W["wte"][ids] + W["wpe"][jnp.arange(S)][None]
-    E = h.shape[-1]
-    D = E // H
-    ks, vs = [], []
-    for (l1w, l1b, wq, bq, wk, bk, wv, bv, wo, bo, l2w, l2b,
-         w1, b1, w2, b2) in W["blocks"]:
-        x = _gen_ln(h, l1w, l1b)
 
-        def heads(t):
-            return t.reshape(B, S, H, D).transpose(0, 2, 1, 3)
-        q = heads(x @ _gen_w(wq, x.dtype) + bq)
-        k = heads(x @ _gen_w(wk, x.dtype) + bk)
-        v = heads(x @ _gen_w(wv, x.dtype) + bv)
-        ks.append(k)
-        vs.append(v)
+    def attend(layer, q, k, v):
         s = jnp.einsum("bhqd,bhkd->bhqk", q, k) * scale
         causal = jnp.tril(jnp.ones((S, S), bool))
         s = jnp.where(causal, s, -1e30)
         p = jax.nn.softmax(s, axis=-1)
-        o = jnp.einsum("bhqk,bhkd->bhqd", p, v)
-        o = o.transpose(0, 2, 1, 3).reshape(B, S, E)
-        h = h + (o @ _gen_w(wo, h.dtype) + bo)
-        x2 = _gen_ln(h, l2w, l2b)
-        h = h + (jax.nn.gelu(x2 @ _gen_w(w1, h.dtype) + b1,
-                             approximate=False) @ _gen_w(w2, h.dtype) + b2)
-    return h, jnp.stack(ks), jnp.stack(vs)
+        return jnp.einsum("bhqk,bhkd->bhqd", p, v)
+
+    return _gen_block_pass(W, h, attend, num_heads=num_heads)
+
+
+def gpt_prefill_extend(W, ids, positions, ctx_attend, *, num_heads,
+                       scale):
+    """Batched causal pass over a prompt TAIL whose prefix K/V already
+    lives in an external cache (the prefix-cache hit path, ISSUE 12).
+
+    ids [B, S_t] tail token ids at absolute positions `positions` [S_t]
+    (the caller clamps pad positions into range); attention is
+    delegated per layer to
+
+        ctx_attend(layer, q, k, v) -> [B, H, S_t, D]
+
+    with q/k/v the tail's own projections — the hook attends each tail
+    query over (external cached context + the given within-tail K/V)
+    and owns the cache layout, masks AND the softmax scale, the same
+    seam discipline as `gpt_decode_step`'s write_kv/attend. Returns
+    `(h, ks, vs)` exactly like `gpt_prefill` ([B,S_t,E] hidden states,
+    [L,B,H,S_t,D] per-layer tail K/V for the caller's cache writes) —
+    both flavors share `_gen_block_pass`, so the block math literally
+    cannot diverge from the full-prefill oracle."""
+    del scale  # the ctx_attend hook owns the scale (kept for symmetry)
+    h = W["wte"][ids] + W["wpe"][positions][None]
+    return _gen_block_pass(W, h, ctx_attend, num_heads=num_heads)
 
 
 def gpt_decode_step(W, tok, pos, cache, write_kv, attend, *, num_heads,

@@ -38,7 +38,8 @@ from ..framework import monitor
 from ..framework.flags import flag
 
 __all__ = ["cached_attention", "paged_attention", "paged_gather",
-           "paged_gather_quantized", "paged_write",
+           "paged_gather_layers", "paged_gather_quantized",
+           "paged_prefix_attention", "paged_write",
            "paged_write_quantized", "page_rows_for_positions"]
 
 
@@ -128,7 +129,8 @@ def paged_gather_quantized(pages, scales, page_table, dtype=jnp.float32):
     return jnp.moveaxis(kb, 1, 0).reshape(B, H, PP * P, D)
 
 
-def paged_write_quantized(pages, scales, layer, page_ids, offsets, values):
+def paged_write_quantized(pages, scales, layer, page_ids, offsets, values,
+                          requant=False):
     """Quantize-on-append into int8 pools; returns (pages, scales).
 
     Decode (`layer` an int): page_ids/offsets [B], values [B, H, D] —
@@ -141,15 +143,36 @@ def paged_write_quantized(pages, scales, layer, page_ids, offsets, values):
     Prefill (`layer=None`): page_ids/offsets [S], values [L, H, S, D] —
     scatter-max builds each target page's scale over every token landing
     in it, then all tokens quantize against their page's final scale.
-    Assumes the target pages are freshly zeroed (scale 0) — exactly what
-    zero-on-free guarantees for an alloc; the trash page (padded prefill
-    tails) accumulates junk between frees, which dequantizes finite and
-    is masked out, same as the fp32 contract."""
+    Assumes freshly zeroed target pages (scale 0 — exactly what
+    zero-on-free guarantees for an alloc) UNLESS `requant=True` (a
+    trace-time switch): the tail-prefill program (prefix cache,
+    ISSUE 12) can write onto a copy-on-write split page that arrives
+    with cloned content + a non-zero scale, so it additionally
+    requantizes the target pages' existing content onto the (possibly
+    widened) grid before the token writes land — growing the grid
+    without requantizing would silently inflate every prior token on
+    dequant. The full-prefill program keeps `requant=False` and skips
+    that whole-page traffic (for zeroed pages it would rewrite zeros
+    with zeros). The trash page (padded prefill tails) accumulates junk
+    between frees, which dequantizes finite and is masked out, same as
+    the fp32 contract."""
     monitor.stat_add("STAT_kv_quant_writes")  # traces, not calls
     if layer is None:
         a = jnp.max(jnp.abs(values), axis=-1) / 127.0        # [L, H, S]
+        s_old = scales[:, :, page_ids]                       # [L, H, S]
         scales = scales.at[:, :, page_ids].max(a)            # dup-safe
         s_tok = scales[:, :, page_ids]                       # [L, H, S]
+        if requant:
+            # duplicate page ids are safe — s_old/s_tok are per-page,
+            # so duplicates compute identical requantized pages and the
+            # scatter's last-writer-wins is a no-op
+            fdt = values.dtype
+            pk = pages[:, :, page_ids]                       # [L,H,S,P,D]
+            ratio = jnp.where(
+                s_tok > 0, s_old / jnp.where(s_tok > 0, s_tok, 1.0), 1.0)
+            pk = jnp.round(pk.astype(fdt) * ratio[..., None, None]) \
+                .astype(jnp.int8)
+            pages = pages.at[:, :, page_ids].set(pk)
         q = _q8(values, s_tok[..., None])
         return pages.at[:, :, page_ids, offsets, :].set(q), scales
     B = page_ids.shape[0]
@@ -221,3 +244,52 @@ def paged_attention(q, k_pages, v_pages, page_table, pos, scale,
     kb = paged_gather(k_pages, page_table)
     vb = paged_gather(v_pages, page_table)
     return cached_attention(q, kb, vb, pos, scale)
+
+
+def paged_gather_layers(pages, page_table, scales=None,
+                        dtype=jnp.float32):
+    """Materialize ONE sequence's page-table row as a dense view across
+    ALL layers at once: pages [L, H, N, P, D] + page_table [PP] →
+    [L, H, PP*P, D] (dequantized via per-page `scales` [L, H, N] in the
+    int8 mode). One gather from the whole pool instead of a per-layer
+    `pages[layer]` slice — slicing the [L, ...] pool per layer copies
+    the full layer buffer each time, which dwarfs the tail prefill's
+    actual compute; gathering first touches only this row's pages."""
+    L, H, _, P, D = pages.shape
+    PP = page_table.shape[0]
+    kb = jnp.take(pages, page_table, axis=2)       # [L, H, PP, P, D]
+    if scales is not None:
+        sc = jnp.take(scales, page_table, axis=2)  # [L, H, PP]
+        kb = kb.astype(dtype) * sc[..., None, None].astype(dtype)
+    return kb.reshape(L, H, PP * P, D)
+
+
+def paged_prefix_attention(q, kb, vb, k_tail, v_tail, prefix_len, scale):
+    """Tail-prefill attention: multi-position queries over a cached
+    prefix (pre-gathered from pages) plus the tail's own in-flight K/V.
+
+    q / k_tail / v_tail [B, H, S, D]; kb/vb [B, H, T, D] — ONE layer of
+    the `paged_gather_layers` view of the sequence's page-table row;
+    prefix_len scalar int32 — cached positions t < prefix_len are
+    attended, everything at or past it in the gathered view (fresh
+    pages, table padding) masks to exact 0.0. Tail position j is
+    attended by tail query i iff j <= i (causal within the tail; the
+    tail K/V never round-trips through the pages, so the page gather
+    stays READ-ONLY — pad tail positions are routed to the scratch page
+    by the caller's WRITE, never read here). Returns [B, H, S, D].
+
+    The joint softmax over [prefix ; tail] is the same masked-softmax
+    expression as `cached_attention` (-1e30 → exact 0.0), so a tail
+    prefill is anchored to the same oracle as the decode step."""
+    monitor.stat_add("STAT_paged_attn_reference")  # traces, not calls
+    T = kb.shape[2]
+    S = q.shape[2]
+    sp = jnp.einsum("bhsd,bhtd->bhst", q, kb) * scale
+    sp = jnp.where(jnp.arange(T)[None, None, None, :] < prefix_len,
+                   sp, -1e30)
+    st = jnp.einsum("bhsd,bhtd->bhst", q, k_tail) * scale
+    causal = jnp.arange(S)[:, None] >= jnp.arange(S)[None, :]
+    st = jnp.where(causal[None, None], st, -1e30)
+    p = jax.nn.softmax(jnp.concatenate([sp, st], axis=-1), axis=-1)
+    return (jnp.einsum("bhst,bhtd->bhsd", p[..., :T], vb)
+            + jnp.einsum("bhst,bhtd->bhsd", p[..., T:], v_tail))

@@ -42,6 +42,12 @@ __all__ = ["REASONS", "AuditLog", "tail_for"]
 # lint). Codes are past-tense facts about one request.
 REASONS = frozenset({
     "ADMIT",               # request took a slot + worst-case pages
+    "ADMIT_PREFIX_HIT",    # admit whose prompt prefix mapped cached
+                           # pages read-only; only the tail prefilled
+    "COW_SPLIT",           # shared page split private before the one
+                           # divergent write (full-prompt match)
+    "EVICT_PREFIX_LRU",    # refcount-0 cached chain pages reclaimed
+                           # LRU, before an admission's alloc
     "DEFER_PAGES",         # admission deferred: free pages < worst case
     "DEFER_SLOTS",         # admission deferred: every decode slot busy
     "REJECT_QUEUE_FULL",   # submit shed by EngineOverloaded backpressure

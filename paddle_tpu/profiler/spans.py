@@ -184,8 +184,9 @@ def start(engine: str) -> Optional[Span]:
 # with the engine's own `<name>_request_ms` histogram carrying the full
 # queued → resolved wall (the resolve tail is host bookkeeping). Each
 # resolved request also drops one self-contained `reqspan:` instant
-# (slot-flavored: `reqspan:<rid>:<engine>:slot<k>:n=<tok>:ttft=…,
-# tpot=…,e=…`) so `tools/latency_report.py` reconstructs TTFT/TPOT
+# (slot-flavored: `reqspan:<rid>:<engine>:slot<k>:n=<tok>:
+# ttft=…,tpot=…,e=…,pfx=<hit>`, `pfx` = prompt tokens served from the
+# prefix cache) so `tools/latency_report.py` reconstructs TTFT/TPOT
 # p50/p99 and slowest-request offenders offline from an exported trace.
 
 GEN_PHASES = ("queued", "admitted", "prefilled", "first_token",
@@ -207,15 +208,19 @@ def _gen_phase_hists():
 
 class GenSpan:
     """One generative request's token clock (single-writer: the engine's
-    step thread owns every stamp after `queued`)."""
+    step thread owns every stamp after `queued`). `prefix_tokens` is the
+    count of prompt tokens served from cached prefix pages (ISSUE 12) —
+    it rides the reqspan instant (`pfx=`) so offline TTFT attribution
+    can split hit from miss requests."""
 
-    __slots__ = ("rid", "engine", "slot", "stamps")
+    __slots__ = ("rid", "engine", "slot", "stamps", "prefix_tokens")
 
     def __init__(self, engine: str):
         self.rid = next(_next_id)
         self.engine = engine
         self.slot: Optional[int] = None
         self.stamps = {}
+        self.prefix_tokens = 0
 
     def stamp(self, phase: str, t: Optional[float] = None) -> None:
         self.stamps[phase] = time.perf_counter() if t is None else t
@@ -223,9 +228,12 @@ class GenSpan:
     def flow(self, ph: str) -> None:
         tracer.flow("gen_request", ph, self.rid)
 
-    def finish(self, n_tokens: int) -> None:
+    def finish(self, n_tokens: int,
+               prefix_tokens: Optional[int] = None) -> None:
         """Called once per DELIVERED request after `resolved` is
         stamped: feed ttft_ms/tpot_ms and drop the reqspan instant."""
+        if prefix_tokens is not None:
+            self.prefix_tokens = int(prefix_tokens)
         s = self.stamps
         if "queued" not in s or "first_token" not in s:
             return
@@ -244,9 +252,12 @@ class GenSpan:
         if n_tokens > 1:
             slo.observe_tpot(self.engine, max(0.0, tpot))
         e2e = (s.get("resolved", last) - s["queued"]) * 1000.0
+        # pfx rides the VALUES segment (after e=) so the colon-separated
+        # head keeps its field count — downstream parsers split on ":"
         tracer.instant(
             f"reqspan:{self.rid}:{self.engine}:slot{self.slot}:"
-            f"n={n_tokens}:ttft={ttft:.3f},tpot={tpot:.3f},e={e2e:.3f}",
+            f"n={n_tokens}:ttft={ttft:.3f},tpot={tpot:.3f},e={e2e:.3f},"
+            f"pfx={self.prefix_tokens}",
             t=s.get("resolved", last))
 
     def to_dict(self) -> dict:

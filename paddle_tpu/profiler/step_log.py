@@ -23,6 +23,11 @@ oldest overwritten — the same bounding discipline as the trace rings):
                   is the oldest)
     pages_in_use / free_pages
                   page-pool occupancy after the iteration
+    prefix_tokens / cow_splits
+                  prompt tokens served from cached prefix pages and
+                  copy-on-write page splits performed THIS iteration
+                  (ISSUE 12 — the prefix-cache effectiveness signal,
+                  per iteration)
     prefill_ms / decode_ms
                   wall spent in prefill jit calls vs the decode step
                   this iteration — the "is one long prompt spiking
@@ -56,7 +61,8 @@ __all__ = ["StepRecord", "StepLog", "enabled", "register", "unregister",
 
 _FIELDS = ("it", "step", "t", "live", "admitted", "completed", "expired",
            "poisoned", "aborted", "freed", "queue_depth", "oldest_age_ms",
-           "pages_in_use", "free_pages", "prefill_ms", "decode_ms")
+           "pages_in_use", "free_pages", "prefix_tokens", "cow_splits",
+           "prefill_ms", "decode_ms")
 
 
 def enabled() -> bool:

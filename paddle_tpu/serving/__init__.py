@@ -39,8 +39,11 @@ class EngineOverloaded(ResourceExhaustedError):
 
 
 from .engine import EngineConfig, InferenceEngine  # noqa: E402
-from .generation import GenerationConfig, GenerationEngine  # noqa: E402
+from .generation import (GenerationConfig, GenerationEngine,  # noqa: E402
+                         TokenStream)
 from .kv_cache import PagedKVCache  # noqa: E402
+from .prefix_cache import PrefixCache  # noqa: E402
 
 __all__ = ["InferenceEngine", "EngineConfig", "EngineOverloaded",
-           "GenerationEngine", "GenerationConfig", "PagedKVCache"]
+           "GenerationEngine", "GenerationConfig", "PagedKVCache",
+           "PrefixCache", "TokenStream"]

@@ -25,6 +25,34 @@ vs fp32 pages is token-level (different compiled programs). A
 `quantize_weights`'d model composes independently: its decode-weight
 pytree carries (int8, scale) leaves dequantized in-graph.
 
+**Prefix cache (ISSUE 12, `FLAGS_gen_prefix_cache` /
+`prefix_cache=True`)**: full pages of prompt K/V are indexed by a
+content-hash block chain (`serving/prefix_cache.py`) over refcounted
+pages; a request whose prompt walks a cached chain maps those pages
+read-only and prefills ONLY the tail through a per-bucket
+`prefill_tail` program (tail queries attend cached pages + their own
+in-flight K/V — `ops/paged_ops.paged_prefix_attention`). A full-prompt
+match recomputes just its last position, copy-on-write splitting the
+page that holds it (int8 mode clones the scale row too) so the shared
+original is never written under other readers. Zero-on-free keys on
+refcounts — a freed sequence's shared pages survive for future hits —
+and refcount-0 cached chains are LRU-evicted BEFORE alloc whenever the
+free list alone is short, so `can_admit`/`headroom` count them as
+reclaimable. TTFT collapses for shared-system-prompt traffic while
+greedy output stays token-identical with the cache off: the cached
+pages hold the same K/V the skipped prefill would have produced.
+
+**Streaming (`submit_stream`)**: a per-token `TokenStream` fed from the
+step thread — each token is staged during the iteration and delivered
+only after `_record_iteration` lands (the same deferred-resolution
+barrier as futures, so a consumer never observes a token the step ring
+doesn't account for yet), and the final token always precedes the
+future's resolution. Stream deadlines split: `ttft_timeout_ms` is HARD
+(expiry before the first token cancels with `ExecutionTimeoutError`),
+`timeout_ms` is SOFT once tokens flow (expiry mid-stream stops decoding
+and resolves with what was delivered — tokens already left the engine
+and cannot be retracted).
+
 Hardening carries over from the one-shot engine, re-expressed at token
 granularity: bounded intake (`EngineOverloaded`), worst-case page
 admission control (a request is only admitted when the allocator can
@@ -47,6 +75,7 @@ scale-out = one engine per chip behind the router tier's `/readyz`.
 from __future__ import annotations
 
 import itertools
+import queue as _queue
 import threading
 import time
 from collections import deque
@@ -63,6 +92,7 @@ from ..framework.flags import flag
 from ..profiler import (RecordEvent, audit, device_telemetry, exporter,
                         flight_recorder, slo, spans, step_log)
 from .kv_cache import TRASH_PAGE, PagedKVCache
+from .prefix_cache import PrefixCache
 
 # the intake queue legitimately moves both ways; registering it as an
 # "updown" gauge makes the exporter render a Prometheus gauge while the
@@ -70,7 +100,7 @@ from .kv_cache import TRASH_PAGE, PagedKVCache
 # (monitor is the single registry of gauge names — ISSUE 11)
 monitor.register_gauge("STAT_gen_queue_depth", updown=True)
 
-__all__ = ["GenerationConfig", "GenerationEngine"]
+__all__ = ["GenerationConfig", "GenerationEngine", "TokenStream"]
 
 
 def _now_ms() -> float:
@@ -91,6 +121,7 @@ class GenerationConfig:
                  max_queue_depth: Optional[int] = None,
                  request_timeout_ms: Optional[float] = None,
                  kv_cache_dtype: Optional[str] = None,
+                 prefix_cache: Optional[bool] = None,
                  top_k: int = 0, seed: int = 0, warmup: bool = True):
         self.max_slots = int(flag("FLAGS_gen_max_slots")
                              if max_slots is None else max_slots)
@@ -126,21 +157,74 @@ class GenerationConfig:
             raise InvalidArgumentError(
                 f"kv_cache_dtype must be auto/int8/float32/bfloat16, "
                 f"got {self.kv_cache_dtype!r}")
+        self.prefix_cache = bool(flag("FLAGS_gen_prefix_cache")
+                                 if prefix_cache is None else prefix_cache)
         self.top_k = int(top_k)
         self.seed = int(seed)
         self.warmup = bool(warmup)
+
+
+class TokenStream:
+    """Per-token delivery handle returned by
+    `GenerationEngine.submit_stream`.
+
+    Iterate it to receive generated token ids as the step thread
+    decodes them (each delivered AFTER its iteration's step-ring record
+    lands — the same deferred-resolution barrier futures honor);
+    iteration ends after the final token, and the streamed tokens
+    concatenate exactly to `result()`'s generated part. A failed
+    request raises the same exception from the iterator and from
+    `result()`. `result(timeout)` returns the full sequence (prompt +
+    generated, numpy int32) — the final token is always queued before
+    the future resolves, so a consumer woken by `result()` can drain
+    the remaining tokens without blocking."""
+
+    _END = object()
+
+    def __init__(self, future: Future):
+        self._q = _queue.SimpleQueue()
+        self._exc: Optional[BaseException] = None
+        self._ended = False
+        self.future = future
+
+    def _put(self, item) -> None:     # engine-side (step thread)
+        self._q.put(item)
+
+    def __iter__(self):
+        return self
+
+    def __next__(self) -> int:
+        if self._exc is not None:
+            raise self._exc
+        if self._ended:
+            raise StopIteration
+        item = self._q.get()
+        if item is TokenStream._END:
+            self._ended = True
+            raise StopIteration
+        if isinstance(item, BaseException):
+            self._exc = item
+            raise item
+        return int(item)
+
+    def result(self, timeout: Optional[float] = None) -> np.ndarray:
+        """The full sequence, exactly what `submit().result()` would
+        have returned for the same request."""
+        return self.future.result(timeout)
 
 
 class _GenRequest:
     __slots__ = ("rid", "prompt", "max_new", "eos", "do_sample",
                  "temperature", "future", "deadline_ms", "t_enqueue_ms",
                  "span", "slot", "pt_row", "toks", "next_pos", "ordinal",
-                 "defer_logged")
+                 "defer_logged", "stream", "ttft_deadline_ms",
+                 "prefix_tokens")
 
     _ids = itertools.count(1)
 
     def __init__(self, prompt, max_new, eos, do_sample, temperature,
-                 future, deadline_ms, t_enqueue_ms, span):
+                 future, deadline_ms, t_enqueue_ms, span,
+                 stream=None, ttft_deadline_ms=None):
         self.rid = next(self._ids)
         self.prompt = prompt            # np.int32 [S]
         self.max_new = max_new
@@ -157,6 +241,9 @@ class _GenRequest:
         self.next_pos = 0               # cache position the NEXT step writes
         self.ordinal = 0                # engine-local submit ordinal
         self.defer_logged = set()       # audit DEFER_* causes noted once
+        self.stream = stream            # TokenStream or None
+        self.ttft_deadline_ms = ttft_deadline_ms  # HARD (streams)
+        self.prefix_tokens = 0          # prompt tokens served from cache
 
 
 class GenerationEngine:
@@ -239,6 +326,11 @@ class GenerationEngine:
         self._vp = self._cache.v_pages
         self._ks = self._cache.k_scales
         self._vs = self._cache.v_scales
+        # prefix cache (ISSUE 12): content-hash chain index over the
+        # refcounted pages; None keeps the PR 8 ownership semantics
+        # exactly (every page refcount 1, nothing cached or shared)
+        self._prefix = (PrefixCache(self._cache, name)
+                        if self._cfg.prefix_cache else None)
 
         self._cv = threading.Condition()
         self._queue: deque = deque()
@@ -249,6 +341,10 @@ class GenerationEngine:
         # futures whose resolution is held until this iteration's
         # step-ring record lands (step-thread only; see _resolve_later)
         self._resolve_q: List[tuple] = []
+        # streamed tokens / end markers staged the same way — flushed
+        # BEFORE the futures, so a stream's final token always precedes
+        # its future's resolution (step-thread only)
+        self._stream_q: List[tuple] = []
         self._warmed = False
         self._steps_total = 0
         self._prefills_total = 0
@@ -270,6 +366,7 @@ class GenerationEngine:
         self._iters = 0
         self._it = {"admitted": 0, "completed": 0, "expired": 0,
                     "poisoned": 0, "aborted": 0, "freed": 0,
+                    "prefix_tokens": 0, "cow_splits": 0,
                     "prefill_ms": 0.0, "decode_ms": 0.0}
 
         self._build_programs()
@@ -321,9 +418,11 @@ class GenerationEngine:
         import jax
         import jax.numpy as jnp
 
-        from ..models.gpt import gpt_decode_step, gpt_logits, gpt_prefill
+        from ..models.gpt import (gpt_decode_step, gpt_logits,
+                                  gpt_prefill, gpt_prefill_extend)
         from ..ops.paged_ops import (page_rows_for_positions,
-                                     paged_attention, paged_write,
+                                     paged_attention, paged_gather_layers,
+                                     paged_prefix_attention, paged_write,
                                      paged_write_quantized)
 
         H, P, scale = self._H, self._cfg.page_size, self._scale
@@ -335,13 +434,18 @@ class GenerationEngine:
         NP = self._npool = 4 if quant else 2
         eng = self
 
-        def write_pages(pools, layer, page_ids, offs, k, v):
+        def write_pages(pools, layer, page_ids, offs, k, v,
+                        requant=False):
+            # requant=True only in the tail program: a CoW split page
+            # arrives with content + scale, every other prefill target
+            # is freshly zeroed (trace-time switch — the full-prefill
+            # program carries no whole-page requant traffic)
             if quant:
                 kp, vp, ksc, vsc = pools
                 kp, ksc = paged_write_quantized(kp, ksc, layer, page_ids,
-                                                offs, k)
+                                                offs, k, requant=requant)
                 vp, vsc = paged_write_quantized(vp, vsc, layer, page_ids,
-                                                offs, v)
+                                                offs, v, requant=requant)
                 return (kp, vp, ksc, vsc)
             kp, vp = pools
             # a forced narrower page dtype (kv_cache_dtype="bfloat16"
@@ -372,6 +476,70 @@ class GenerationEngine:
                                 ks[:, 0], vs[:, 0])
             idx = jnp.clip(length - 1, 0, S_b - 1)
             return (*pools, gpt_logits(W, h[0, idx]))
+
+        def tail_prefill_fn(W, *rest):
+            """Prefix-hit prefill: only the prompt TAIL runs the model —
+            queries attend the cached prefix pages READ-ONLY plus their
+            own in-flight K/V, and the writes land in the tail's pages
+            (bucket-pad positions routed to the scratch page, exactly
+            the full-prefill contract — a shared page never receives a
+            pad write). One compiled program per tail bucket."""
+            pools = rest[:NP]
+            pt_row, ids, length, offset = rest[NP:]
+            eng._note_trace(f"prefill_tail[b={ids.shape[1]}]")
+            S_b = ids.shape[1]
+            ar = jnp.arange(S_b)
+            valid = ar < length
+            # pad positions clamp to 0 so neither the wpe gather nor the
+            # page-index arithmetic ever reads out of range; their
+            # writes go to the scratch page below regardless
+            positions = jnp.where(valid, offset + ar, 0)
+            # gather the sequence's cached pages ONCE across all layers
+            # (dequantizing in the int8 mode) — per-layer pool slices
+            # would copy the whole layer buffer per layer, costing more
+            # than the tail's compute
+            if quant:
+                kp, vp, ksc, vsc = pools
+                kb_all = paged_gather_layers(kp, pt_row, ksc)
+                vb_all = paged_gather_layers(vp, pt_row, vsc)
+            else:
+                kp, vp = pools
+                kb_all = paged_gather_layers(kp, pt_row)
+                vb_all = paged_gather_layers(vp, pt_row)
+
+            def ctx_attend(layer, q, k, v):
+                return paged_prefix_attention(
+                    q, kb_all[layer][None], vb_all[layer][None],
+                    k, v, offset, scale)
+
+            h, ks, vs = gpt_prefill_extend(W, ids, positions, ctx_attend,
+                                           num_heads=H, scale=scale)
+            page_ids, offs = page_rows_for_positions(pt_row, positions, P)
+            page_ids = jnp.where(valid, page_ids, TRASH_PAGE)
+            offs = jnp.where(valid, offs, 0)
+            pools = write_pages(pools, None, page_ids, offs,
+                                ks[:, 0], vs[:, 0], requant=True)
+            idx = jnp.clip(length - 1, 0, S_b - 1)
+            return (*pools, gpt_logits(W, h[0, idx]))
+
+        def cow_fn(*rest):
+            """Copy-on-write page split: clone one page's content across
+            every layer/head from `src` to `dst` — including the
+            per-(layer, head, page) scale rows in the int8 mode, so the
+            private copy dequantizes identically to the shared
+            original."""
+            pools = rest[:NP]
+            src, dst = rest[NP], rest[NP + 1]
+            eng._note_trace("cow_copy")
+            if quant:
+                kp, vp, ksc, vsc = pools
+                return (kp.at[:, :, dst].set(kp[:, :, src]),
+                        vp.at[:, :, dst].set(vp[:, :, src]),
+                        ksc.at[:, :, dst].set(ksc[:, :, src]),
+                        vsc.at[:, :, dst].set(vsc[:, :, src]))
+            kp, vp = pools
+            return (kp.at[:, :, dst].set(kp[:, :, src]),
+                    vp.at[:, :, dst].set(vp[:, :, src]))
 
         def write_kv(cache, layer, k, v, pos):
             pools, pt = cache
@@ -423,9 +591,11 @@ class GenerationEngine:
 
         donate = tuple(range(1, 1 + NP))
         self._prefill_jit = jax.jit(prefill_fn, donate_argnums=donate)
+        self._tail_jit = jax.jit(tail_prefill_fn, donate_argnums=donate)
         self._decode_jit = jax.jit(decode_fn, donate_argnums=donate)
         self._zero_jit = jax.jit(zero_fn,
                                  donate_argnums=tuple(range(NP)))
+        self._cow_jit = jax.jit(cow_fn, donate_argnums=tuple(range(NP)))
 
     def _dev_ctx(self):
         import jax
@@ -440,9 +610,20 @@ class GenerationEngine:
             return self._decode_jit(*args)
 
     def _zero_pages(self, pages):
-        row = self._cache.zero_rows(pages)
+        # chunked to the fixed zero-scatter width: one sequence's free
+        # fits a single row, but a prefix-cache eviction sweep can
+        # return more pages than pages_per_seq at once
+        PP = self._cfg.pages_per_seq
+        for i in range(0, max(len(pages), 1), PP):
+            row = self._cache.zero_rows(pages[i:i + PP])
+            with self._dev_ctx():
+                self._set_pools(self._zero_jit(*self._pools(), row))
+
+    def _cow_copy(self, src: int, dst: int):
+        """Device-side CoW clone of one page (content + int8 scale row)."""
         with self._dev_ctx():
-            self._set_pools(self._zero_jit(*self._pools(), row))
+            self._set_pools(self._cow_jit(*self._pools(), np.int32(src),
+                                          np.int32(dst)))
 
     def _warmup(self):
         """Compile every prefill bucket + the decode step + the zeroing
@@ -460,6 +641,20 @@ class GenerationEngine:
                         self._W, *self._pools(), trash, ids, np.int32(1))
                 self._set_pools(out[:-1])
                 np.asarray(out[-1])
+                if self._prefix is not None:
+                    # one tail-prefill compile per bucket too: a prefix
+                    # hit must never pay a runtime compile, and the
+                    # ledger's exactly-once invariant covers both
+                    # prefill shapes from step one
+                    with self._dev_ctx():
+                        # lint: allow(use-after-donate): donate covers only the NP pool args in the *splat; trash/ids ride AFTER them (positions NP+1/NP+2), read-only across warmup prefills
+                        out = self._tail_jit(
+                            self._W, *self._pools(), trash, ids,  # lint: allow(use-after-donate): same — non-donated arg positions, reused read-only
+                            np.int32(1), np.int32(0))
+                    self._set_pools(out[:-1])
+                    np.asarray(out[-1])
+            if self._prefix is not None:
+                self._cow_copy(TRASH_PAGE, TRASH_PAGE)
             args = self._step_arrays()
             out = self._decode_call(self._W, *self._pools(), *args)
             np.asarray(out[-2])
@@ -478,6 +673,42 @@ class GenerationEngine:
         when hit, is included). Raises `EngineOverloaded` at
         max_queue_depth, `InvalidArgumentError`/`ResourceExhaustedError`
         for requests that could never run."""
+        return self._submit(prompt_ids, max_new_tokens, eos_token_id,
+                            timeout_ms, do_sample, temperature,
+                            stream=None, ttft_timeout_ms=None).future
+
+    def submit_stream(self, prompt_ids,
+                      max_new_tokens: Optional[int] = None,
+                      eos_token_id: Optional[int] = None,
+                      timeout_ms: Optional[float] = None,
+                      ttft_timeout_ms: Optional[float] = None,
+                      do_sample: bool = False,
+                      temperature: float = 1.0) -> TokenStream:
+        """Streaming submit: tokens leave the engine as they are
+        decoded. Returns a `TokenStream` — iterate it for per-token
+        delivery (each token lands after its iteration's step-ring
+        record; the final token always precedes the future's
+        resolution), `stream.result()` for the full sequence.
+
+        Deadline semantics split for streams (ISSUE 12):
+        `ttft_timeout_ms` is HARD — expiry before the first token
+        cancels the request with `ExecutionTimeoutError` (a stream that
+        cannot start on time is useless). `timeout_ms` is SOFT once
+        tokens flow — expiry mid-stream stops decoding, frees the
+        pages, and resolves the stream AND future with the tokens
+        already delivered (they left the engine; there is nothing to
+        retract), counted as a timeout for SLO purposes."""
+        if ttft_timeout_ms is not None and float(ttft_timeout_ms) < 0:
+            raise InvalidArgumentError("ttft_timeout_ms must be >= 0")
+        stream = TokenStream(Future())
+        self._submit(prompt_ids, max_new_tokens, eos_token_id,
+                     timeout_ms, do_sample, temperature,
+                     stream=stream, ttft_timeout_ms=ttft_timeout_ms)
+        return stream
+
+    def _submit(self, prompt_ids, max_new_tokens, eos_token_id,
+                timeout_ms, do_sample, temperature, stream,
+                ttft_timeout_ms) -> _GenRequest:
         from . import EngineOverloaded
         with RecordEvent("generation::submit"):
             from ..framework.tensor import Tensor
@@ -516,6 +747,8 @@ class GenerationEngine:
             t = _now_ms()
             tmo = (self._cfg.request_timeout_ms if timeout_ms is None
                    else float(timeout_ms))
+            ttft_tmo = (0.0 if ttft_timeout_ms is None
+                        else float(ttft_timeout_ms))
             reject_depth = None
             with self._cv:
                 if self._closed:
@@ -526,9 +759,13 @@ class GenerationEngine:
                 else:
                     req = _GenRequest(
                         prompt, max_new, eos_token_id, bool(do_sample),
-                        float(temperature), Future(),
+                        float(temperature),
+                        stream.future if stream is not None else Future(),
                         None if not tmo else t + tmo, t,
-                        spans.start_gen(self.name))
+                        spans.start_gen(self.name),
+                        stream=stream,
+                        ttft_deadline_ms=(t + ttft_tmo if ttft_tmo
+                                          else None))
                     self._req_seq += 1
                     req.ordinal = self._req_seq
                     self._queue.append(req)
@@ -547,7 +784,7 @@ class GenerationEngine:
                     f"{self._cfg.max_queue_depth} reached; shed load "
                     f"or raise FLAGS_gen_max_queue_depth")
             monitor.stat_add("STAT_gen_requests")
-            return req.future
+            return req
 
     def generate(self, prompt_ids, **kw) -> np.ndarray:
         """Synchronous submit: blocks for this prompt's full sequence."""
@@ -611,8 +848,8 @@ class GenerationEngine:
         can't leak one arm's counts into the other."""
         it, self._it = self._it, {
             "admitted": 0, "completed": 0, "expired": 0, "poisoned": 0,
-            "aborted": 0, "freed": 0, "prefill_ms": 0.0,
-            "decode_ms": 0.0}
+            "aborted": 0, "freed": 0, "prefix_tokens": 0,
+            "cow_splits": 0, "prefill_ms": 0.0, "decode_ms": 0.0}
         if self._step_log is None:
             return
         self._iters += 1
@@ -632,6 +869,8 @@ class GenerationEngine:
             admitted=it["admitted"], completed=it["completed"],
             expired=it["expired"], poisoned=it["poisoned"],
             aborted=it["aborted"], freed=it["freed"],
+            prefix_tokens=it["prefix_tokens"],
+            cow_splits=it["cow_splits"],
             prefill_ms=round(it["prefill_ms"], 3),
             decode_ms=round(it["decode_ms"], 3))
         self._step_log.record(rec)
@@ -644,7 +883,28 @@ class GenerationEngine:
         record landed and see counts that don't reconcile."""
         self._resolve_q.append((fut, result, exc))
 
+    def _resolve_req_later(self, req: _GenRequest, result=None, exc=None):
+        """Request-level resolution: the stream (when present) gets its
+        terminal marker — the error, or the end-of-stream sentinel —
+        staged BEFORE the future, behind the same barrier."""
+        if req.stream is not None:
+            self._stream_q.append((req.stream,
+                                   exc if exc is not None
+                                   else TokenStream._END))
+        self._resolve_later(req.future, result, exc)
+
+    def _stage_token(self, req: _GenRequest, tok: int):
+        """Stage one decoded token for post-barrier stream delivery."""
+        if req.stream is not None:
+            self._stream_q.append((req.stream, tok))
+
     def _flush_resolutions(self):
+        # streams first: a stream's final token / terminal marker must
+        # be readable by the time its future resolves ("streamed tokens
+        # arrive before resolved")
+        sq, self._stream_q = self._stream_q, []
+        for stream, item in sq:
+            stream._put(item)
         q, self._resolve_q = self._resolve_q, []
         for fut, result, exc in q:
             try:
@@ -675,6 +935,10 @@ class GenerationEngine:
                                f"{e!r}")
         active = [r for r in self._slots if r is not None]
         for req in active + stranded:
+            if req.stream is not None:
+                # direct put (no barrier): the step loop is dead, no
+                # further _flush_resolutions will run
+                req.stream._put(err)
             try:
                 req.future.set_exception(err)
             except Exception:
@@ -723,53 +987,142 @@ class GenerationEngine:
                             "DEFER_SLOTS", rid=req.rid,
                             queue_depth=len(self._queue))
                     return
-                total = int(req.prompt.size) + req.max_new
-                if not self._cache.can_admit(total):
-                    monitor.stat_add("STAT_gen_admit_blocked")
-                    if "pages" not in req.defer_logged:
-                        req.defer_logged.add("pages")
+                S = int(req.prompt.size)
+                total = S + req.max_new
+                need = self._cache.pages_needed(total)
+                # prefix plan (ISSUE 12): the longest cached chain this
+                # prompt walks maps read-only; a FULL-prompt match keeps
+                # every page but must recompute its last position's
+                # logits, so the page holding position S-1 is CoW-split
+                # (the one divergent write) — tail length stays >= 1
+                # either way, there is always a token to prefill
+                digests, hit_pages = ([], [])
+                if self._prefix is not None:
+                    digests, hit_pages = self._prefix.lookup(req.prompt)
+                matched = len(hit_pages)
+                full_match = (matched > 0
+                              and matched * self._cfg.page_size == S)
+                fresh_needed = need - matched + (1 if full_match else 0)
+                pinned = bool(matched)
+                if pinned:
+                    # hold the matched chain across the eviction pass:
+                    # refcount >= 2 takes its pages out of the
+                    # evictable set, so the eviction below can never
+                    # reclaim the very pages this admission maps
+                    self._cache.pin(hit_pages)
+                try:
+                    if fresh_needed > self._cache.reclaimable_pages:
+                        monitor.stat_add("STAT_gen_admit_blocked")
+                        if "pages" not in req.defer_logged:
+                            req.defer_logged.add("pages")
+                            self._audit.audit(
+                                "DEFER_PAGES", rid=req.rid,
+                                need_pages=fresh_needed,
+                                free_pages=self._cache.free_pages,
+                                reclaimable=self._cache
+                                .reclaimable_pages)
+                        if not self._exhaust_dumped:
+                            self._exhaust_dumped = True
+                            flight_recorder.dump(
+                                "gen_allocator_exhausted", {
+                                    "engine": self.name, "rid": req.rid,
+                                    "need_pages": fresh_needed,
+                                    "cache": self._cache.stats(),
+                                    "queue_depth": len(self._queue),
+                                    "step_log_tail":
+                                        (self._step_log.tail(32)
+                                         if self._step_log is not None
+                                         else []),
+                                    "audit_tail": self._audit.tail(64)})
+                        return
+                    if fresh_needed > self._cache.free_pages:
+                        # evictable pages counted as admission capacity
+                        # above; reclaim them NOW, before alloc — the
+                        # deferred zero-on-free point for cached chains
+                        # (the pinned matched chain is never victimized)
+                        freed = self._prefix.evict(
+                            fresh_needed - self._cache.free_pages,
+                            exclude=hit_pages)
                         self._audit.audit(
-                            "DEFER_PAGES", rid=req.rid,
-                            need_pages=self._cache.pages_needed(total),
+                            "EVICT_PREFIX_LRU", rid=req.rid,
+                            pages=len(freed),
                             free_pages=self._cache.free_pages)
-                    if not self._exhaust_dumped:
-                        self._exhaust_dumped = True
-                        flight_recorder.dump("gen_allocator_exhausted", {
-                            "engine": self.name, "rid": req.rid,
-                            "need_pages":
-                                self._cache.pages_needed(total),
-                            "cache": self._cache.stats(),
-                            "queue_depth": len(self._queue),
-                            "step_log_tail":
-                                (self._step_log.tail(32)
-                                 if self._step_log is not None else []),
-                            "audit_tail": self._audit.tail(64)})
-                    return
-                self._queue.popleft()
-                monitor.stat_sub("STAT_gen_queue_depth")
-                if not req.future.set_running_or_notify_cancel():
-                    self._audit.audit("CANCELLED", rid=req.rid)
-                    continue
-                req.slot = slot
-                req.pt_row = self._cache.alloc(req.rid, total)
+                        if freed:
+                            self._zero_pages(freed)
+                        if fresh_needed > self._cache.free_pages:
+                            # under-delivery (every remaining chain is
+                            # live-shared or excluded): defer rather
+                            # than let alloc raise into engine death —
+                            # pages reclaim through those sequences'
+                            # frees
+                            monitor.stat_add("STAT_gen_admit_blocked")
+                            return
+                    self._queue.popleft()
+                    monitor.stat_sub("STAT_gen_queue_depth")
+                    if not req.future.set_running_or_notify_cancel():
+                        self._audit.audit("CANCELLED", rid=req.rid)
+                        if req.stream is not None:
+                            from concurrent.futures import CancelledError
+                            self._stream_q.append(
+                                (req.stream, CancelledError()))
+                        continue
+                    req.slot = slot
+                    req.pt_row = self._cache.alloc_shared(
+                        req.rid, total, hit_pages)
+                finally:
+                    if pinned:
+                        self._cache.unpin(hit_pages)
+                cow_src = cow_dst = None
+                if full_match:
+                    cow_src = hit_pages[-1]
+                    cow_dst = self._cache.cow_split(req.rid, cow_src)
+                    req.pt_row[matched - 1] = cow_dst
+                    monitor.stat_add("STAT_cow_splits")
+                    self._it["cow_splits"] += 1
+                    self._audit.audit("COW_SPLIT", rid=req.rid,
+                                      src_page=cow_src, dst_page=cow_dst)
+                req.prefix_tokens = ((S - 1) if full_match
+                                     else matched * self._cfg.page_size)
+                if self._prefix is not None:
+                    self._prefix.note_admitted(req.prefix_tokens)
+                self._it["prefix_tokens"] += req.prefix_tokens
                 self._slots[slot] = req
                 self._it["admitted"] += 1
-                self._audit.audit(
-                    "ADMIT", rid=req.rid, slot=slot,
-                    pages=self._cache.pages_needed(total),
-                    queued_ms=round(_now_ms() - req.t_enqueue_ms, 3))
+                if matched:
+                    self._audit.audit(
+                        "ADMIT_PREFIX_HIT", rid=req.rid, slot=slot,
+                        pages=need, shared_pages=matched,
+                        prefix_tokens=req.prefix_tokens,
+                        queued_ms=round(_now_ms() - req.t_enqueue_ms, 3))
+                else:
+                    self._audit.audit(
+                        "ADMIT", rid=req.rid, slot=slot, pages=need,
+                        queued_ms=round(_now_ms() - req.t_enqueue_ms, 3))
                 if req.span is not None:
                     req.span.slot = slot
+                    req.span.prefix_tokens = req.prefix_tokens
                     req.span.stamp("admitted")
-            self._do_prefill(req)
+            if cow_dst is not None:
+                # clone the shared page (content + int8 scale row)
+                # before the tail prefill writes position S-1 through
+                # the private copy; the shared original is never
+                # written under its other readers
+                self._cow_copy(cow_src, cow_dst)
+            self._do_prefill(req, digests)
 
     def _expire_queued(self):
         """Fail every expired request and drop every cancelled one from
-        the WHOLE queue (position-independent); caller holds the lock."""
+        the WHOLE queue (position-independent); caller holds the lock.
+        While queued nothing has been delivered, so BOTH stream
+        deadlines are hard here: the TTFT deadline (first token cannot
+        arrive on time) and the whole-request deadline alike."""
         t = _now_ms()
         live = deque()
         for req in self._queue:
-            if req.deadline_ms is not None and t > req.deadline_ms:
+            deadlines = [d for d in (req.deadline_ms,
+                                     req.ttft_deadline_ms)
+                         if d is not None]
+            if deadlines and t > min(deadlines):
                 monitor.stat_sub("STAT_gen_queue_depth")
                 monitor.stat_add("STAT_gen_timeouts")
                 self._it["expired"] += 1
@@ -777,13 +1130,16 @@ class GenerationEngine:
                     "EXPIRE_QUEUED", rid=req.rid,
                     queued_ms=round(t - req.t_enqueue_ms, 3))
                 slo.observe_request(self.name, ok=False)
-                self._resolve_later(req.future, exc=ExecutionTimeoutError(
+                self._resolve_req_later(req, exc=ExecutionTimeoutError(
                     f"{self.name}: request expired after "
                     f"{t - req.t_enqueue_ms:.1f}ms in queue"))
                 continue
             if req.future.cancelled():
                 monitor.stat_sub("STAT_gen_queue_depth")
                 self._audit.audit("CANCELLED", rid=req.rid)
+                if req.stream is not None:
+                    from concurrent.futures import CancelledError
+                    self._stream_q.append((req.stream, CancelledError()))
                 continue
             live.append(req)
         self._queue = live
@@ -794,29 +1150,45 @@ class GenerationEngine:
                 return b
         return self._cfg.prefill_buckets[-1]
 
-    def _do_prefill(self, req: _GenRequest):
+    def _do_prefill(self, req: _GenRequest, digests=None):
         """Run the request's prompt through the bucketed prefill program
         (writes its K/V pages), sample the first token, and mark the
-        slot live — it joins the very next decode step. A poisoned
-        request (non-finite logits — the pools came back valid) fails
-        ONLY this request and returns its pages zeroed; an exception
-        from the jitted call itself is engine-fatal, because the pools
-        were DONATED into it and may already be consumed — touching
-        them again (even to zero this request's pages) would
-        dereference deleted buffers (same contract as a decode-step
-        exception)."""
+        slot live — it joins the very next decode step. A prefix hit
+        (req.prefix_tokens > 0) prefills ONLY the tail through the
+        per-bucket tail program — the cached pages are read, never
+        written. A poisoned request (non-finite logits — the pools came
+        back valid) fails ONLY this request and returns its pages
+        zeroed; an exception from the jitted call itself is
+        engine-fatal, because the pools were DONATED into it and may
+        already be consumed — touching them again (even to zero this
+        request's pages) would dereference deleted buffers (same
+        contract as a decode-step exception)."""
         S = int(req.prompt.size)
-        bucket = self._bucket_for(S)
-        ids = np.zeros((1, bucket), np.int32)
-        ids[0, :S] = req.prompt
+        pfx = req.prefix_tokens
+        tail = S - pfx
         t0 = _now_ms()
-        with RecordEvent(f"generation::prefill[b={bucket}]"):
-            with self._dev_ctx():
-                out = self._prefill_jit(
-                    self._W, *self._pools(), req.pt_row, ids,
-                    np.int32(S))
-            self._set_pools(out[:-1])
-            lg = np.asarray(out[-1])
+        if pfx:
+            bucket = self._bucket_for(tail)
+            ids = np.zeros((1, bucket), np.int32)
+            ids[0, :tail] = req.prompt[pfx:]
+            with RecordEvent(f"generation::prefill_tail[b={bucket}]"):
+                with self._dev_ctx():
+                    out = self._tail_jit(
+                        self._W, *self._pools(), req.pt_row, ids,
+                        np.int32(tail), np.int32(pfx))
+                self._set_pools(out[:-1])
+                lg = np.asarray(out[-1])
+        else:
+            bucket = self._bucket_for(S)
+            ids = np.zeros((1, bucket), np.int32)
+            ids[0, :S] = req.prompt
+            with RecordEvent(f"generation::prefill[b={bucket}]"):
+                with self._dev_ctx():
+                    out = self._prefill_jit(
+                        self._W, *self._pools(), req.pt_row, ids,
+                        np.int32(S))
+                self._set_pools(out[:-1])
+                lg = np.asarray(out[-1])
         self._it["prefill_ms"] += _now_ms() - t0
         if not np.all(np.isfinite(lg)):
             monitor.stat_add("STAT_gen_poisoned")
@@ -831,17 +1203,24 @@ class GenerationEngine:
                                   if self._step_log is not None else []),
                 "audit_tail": self._audit.tail(64)})
             self._release(req)
-            self._resolve_later(req.future, exc=FatalError(
+            self._resolve_req_later(req, exc=FatalError(
                 f"{self.name}: non-finite prefill logits for request "
                 f"{req.rid} (poisoned prompt or weights)"))
             return
         self._prefills_total += 1
         monitor.stat_add("STAT_gen_prefills")
+        if self._prefix is not None and digests:
+            # index this prompt's full pages for future hits: matched
+            # nodes are touched, freshly filled full pages (the tail's)
+            # join the chain with a cache reference — they now outlive
+            # this request's free, unzeroed, until LRU eviction
+            self._prefix.register(digests, req.pt_row)
         tok = self._sample_host(req, lg)
         req.toks.append(tok)
         req.next_pos = S
         self._tokens_total += 1
         monitor.stat_add("STAT_gen_tokens")
+        self._stage_token(req, tok)
         if req.span is not None:
             req.span.stamp("prefilled")
             req.span.stamp("first_token")
@@ -940,6 +1319,7 @@ class GenerationEngine:
             req.next_pos += 1
             self._tokens_total += 1
             monitor.stat_add("STAT_gen_tokens")
+            self._stage_token(req, tok)
             if req.span is not None:
                 req.span.stamp("last_token")
             if self._finished(req, tok):
@@ -950,8 +1330,13 @@ class GenerationEngine:
                 or len(req.toks) >= req.max_new)
 
     def _expire_active(self):
-        """Per-step deadline enforcement: an expired sequence cancels
-        mid-decode — pages freed the same step, only its future fails."""
+        """Per-step deadline enforcement: an expired non-streaming
+        sequence cancels mid-decode — pages freed the same step, only
+        its future fails. A STREAMING sequence's whole-request deadline
+        is soft once tokens flow (ISSUE 12): expiry stops decoding the
+        same step but resolves with the tokens already delivered —
+        they left the engine and cannot be retracted — still counted as
+        a timeout (STAT_gen_timeouts, SLO error)."""
         t = _now_ms()
         for req in list(self._slots):
             if req is None or req.deadline_ms is None:
@@ -962,14 +1347,27 @@ class GenerationEngine:
                 self._audit.audit(
                     "EXPIRE_DECODE", rid=req.rid, slot=req.slot,
                     generated=len(req.toks),
+                    stream=req.stream is not None,
                     age_ms=round(t - req.t_enqueue_ms, 3))
                 slo.observe_request(self.name, ok=False)
+                if req.stream is not None and req.toks:
+                    # soft: pages freed now, stream closed normally,
+                    # future resolves with the partial sequence
+                    self._release(req)
+                    self._resolve_req_later(req, result=np.concatenate(
+                        [req.prompt, np.asarray(req.toks, np.int32)]))
+                    if req.span is not None:
+                        req.span.stamp("resolved")
+                        req.span.finish(len(req.toks),
+                                        prefix_tokens=req.prefix_tokens)
+                    continue
                 self._evict(req, ExecutionTimeoutError(
                     f"{self.name}: request {req.rid} expired after "
                     f"{t - req.t_enqueue_ms:.1f}ms with "
                     f"{len(req.toks)}/{req.max_new} tokens decoded "
-                    f"(deadlines are whole-request; partial streams are "
-                    f"not delivered)"))
+                    f"(whole-request deadlines are hard for "
+                    f"non-streaming submits; no partial result is "
+                    f"delivered)"))
 
     # -- completion / eviction ---------------------------------------------
 
@@ -1000,6 +1398,15 @@ class GenerationEngine:
             self._audit.audit("EXPIRE_LATE", rid=req.rid,
                               generated=len(req.toks))
             slo.observe_request(self.name, ok=False)
+            if req.stream is not None:
+                # the stream's whole-request deadline is soft: tokens
+                # already left, deliver the (complete) sequence
+                self._resolve_req_later(req, result=out)
+                if req.span is not None:
+                    req.span.stamp("resolved")
+                    req.span.finish(len(req.toks),
+                                    prefix_tokens=req.prefix_tokens)
+                return
             self._resolve_later(req.future, exc=ExecutionTimeoutError(
                 f"{self.name}: request expired after "
                 f"{t_done - req.t_enqueue_ms:.1f}ms"))
@@ -1007,7 +1414,8 @@ class GenerationEngine:
         # delivery cannot fail: _admit claimed the future via
         # set_running_or_notify_cancel, so a caller-side cancel is no
         # longer possible — count now, resolve after the ring record
-        self._resolve_later(req.future, result=out)
+        # (the stream's end marker flushes before the future resolves)
+        self._resolve_req_later(req, result=out)
         monitor.stat_add("STAT_gen_completions")  # delivered results
         self._it["completed"] += 1
         self._audit.audit(
@@ -1020,14 +1428,15 @@ class GenerationEngine:
         slo.observe_request(self.name, ok=True)
         if req.span is not None:
             req.span.stamp("resolved")
-            req.span.finish(len(req.toks))
+            req.span.finish(len(req.toks),
+                            prefix_tokens=req.prefix_tokens)
 
     def _evict(self, req: _GenRequest, err: BaseException):
         """Cancel a LIVE sequence mid-decode: free + zero its pages,
-        fail only its own future."""
+        fail only its own future (and stream, when present)."""
         self._release(req)
         monitor.stat_add("STAT_gen_evictions")
-        self._resolve_later(req.future, exc=err)
+        self._resolve_req_later(req, exc=err)
 
     def _evict_all(self, err: BaseException):
         for req in list(self._slots):
@@ -1096,6 +1505,10 @@ class GenerationEngine:
         out["owners"] = [
             {"rid": rid, "slot": slot_of.get(rid), "pages": pages}
             for rid, pages in sorted(owners.items())]
+        # prefix-cache surface (ISSUE 12): hit/eviction counters + the
+        # cached/evictable page split the admission arithmetic uses
+        out["prefix"] = (self._prefix.stats() if self._prefix is not None
+                         else {"enabled": False})
         shapes = {b + self._cfg.max_new_tokens
                   for b in self._cfg.prefill_buckets}
         out["admit_headroom"] = {
@@ -1149,9 +1562,13 @@ class GenerationEngine:
                     req = self._queue.popleft()
                     monitor.stat_sub("STAT_gen_queue_depth")
                     dropped.append(req)
+                    err = UnavailableError(
+                        f"{self.name}: engine shut down")
+                    if req.stream is not None:
+                        req.stream._put(err)  # never admitted: no
+                        # barrier to honor, nothing was recorded
                     try:
-                        req.future.set_exception(UnavailableError(
-                            f"{self.name}: engine shut down"))
+                        req.future.set_exception(err)
                     except Exception:
                         pass
             self._cv.notify_all()

@@ -1,0 +1,221 @@
+"""Content-hash prefix cache over the paged KV pools (ISSUE 12).
+
+Real chat/agent traffic is thousands of requests sharing one system
+prompt; without reuse every request prefills it from scratch.
+RadixAttention's insight, page-granular: index already-computed prompt
+K/V by a **content-hash block chain** — one node per FULL page of
+prompt tokens, keyed by `blake2b(parent_digest + page_token_ids)` — so
+a chain digest commits to every token before it and two prompts share
+cached pages exactly as far as their token streams agree. A request
+whose prompt walks the chain maps those pages READ-ONLY
+(`PagedKVCache.alloc_shared`) and prefills only the tail; vLLM's
+copy-on-write covers the one divergent-write case (a full-prompt match
+must recompute its last position's logits, so the page holding it is
+split private before the tail prefill writes through it).
+
+Ownership model (the refcount substrate lives in `kv_cache.py`):
+
+- Registration (`register`, after a successful prefill) takes a cache
+  reference on each full prompt page (`cache_hold`) — the chain
+  survives its producer sequence's free, content preserved, NOT zeroed
+  (zero-on-free defers until refcount 0).
+- A chain page shared by live sequences is not reclaimable; once only
+  the index holds it (refcount 1) it is *evictable* and counts toward
+  `can_admit`/`headroom` so admission capacity stays truthful.
+- Eviction (`evict`, called by the engine BEFORE alloc when the free
+  list alone is short) walks least-recently-used LEAF nodes — children
+  before parents, so a surviving node is always reachable from the
+  root — releasing the index reference; pages freed NOW (refcount 0)
+  are returned for the engine's zero-on-free scatter, pages a live
+  sequence still shares zero later through that sequence's free.
+
+Single-writer like the allocator: the engine's step thread owns every
+mutation (lookup/register/evict); `stats()` takes GIL-consistent
+snapshots for scraper threads.
+"""
+from __future__ import annotations
+
+import hashlib
+import itertools
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+from ..framework import monitor
+from .kv_cache import PagedKVCache
+
+__all__ = ["PrefixCache"]
+
+_ROOT = b"paged-prefix-root"
+
+
+class _Node:
+    """One full prompt page in the chain tree."""
+
+    __slots__ = ("key", "parent", "page", "children", "tick")
+
+    def __init__(self, key: bytes, parent: Optional[bytes], page: int,
+                 tick: int):
+        self.key = key
+        self.parent = parent        # parent digest (None at depth 0)
+        self.page = page            # physical page id in the pools
+        self.children: set = set()  # child digests
+        self.tick = tick            # LRU clock (max of hits on the path)
+
+
+class PrefixCache:
+    """Block-chain index of cached prompt-prefix pages for ONE engine's
+    `PagedKVCache` (the engine's step thread is the only writer)."""
+
+    def __init__(self, kv: PagedKVCache, engine: str = "generation"):
+        self._kv = kv
+        self.engine = engine
+        self._nodes: Dict[bytes, _Node] = {}
+        self._tick = itertools.count(1)
+        # counted per ADMISSION via note_admitted, never per lookup — a
+        # deferred head re-looks-up every engine iteration
+        self.hits = 0           # admissions that matched >= 1 cached page
+        self.misses = 0         # admissions that matched nothing
+        self.hit_tokens = 0     # prompt tokens served from cached pages
+        self.evictions = 0      # chain nodes evicted (LRU)
+
+    # -- hashing -----------------------------------------------------------
+
+    def digests(self, prompt: np.ndarray) -> List[bytes]:
+        """The chain digests of every FULL page of `prompt` — digest i
+        commits to tokens [0, (i+1)*page_size), so equal digests mean
+        equal token streams up to that page boundary."""
+        P = self._kv.page_size
+        toks = np.ascontiguousarray(np.asarray(prompt, np.int32))
+        out, parent = [], _ROOT
+        for i in range(int(toks.size) // P):
+            h = hashlib.blake2b(parent, digest_size=16)
+            h.update(toks[i * P:(i + 1) * P].tobytes())
+            parent = h.digest()
+            out.append(parent)
+        return out
+
+    # -- lookup / register -------------------------------------------------
+
+    def lookup(self, prompt: np.ndarray) -> Tuple[List[bytes], List[int]]:
+        """(digests_of_all_full_pages, matched_page_ids): the longest
+        cached chain this prompt's leading full pages walk. Touches the
+        matched path's LRU clock but counts nothing — the engine calls
+        `note_admitted` once per ADMITTED request (a deferred head
+        re-looks-up every iteration and must not inflate the hit
+        counters). The caller must `pin` the matched pages before any
+        eviction can run (a hit is only a plan until the pages are
+        referenced)."""
+        digests = self.digests(prompt)
+        pages: List[int] = []
+        tick = next(self._tick)
+        for d in digests:
+            node = self._nodes.get(d)
+            if node is None:
+                break
+            node.tick = tick
+            pages.append(node.page)
+        return digests, pages
+
+    def note_admitted(self, hit_tokens: int) -> None:
+        """Count one admission's cache outcome: `hit_tokens` prompt
+        tokens served from cached pages (0 = a miss)."""
+        if hit_tokens > 0:
+            self.hits += 1
+            self.hit_tokens += int(hit_tokens)
+            monitor.stat_add("STAT_prefix_hits")
+            monitor.stat_add("STAT_prefix_hit_tokens", int(hit_tokens))
+        else:
+            self.misses += 1
+
+    def register(self, digests: List[bytes], pt_row) -> int:
+        """Index a freshly prefilled prompt's full pages (called by the
+        step thread after the prefill wrote them). Existing nodes are
+        touched, new nodes take a cache reference on their page
+        (`cache_hold`). Returns the number of NEW nodes. A full-match
+        CoW split never re-registers: its node already exists and keeps
+        the ORIGINAL page — the private copy belongs to the sequence
+        alone."""
+        added = 0
+        tick = next(self._tick)
+        parent: Optional[bytes] = None
+        for i, d in enumerate(digests):
+            node = self._nodes.get(d)
+            if node is None:
+                page = int(pt_row[i])
+                self._kv.cache_hold([page])
+                node = _Node(d, parent, page, tick)
+                self._nodes[d] = node
+                if parent is not None and parent in self._nodes:
+                    self._nodes[parent].children.add(d)
+                added += 1
+            else:
+                node.tick = tick
+            parent = d
+        if added:
+            monitor.stat_set("STAT_prefix_cached_pages",
+                             len(self._kv.cached_pages()))
+        return added
+
+    # -- eviction ----------------------------------------------------------
+
+    def evict(self, need_pages: int, exclude=()) -> List[int]:
+        """Release least-recently-used LEAF chains until `need_pages`
+        pages have actually returned to the free list (or nothing more
+        can be evicted). Returns the freed page ids — the engine zeroes
+        them before reuse (this is the deferred zero-on-free point for
+        cached pages).
+
+        Victim policy: prefer leaves whose page ONLY the index holds
+        (refcount 1 — the ones that actually free bytes); a leaf a live
+        sequence still shares is victimized only when no freeable leaf
+        exists, because a refcount-1 ancestor can be blocked behind it
+        (children must leave the index before their parent, or the
+        survivor would be unreachable from the root). `exclude` pages
+        (the admitting request's just-matched — and pinned — chain) are
+        never victimized: evicting them would force a needless re-prefill
+        and, on a full-prompt match, re-register the chain against the
+        CoW private copy."""
+        refs = self._kv.refcounts()
+        exclude = set(exclude)
+        freed: List[int] = []
+        while len(freed) < need_pages and self._nodes:
+            leaves = [n for n in self._nodes.values()
+                      if not n.children and n.page not in exclude]
+            if not leaves:
+                break
+            victim = min((n for n in leaves if refs.get(n.page) == 1),
+                         key=lambda n: n.tick, default=None)
+            if victim is None:
+                # no freeable leaf: peel the LRU shared leaf to expose
+                # the freeable pages behind it (frees nothing itself)
+                victim = min(leaves, key=lambda n: n.tick)
+            del self._nodes[victim.key]
+            if victim.parent is not None and victim.parent in self._nodes:
+                self._nodes[victim.parent].children.discard(victim.key)
+            freed.extend(self._kv.cache_release([victim.page]))
+            refs.pop(victim.page, None)
+            self.evictions += 1
+            monitor.stat_add("STAT_prefix_evictions")
+        monitor.stat_set("STAT_prefix_cached_pages",
+                         len(self._kv.cached_pages()))
+        return freed
+
+    # -- introspection -----------------------------------------------------
+
+    def __len__(self) -> int:
+        return len(self._nodes)
+
+    def stats(self) -> dict:
+        """Scraper-safe snapshot (counters are GIL-atomic ints)."""
+        return {
+            "enabled": True,
+            "engine": self.engine,
+            "nodes": len(self._nodes),
+            "cached_pages": len(self._kv.cached_pages()),
+            "evictable_pages": self._kv.evictable_pages,
+            "hits": self.hits,
+            "misses": self.misses,
+            "hit_tokens": self.hit_tokens,
+            "evictions": self.evictions,
+        }

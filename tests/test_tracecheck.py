@@ -129,8 +129,11 @@ def test_audit_reasons_corpus():
     assert len(fs) == 2 and undoc and stale
     assert undoc[0].path.endswith("bad.py")
     assert stale[0].path == "COVERAGE.md"
-    # the documented codes — including both IfExp branches — are clean
-    for code in ("FIX_DOC_ADMIT", "FIX_DOC_EOS", "FIX_DOC_BUDGET"):
+    # the documented codes — including both IfExp branches and the
+    # detail-kwarg shapes the prefix-cache decisions use — are clean
+    for code in ("FIX_DOC_ADMIT", "FIX_DOC_EOS", "FIX_DOC_BUDGET",
+                 "FIX_DOC_PREFIX_HIT", "FIX_DOC_COW_SPLIT",
+                 "FIX_DOC_EVICT_LRU"):
         assert not any(code in f.message for f in fs)
 
 

@@ -16,3 +16,18 @@ def finish(hit_eos):
 
 def admit():
     log.audit("FIX_DOC_ADMIT", rid=3, slot=0)
+
+
+def admit_prefix(matched):
+    # the ISSUE 12 shape: hit-vs-miss admits pick the code via IfExp,
+    # with detail kwargs riding along — both branches are vocabulary
+    log.audit("FIX_DOC_PREFIX_HIT" if matched else "FIX_DOC_ADMIT",
+              rid=4, shared_pages=matched, prefix_tokens=matched * 16)
+
+
+def cow_split():
+    log.audit("FIX_DOC_COW_SPLIT", rid=4, src_page=7, dst_page=9)
+
+
+def evict_lru():
+    log.audit("FIX_DOC_EVICT_LRU", rid=5, pages=3)

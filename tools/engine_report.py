@@ -12,8 +12,11 @@ dump whose `extra` carries `step_log_tail`/`audit_tail` (engine death,
 poison, allocator exhaustion). The report shows, per iteration: decode
 slots in use (as a bar), scheduler decisions (admit/complete/expire/
 poison/abort), queue depth + oldest-request age, page-pool occupancy,
-and prefill-vs-decode wall — then the audit tail with reason codes, so
-"why did this request wait/die" reads straight off the artifact.
+prefix-cache hit tokens + copy-on-write splits (pfx/cow), and
+prefill-vs-decode wall — then the audit tail with reason codes (per
+request: ADMIT_PREFIX_HIT carries prefix_tokens, COW_SPLIT the split
+pages), so "why did this request wait/die" reads straight off the
+artifact.
 
 `--json` emits the parsed + summarized structure for scripting.
 """
@@ -53,7 +56,7 @@ def summarize(records: List[dict]) -> dict:
         return {"iterations": 0}
     tot = {k: sum(r.get(k, 0) for r in records)
            for k in ("admitted", "completed", "expired", "poisoned",
-                     "aborted", "freed")}
+                     "aborted", "freed", "prefix_tokens", "cow_splits")}
     return {
         "iterations": len(records),
         "decode_steps": sum(1 for r in records
@@ -106,10 +109,14 @@ def render(name: str, eng: dict, last: int = 0,
               f"{summ['peak_oldest_age_ms']}ms), peak pages "
               f"{summ['peak_pages_in_use']}, min free pages "
               f"{summ['min_free_pages']}", file=out)
+        if summ.get("prefix_tokens") or summ.get("cow_splits"):
+            print(f"   prefix cache: {summ['prefix_tokens']} prompt "
+                  f"tokens served from cached pages, "
+                  f"{summ['cow_splits']} copy-on-write splits", file=out)
         hdr = (f"   {'it':>6} {'step':>6} {'slots':<10} {'adm':>3} "
                f"{'done':>4} {'exp':>3} {'psn':>3} {'abt':>3} "
                f"{'queue':>5} {'age_ms':>8} {'pages':>5} {'free':>5} "
-               f"{'prefill':>8} {'decode':>8}")
+               f"{'pfx':>4} {'cow':>3} {'prefill':>8} {'decode':>8}")
         print(hdr, file=out)
         for r in records:
             print(f"   {r.get('it', 0):>6} {r.get('step', 0):>6} "
@@ -123,6 +130,8 @@ def render(name: str, eng: dict, last: int = 0,
                   f"{r.get('oldest_age_ms', 0.0):>8.1f} "
                   f"{r.get('pages_in_use', 0):>5} "
                   f"{r.get('free_pages', 0):>5} "
+                  f"{r.get('prefix_tokens', 0):>4} "
+                  f"{r.get('cow_splits', 0):>3} "
                   f"{r.get('prefill_ms', 0.0):>7.1f}ms "
                   f"{r.get('decode_ms', 0.0):>7.1f}ms", file=out)
     audit = eng.get("audit", [])

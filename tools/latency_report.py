@@ -45,7 +45,8 @@ _REQSPAN = re.compile(
 _GENSPAN = re.compile(
     r"^reqspan:(?P<rid>\d+):(?P<engine>.*):slot(?P<slot>[^:]*):"
     r"n=(?P<n>\d+):"
-    r"ttft=(?P<ttft>[0-9.]+),tpot=(?P<tpot>[0-9.]+),e=(?P<e>[0-9.]+)$")
+    r"ttft=(?P<ttft>[0-9.]+),tpot=(?P<tpot>[0-9.]+),e=(?P<e>[0-9.]+)"
+    r"(?:,pfx=(?P<pfx>\d+))?$")
 
 PHASES = (("queue", "q"), ("pad", "p"), ("device", "d"), ("resolve", "r"))
 GEN_PHASES = (("ttft", "ttft"), ("tpot", "tpot"))
@@ -77,8 +78,10 @@ def parse_trace(path, events=None):
 
 
 def parse_gen_trace(path, events=None):
-    """[{rid, engine, slot, n, ttft, tpot, e, ts_us}] from the trace's
-    generation-engine reqspan instants."""
+    """[{rid, engine, slot, n, pfx, ttft, tpot, e, ts_us}] from the
+    trace's generation-engine reqspan instants (`pfx` = prompt tokens
+    served from the prefix cache; 0 in traces predating ISSUE 12 —
+    the field is optional in the regex, so old traces still parse)."""
     events = _load_events(path) if events is None else events
     out = []
     for ev in events:
@@ -88,6 +91,7 @@ def parse_gen_trace(path, events=None):
         g = m.groupdict()
         out.append({"rid": int(g["rid"]), "engine": g["engine"],
                     "slot": g["slot"], "n": int(g["n"]),
+                    "pfx": int(g["pfx"] or 0),
                     "ttft": float(g["ttft"]), "tpot": float(g["tpot"]),
                     "e": float(g["e"]), "ts_us": ev.get("ts", 0.0)})
     return out
@@ -146,12 +150,17 @@ def gen_phase_stats(gens):
 def gen_report(gens, top=10):
     return {"requests": len(gens), "phases_ms": gen_phase_stats(gens),
             "tokens": sum(g["n"] for g in gens),
+            "prefix_hit_requests": sum(1 for g in gens if g["pfx"] > 0),
+            "prefix_hit_tokens": sum(g["pfx"] for g in gens),
             "slowest": sorted(gens, key=lambda g: -g["e"])[:top]}
 
 
 def render_gen(rep, file=sys.stdout):
     print(f"{rep['requests']} generation span(s), "
-          f"{rep['tokens']} tokens", file=file)
+          f"{rep['tokens']} tokens "
+          f"({rep['prefix_hit_requests']} prefix-cache hit(s), "
+          f"{rep['prefix_hit_tokens']} prompt tokens served from cache)",
+          file=file)
     print(f"\n{'phase':<10}{'p50(ms)':>10}{'p99(ms)':>10}"
           f"{'mean':>10}{'max':>10}", file=file)
     for label, _ in GEN_PHASES + (("e2e", "e"),):
@@ -161,11 +170,12 @@ def render_gen(rep, file=sys.stdout):
     if rep["slowest"]:
         print(f"\ntop {len(rep['slowest'])} slowest:", file=file)
         print(f"{'rid':>8} {'engine':<16}{'slot':>5}{'toks':>6}"
-              f"{'e2e(ms)':>10}{'ttft':>9}{'tpot':>9}", file=file)
+              f"{'pfx':>5}{'e2e(ms)':>10}{'ttft':>9}{'tpot':>9}",
+              file=file)
         for g in rep["slowest"]:
             print(f"{g['rid']:>8} {g['engine']:<16}{g['slot']:>5}"
-                  f"{g['n']:>6}{g['e']:>10.3f}{g['ttft']:>9.3f}"
-                  f"{g['tpot']:>9.3f}", file=file)
+                  f"{g['n']:>6}{g['pfx']:>5}{g['e']:>10.3f}"
+                  f"{g['ttft']:>9.3f}{g['tpot']:>9.3f}", file=file)
 
 
 def render(rep, file=sys.stdout):
