@@ -601,7 +601,11 @@ def bench_generation():
     time — the deployment a one-shot engine forces today. Acceptance
     gates: engine >= 2x sequential tokens/sec, exactly ONE decode-step
     compile and one prefill compile per prompt bucket (ledger-verified),
-    and every future delivered."""
+    and every future delivered. Sub-arms: prefix cache TTFT (ISSUE 12),
+    speculative decoding spec-on/off at equal pool bytes (ISSUE 14,
+    1.3x floor + acceptance rate + zero post-warmup compiles), and the
+    chunked-prefill interleave (live TPOT p99 strictly better than
+    whole-prompt prefill under a co-resident long-prompt load)."""
     import paddle_tpu as paddle
     from paddle_tpu import serving
     from paddle_tpu.framework import monitor
@@ -775,6 +779,164 @@ def bench_generation():
     ttft_off, outs_off, s_off, live_off = prefix_arm(False)
     token_identical = all(np.array_equal(a, b)
                           for a, b in zip(outs_on, outs_off))
+
+    # ---- speculative arm (ISSUE 14): spec-on vs spec-off at equal
+    # pool bytes (same engine config, same num_pages, same dtype).
+    # The workload is the regime speculation targets — long decodes
+    # whose continuations are locally repetitive (greedy decoding's
+    # repetition attractors; a small vocab makes the untrained smoke
+    # model enter its attractor quickly for EVERY prompt, standing in
+    # for the code/quote/JSON repetition of trained-model traffic).
+    # Gates: >= 1.3x aggregate tokens/sec, token-identical outputs,
+    # acceptance rate in the JSON, ZERO post-warmup compiles in either
+    # arm (drafts accepted or rejected mid-decode never retrace —
+    # there is exactly one verify[k] program).
+    S_VOCAB, S_PROMPT = 128, 16
+    S_MAXN, S_REQ = 224, 32
+    SPEC_K, SPEC_NGRAM = 7, 2
+    paddle.seed(0)
+    cfg_s = GPTConfig(vocab_size=S_VOCAB, hidden_size=HID,
+                      num_layers=LAYERS + 2, num_heads=HEADS,
+                      intermediate_size=4 * HID,
+                      max_position_embeddings=S_PROMPT + S_MAXN,
+                      dropout=0.0)
+    net_s = GPTForCausalLM(cfg_s)
+    net_s.eval()
+    rng_s = np.random.RandomState(0)
+    spec_prompts = [rng_s.randint(0, S_VOCAB, size=(S_PROMPT,))
+                    .astype("int64") for _ in range(S_REQ)]
+    pages_s = 8 * -(-(S_PROMPT + S_MAXN) // PAGE) + 1
+
+    def spec_arm(k):
+        eng = serving.GenerationEngine(
+            net_s, max_slots=8, page_size=PAGE, num_pages=pages_s,
+            prefill_buckets=(S_PROMPT,), max_new_tokens=S_MAXN,
+            max_queue_depth=2 * S_REQ, request_timeout_ms=0,
+            spec_k=k, spec_ngram=SPEC_NGRAM,
+            name=f"bench_spec_{'on' if k else 'off'}")
+        warm_ledger = dict(eng.stats()["compiles"])
+        t0 = time.perf_counter()
+        futs = [eng.submit(p, max_new_tokens=S_MAXN)
+                for p in spec_prompts]
+        outs = [f.result() for f in futs]
+        wall = time.perf_counter() - t0
+        s_arm = eng.stats()
+        eng.shutdown()
+        live = {kk: v for kk, v in s_arm["compiles"].items()
+                if warm_ledger.get(kk) != v}
+        tps = sum(len(o) - S_PROMPT for o in outs) / wall
+        return tps, outs, s_arm, live
+
+    spec_tps_on, spec_outs_on, spec_s_on, spec_live_on = spec_arm(SPEC_K)
+    spec_tps_off, spec_outs_off, spec_s_off, spec_live_off = spec_arm(0)
+    spec_identical = all(np.array_equal(a, b)
+                         for a, b in zip(spec_outs_on, spec_outs_off))
+    spec_arm_extra = {
+        "requests": S_REQ,
+        "max_new_tokens": S_MAXN,
+        "spec_k": SPEC_K,
+        "spec_ngram": SPEC_NGRAM,
+        "pool_pages": pages_s,
+        "tokens_per_sec_spec_on": round(spec_tps_on, 2),
+        "tokens_per_sec_spec_off": round(spec_tps_off, 2),
+        "spec_speedup": round(spec_tps_on / max(spec_tps_off, 1e-9), 3),
+        "acceptance_rate": spec_s_on["spec"]["acceptance_rate"],
+        "drafted": spec_s_on["spec"]["drafted"],
+        "accepted": spec_s_on["spec"]["accepted"],
+        "steps_spec_on": spec_s_on["steps"],
+        "steps_spec_off": spec_s_off["steps"],
+        "token_identical_on_vs_off": spec_identical,
+        "post_warmup_compiles": {"on": spec_live_on,
+                                 "off": spec_live_off},
+        "ledger_on": spec_s_on["compiles"],
+    }
+
+    # ---- chunked-prefill interleave sub-arm (ISSUE 14): live decode
+    # streams co-resident with one LONG prompt admitting mid-decode.
+    # Whole-prompt prefill runs the entire bucketed pass between two
+    # decode steps — every live sequence's next token waits behind it;
+    # chunked prefill interleaves fixed-size chunks with decode steps.
+    # Gate: live-sequence TPOT p99 strictly better with chunking under
+    # the same load (the long prompt still completes, token-identical).
+    I_VOCAB, I_HID, I_LAYERS = 512, 256, 4
+    I_LONG, I_CHUNK, I_LIVE_NEW, I_LIVE_N = 448, 64, 48, 4
+    paddle.seed(0)
+    cfg_i = GPTConfig(vocab_size=I_VOCAB, hidden_size=I_HID,
+                      num_layers=I_LAYERS, num_heads=8,
+                      intermediate_size=4 * I_HID,
+                      max_position_embeddings=I_LONG + 64,
+                      dropout=0.0)
+    net_i = GPTForCausalLM(cfg_i)
+    net_i.eval()
+    rng_i = np.random.RandomState(3)
+    long_prompt = rng_i.randint(0, I_VOCAB, size=(I_LONG,)) \
+        .astype("int64")
+    live_prompts = [rng_i.randint(0, I_VOCAB, size=(16,))
+                    .astype("int64") for _ in range(I_LIVE_N)]
+    pages_i = (I_LIVE_N + 1) * -(-(I_LONG + 64) // PAGE) + 1
+
+    def interleave_arm(chunk):
+        eng = serving.GenerationEngine(
+            net_i, max_slots=I_LIVE_N + 1, page_size=PAGE,
+            num_pages=pages_i, prefill_buckets=(I_CHUNK, I_LONG + 16),
+            max_new_tokens=I_LIVE_NEW, max_queue_depth=16,
+            request_timeout_ms=0, prefill_chunk=chunk,
+            name=f"bench_interleave_{'chunk' if chunk else 'whole'}")
+        streams = [eng.submit_stream(p, max_new_tokens=I_LIVE_NEW)
+                   for p in live_prompts]
+        gaps = [[] for _ in streams]
+        outs = [None] * len(streams)
+        long_out = [None]
+
+        def consume(i):
+            last = time.perf_counter()
+            for _ in streams[i]:
+                now = time.perf_counter()
+                gaps[i].append((now - last) * 1e3)
+                last = now
+            outs[i] = streams[i].result(timeout=600)
+
+        threads = [threading.Thread(target=consume, args=(i,),
+                                    daemon=True)
+                   for i in range(len(streams))]
+        for t in threads:
+            t.start()
+        # admit the long prompt once the live streams are decoding
+        while eng.stats()["steps"] < 4:
+            time.sleep(0.002)
+        long_out[0] = eng.generate(long_prompt, max_new_tokens=4)
+        for t in threads:
+            t.join()
+        s_arm = eng.stats()
+        eng.shutdown()
+        # drop each stream's first gap (TTFT, not TPOT)
+        tpots = sorted(g for gs in gaps for g in gs[1:])
+        p99 = tpots[min(len(tpots) - 1,
+                        int(round(0.99 * len(tpots)) - 1))]
+        p50 = tpots[len(tpots) // 2]
+        return p50, p99, outs, long_out[0], s_arm
+
+    il_p50_c, il_p99_c, il_outs_c, il_long_c, il_s_c = \
+        interleave_arm(I_CHUNK)
+    il_p50_w, il_p99_w, il_outs_w, il_long_w, il_s_w = \
+        interleave_arm(0)
+    il_identical = (all(np.array_equal(a, b)
+                        for a, b in zip(il_outs_c, il_outs_w))
+                    and np.array_equal(il_long_c, il_long_w))
+    interleave_arm_extra = {
+        "long_prompt_tokens": I_LONG,
+        "chunk_tokens": I_CHUNK,
+        "live_streams": I_LIVE_N,
+        "live_tpot_p50_ms_chunked": round(il_p50_c, 3),
+        "live_tpot_p99_ms_chunked": round(il_p99_c, 3),
+        "live_tpot_p50_ms_whole": round(il_p50_w, 3),
+        "live_tpot_p99_ms_whole": round(il_p99_w, 3),
+        "tpot_p99_improvement": round(il_p99_w / max(il_p99_c, 1e-9),
+                                      3),
+        "prefill_chunks": il_s_c["prefill_chunks"],
+        "token_identical_chunked_vs_whole": il_identical,
+    }
+
     prefix_arm_extra = {
         "requests": N_PFX,
         "shared_prefix_tokens": PFX,
@@ -816,6 +978,8 @@ def bench_generation():
         "tpot_ms": s["tpot_ms"],
         "e2e_ms": s["latency_ms"],
         "prefix_arm": prefix_arm_extra,
+        "spec_arm": spec_arm_extra,
+        "interleave_arm": interleave_arm_extra,
     }
     return eng_tps, extra
 
@@ -1763,6 +1927,41 @@ def _run_mode(mode="train", backend=None):
                     f"warmup {parm['post_warmup_compiles']} — prefix "
                     f"hits must ride the warmed prefill_tail buckets, "
                     f"never mint new ones\n")
+            sarm = extra["spec_arm"]
+            if sarm["spec_speedup"] < 1.3:
+                sys.stderr.write(
+                    f"REGRESSION: speculative decoding sustains only "
+                    f"{sarm['spec_speedup']}x aggregate tokens/sec vs "
+                    f"spec-off at equal pool bytes (acceptance rate "
+                    f"{sarm['acceptance_rate']}) — below the 1.3x "
+                    f"floor for the weight-bound smoke\n")
+            if not sarm["token_identical_on_vs_off"]:
+                sys.stderr.write(
+                    "REGRESSION: greedy output differs with "
+                    "speculation on vs off — acceptance must be exact "
+                    "greedy agreement over the same paged cache\n")
+            if sarm["post_warmup_compiles"]["on"] \
+                    or sarm["post_warmup_compiles"]["off"]:
+                sys.stderr.write(
+                    f"REGRESSION: speculative traffic compiled after "
+                    f"warmup {sarm['post_warmup_compiles']} — drafts "
+                    f"accepted or rejected mid-decode must ride the "
+                    f"one verify[k] program, zero retraces\n")
+            iarm = extra["interleave_arm"]
+            if iarm["live_tpot_p99_ms_chunked"] \
+                    >= iarm["live_tpot_p99_ms_whole"]:
+                sys.stderr.write(
+                    f"REGRESSION: chunked prefill does not improve "
+                    f"co-resident TPOT p99 under an interleaved "
+                    f"long-prompt load "
+                    f"({iarm['live_tpot_p99_ms_chunked']}ms chunked vs "
+                    f"{iarm['live_tpot_p99_ms_whole']}ms whole-prompt) "
+                    f"— chunks must interleave with decode steps\n")
+            if not iarm["token_identical_chunked_vs_whole"]:
+                sys.stderr.write(
+                    "REGRESSION: greedy output differs with chunked "
+                    "prefill on vs off — chunk boundaries must not "
+                    "change the K/V the prefill writes\n")
         except Exception as e:  # noqa: BLE001
             traceback.print_exc()
             _emit(headline, 0.0, "tokens/sec",
@@ -1921,8 +2120,13 @@ if __name__ == "__main__":
                          "continuous-batching GenerationEngine vs "
                          "sequential generate — tokens/sec, TTFT/TPOT "
                          "p50/p99, page-pool occupancy, the "
-                         "one-decode-compile ledger, and a step-ring "
-                         "on/off A/B (<2% overhead gate); quant: quantized "
+                         "one-decode-compile ledger, a step-ring "
+                         "on/off A/B (<2% overhead gate), a speculative "
+                         "arm (spec-on vs off at equal pool bytes, 1.3x "
+                         "floor, acceptance rate, zero post-warmup "
+                         "compiles), and a chunked-prefill interleave "
+                         "arm (live TPOT p99 vs whole-prompt prefill "
+                         "under a long-prompt load); quant: quantized "
                          "serving — int8-weight generation vs sequential "
                          "(2x floor), fp32/int8/int4 artifact bytes + "
                          "Predictor parity + quantized-artifact engine "

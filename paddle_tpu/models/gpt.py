@@ -14,7 +14,8 @@ from ..ops import creation, manipulation
 
 __all__ = ["GPTConfig", "GPTModel", "GPTForCausalLM", "MoEFeedForward",
            "gpt_prefill", "gpt_prefill_extend", "gpt_decode_step",
-           "gpt_logits", "dense_cache_write", "dense_cache_attend"]
+           "gpt_spec_verify", "gpt_logits", "dense_cache_write",
+           "dense_cache_attend"]
 
 
 # -- shared decode math (generate() AND serving.GenerationEngine) -----------
@@ -136,6 +137,29 @@ def gpt_prefill_extend(W, ids, positions, ctx_attend, *, num_heads,
     cannot diverge from the full-prefill oracle."""
     del scale  # the ctx_attend hook owns the scale (kept for symmetry)
     h = W["wte"][ids] + W["wpe"][positions][None]
+    return _gen_block_pass(W, h, ctx_attend, num_heads=num_heads)
+
+
+def gpt_spec_verify(W, toks, positions, ctx_attend, *, num_heads):
+    """Batched multi-position decode block for speculative verification
+    (ISSUE 14): score a [B, K+1] block of tokens — each row's current
+    token followed by K draft tokens — at PER-ROW absolute positions
+    [B, K+1] in one `_gen_block_pass`, so verifying K drafts costs one
+    forward over K+1 positions instead of K+1 decode dispatches.
+
+    Attention is delegated per layer to
+
+        ctx_attend(layer, q, k, v) -> [B, H, K+1, D]
+
+    with q/k/v the block's own projections — the hook attends each
+    block query over (cached context + the given within-block K/V) and
+    owns the cache layout, masks AND the softmax scale, exactly the
+    `gpt_prefill_extend` seam batched over rows. Returns `(h, ks, vs)`
+    ([B,K+1,E] hidden states, [L,B,H,K+1,D] per-layer block K/V for the
+    caller's — acceptance-masked — cache writes). Sharing
+    `_gen_block_pass` is what anchors verification to the decode-step
+    oracle: the block math literally cannot diverge."""
+    h = W["wte"][toks] + W["wpe"][positions]
     return _gen_block_pass(W, h, ctx_attend, num_heads=num_heads)
 
 

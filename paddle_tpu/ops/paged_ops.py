@@ -72,11 +72,19 @@ def paged_gather(pages, page_table):
 def page_rows_for_positions(page_table, positions, page_size):
     """(page_ids, offsets) physical coordinates for logical `positions`.
 
-    page_table [PP] or [B, PP]; positions [S] (with a [PP] table) or [B]
-    (with a [B, PP] table — one position per row)."""
+    page_table [PP] or [B, PP]; positions [S] (with a [PP] table), [B]
+    (with a [B, PP] table — one position per row), or [B, S] (with a
+    [B, PP] table — a block of positions per row, the speculative
+    verify shape). Out-of-range page indices clamp onto the row's last
+    entry (XLA gather semantics) — callers mask such coordinates to the
+    scratch page before writing."""
     if page_table.ndim == 1:
         return page_table[positions // page_size], positions % page_size
     B = page_table.shape[0]
+    if positions.ndim == 2:
+        rows = jnp.arange(B)[:, None]
+        return (page_table[rows, positions // page_size],
+                positions % page_size)
     return (page_table[jnp.arange(B), positions // page_size],
             positions % page_size)
 
@@ -270,7 +278,9 @@ def paged_prefix_attention(q, kb, vb, k_tail, v_tail, prefix_len, scale):
 
     q / k_tail / v_tail [B, H, S, D]; kb/vb [B, H, T, D] — ONE layer of
     the `paged_gather_layers` view of the sequence's page-table row;
-    prefix_len scalar int32 — cached positions t < prefix_len are
+    prefix_len scalar int32, or [B] int32 for per-row context lengths
+    (the speculative verify block, ISSUE 14 — every decode slot carries
+    its own cache length) — cached positions t < prefix_len are
     attended, everything at or past it in the gathered view (fresh
     pages, table padding) masks to exact 0.0. Tail position j is
     attended by tail query i iff j <= i (causal within the tail; the
@@ -285,7 +295,9 @@ def paged_prefix_attention(q, kb, vb, k_tail, v_tail, prefix_len, scale):
     T = kb.shape[2]
     S = q.shape[2]
     sp = jnp.einsum("bhsd,bhtd->bhst", q, kb) * scale
-    sp = jnp.where(jnp.arange(T)[None, None, None, :] < prefix_len,
+    limit = (prefix_len[:, None, None, None] if jnp.ndim(prefix_len)
+             else prefix_len)
+    sp = jnp.where(jnp.arange(T)[None, None, None, :] < limit,
                    sp, -1e30)
     st = jnp.einsum("bhsd,bhtd->bhst", q, k_tail) * scale
     causal = jnp.arange(S)[:, None] >= jnp.arange(S)[None, :]

@@ -48,6 +48,9 @@ REASONS = frozenset({
                            # divergent write (full-prompt match)
     "EVICT_PREFIX_LRU",    # refcount-0 cached chain pages reclaimed
                            # LRU, before an admission's alloc
+    "EVICT_PREFIX_BUDGET",  # cached chains evicted eagerly at
+                            # register() to hold the page-count budget
+                            # (FLAGS_gen_prefix_cache_max_pages)
     "DEFER_PAGES",         # admission deferred: free pages < worst case
     "DEFER_SLOTS",         # admission deferred: every decode slot busy
     "REJECT_QUEUE_FULL",   # submit shed by EngineOverloaded backpressure

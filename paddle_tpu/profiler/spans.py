@@ -211,9 +211,13 @@ class GenSpan:
     step thread owns every stamp after `queued`). `prefix_tokens` is the
     count of prompt tokens served from cached prefix pages (ISSUE 12) —
     it rides the reqspan instant (`pfx=`) so offline TTFT attribution
-    can split hit from miss requests."""
+    can split hit from miss requests. `spec_tokens` (ISSUE 14) is the
+    count of accepted speculative draft tokens — it rides the instant as
+    `acc=`, so offline TPOT attribution can split speculation's
+    multi-token steps from plain decode."""
 
-    __slots__ = ("rid", "engine", "slot", "stamps", "prefix_tokens")
+    __slots__ = ("rid", "engine", "slot", "stamps", "prefix_tokens",
+                 "spec_tokens")
 
     def __init__(self, engine: str):
         self.rid = next(_next_id)
@@ -221,6 +225,7 @@ class GenSpan:
         self.slot: Optional[int] = None
         self.stamps = {}
         self.prefix_tokens = 0
+        self.spec_tokens = 0
 
     def stamp(self, phase: str, t: Optional[float] = None) -> None:
         self.stamps[phase] = time.perf_counter() if t is None else t
@@ -229,11 +234,14 @@ class GenSpan:
         tracer.flow("gen_request", ph, self.rid)
 
     def finish(self, n_tokens: int,
-               prefix_tokens: Optional[int] = None) -> None:
+               prefix_tokens: Optional[int] = None,
+               spec_tokens: Optional[int] = None) -> None:
         """Called once per DELIVERED request after `resolved` is
         stamped: feed ttft_ms/tpot_ms and drop the reqspan instant."""
         if prefix_tokens is not None:
             self.prefix_tokens = int(prefix_tokens)
+        if spec_tokens is not None:
+            self.spec_tokens = int(spec_tokens)
         s = self.stamps
         if "queued" not in s or "first_token" not in s:
             return
@@ -252,12 +260,14 @@ class GenSpan:
         if n_tokens > 1:
             slo.observe_tpot(self.engine, max(0.0, tpot))
         e2e = (s.get("resolved", last) - s["queued"]) * 1000.0
-        # pfx rides the VALUES segment (after e=) so the colon-separated
-        # head keeps its field count — downstream parsers split on ":"
+        # pfx/acc ride the VALUES segment (after e=) so the colon-
+        # separated head keeps its field count — downstream parsers
+        # split on ":", and each appended value is regex-optional so
+        # older traces (and older parsers) keep working both ways
         tracer.instant(
             f"reqspan:{self.rid}:{self.engine}:slot{self.slot}:"
             f"n={n_tokens}:ttft={ttft:.3f},tpot={tpot:.3f},e={e2e:.3f},"
-            f"pfx={self.prefix_tokens}",
+            f"pfx={self.prefix_tokens},acc={self.spec_tokens}",
             t=s.get("resolved", last))
 
     def to_dict(self) -> dict:

@@ -28,6 +28,16 @@ oldest overwritten — the same bounding discipline as the trace rings):
                   copy-on-write page splits performed THIS iteration
                   (ISSUE 12 — the prefix-cache effectiveness signal,
                   per iteration)
+    tokens / spec_drafted / spec_accepted / prefill_chunks
+                  tokens delivered THIS iteration (prefill first
+                  tokens + decode/verify), speculative draft tokens
+                  proposed and accepted, and prefill chunks run
+                  (ISSUE 14 — tokens > live on a decode iteration is
+                  speculation paying off; prefill_chunks interleaved
+                  with decode_ms > 0 is chunked prefill protecting
+                  TPOT). Appended AFTER the ISSUE-12 fields so older
+                  ring consumers — which read by name with defaults —
+                  parse records from both eras unchanged
     prefill_ms / decode_ms
                   wall spent in prefill jit calls vs the decode step
                   this iteration — the "is one long prompt spiking
@@ -62,7 +72,8 @@ __all__ = ["StepRecord", "StepLog", "enabled", "register", "unregister",
 _FIELDS = ("it", "step", "t", "live", "admitted", "completed", "expired",
            "poisoned", "aborted", "freed", "queue_depth", "oldest_age_ms",
            "pages_in_use", "free_pages", "prefix_tokens", "cow_splits",
-           "prefill_ms", "decode_ms")
+           "prefill_ms", "decode_ms", "tokens", "spec_drafted",
+           "spec_accepted", "prefill_chunks")
 
 
 def enabled() -> bool:

@@ -43,7 +43,8 @@ from .generation import (GenerationConfig, GenerationEngine,  # noqa: E402
                          TokenStream)
 from .kv_cache import PagedKVCache  # noqa: E402
 from .prefix_cache import PrefixCache  # noqa: E402
+from .spec_decode import NGramProposer  # noqa: E402
 
 __all__ = ["InferenceEngine", "EngineConfig", "EngineOverloaded",
-           "GenerationEngine", "GenerationConfig", "PagedKVCache",
-           "PrefixCache", "TokenStream"]
+           "GenerationEngine", "GenerationConfig", "NGramProposer",
+           "PagedKVCache", "PrefixCache", "TokenStream"]

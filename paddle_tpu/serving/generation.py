@@ -42,6 +42,22 @@ reclaimable. TTFT collapses for shared-system-prompt traffic while
 greedy output stays token-identical with the cache off: the cached
 pages hold the same K/V the skipped prefill would have produced.
 
+**Speculative decoding (ISSUE 14, `FLAGS_gen_spec_k` / `spec_k=K`)**:
+decode is weight-streaming-bound, so ONE fixed-k jitted verify program
+replaces the decode step — each live slot's [current token + K
+prompt-lookup drafts] block (`serving/spec_decode.py`, the sequence's
+own history as the draft model) runs one `gpt_spec_verify` pass over
+the paged cache, acceptance (exact greedy agreement) is computed
+in-graph, and only consumed positions' K/V commit; rejected draft
+lanes scrub to the scratch page, so a step delivers 1..K+1 tokens with
+greedy output token-identical to speculation off and zero retraces as
+drafts are accepted or rejected. **Chunked prefill
+(`FLAGS_gen_prefill_chunk`)**: long prompts admit immediately but
+prefill one fixed-size chunk per engine iteration through the
+per-bucket tail programs, interleaved with decode steps — a long
+prompt stops spiking every live sequence's TPOT; the slot joins decode
+when its final chunk lands.
+
 **Streaming (`submit_stream`)**: a per-token `TokenStream` fed from the
 step thread — each token is staged during the iteration and delivered
 only after `_record_iteration` lands (the same deferred-resolution
@@ -93,6 +109,7 @@ from ..profiler import (RecordEvent, audit, device_telemetry, exporter,
                         flight_recorder, slo, spans, step_log)
 from .kv_cache import TRASH_PAGE, PagedKVCache
 from .prefix_cache import PrefixCache
+from .spec_decode import NGramProposer
 
 # the intake queue legitimately moves both ways; registering it as an
 # "updown" gauge makes the exporter render a Prometheus gauge while the
@@ -122,6 +139,10 @@ class GenerationConfig:
                  request_timeout_ms: Optional[float] = None,
                  kv_cache_dtype: Optional[str] = None,
                  prefix_cache: Optional[bool] = None,
+                 prefix_cache_max_pages: Optional[int] = None,
+                 spec_k: Optional[int] = None,
+                 spec_ngram: Optional[int] = None,
+                 prefill_chunk: Optional[int] = None,
                  top_k: int = 0, seed: int = 0, warmup: bool = True):
         self.max_slots = int(flag("FLAGS_gen_max_slots")
                              if max_slots is None else max_slots)
@@ -159,6 +180,27 @@ class GenerationConfig:
                 f"got {self.kv_cache_dtype!r}")
         self.prefix_cache = bool(flag("FLAGS_gen_prefix_cache")
                                  if prefix_cache is None else prefix_cache)
+        self.prefix_cache_max_pages = int(
+            flag("FLAGS_gen_prefix_cache_max_pages")
+            if prefix_cache_max_pages is None else prefix_cache_max_pages)
+        if self.prefix_cache_max_pages < 0:
+            raise InvalidArgumentError(
+                "prefix_cache_max_pages must be >= 0 (0 = unbounded)")
+        self.spec_k = int(flag("FLAGS_gen_spec_k")
+                          if spec_k is None else spec_k)
+        if self.spec_k < 0:
+            raise InvalidArgumentError("spec_k must be >= 0 (0 = off)")
+        self.spec_ngram = int(flag("FLAGS_gen_spec_ngram")
+                              if spec_ngram is None else spec_ngram)
+        if self.spec_k and self.spec_ngram < 1:
+            raise InvalidArgumentError(
+                "spec_ngram must be >= 1 when spec_k > 0")
+        self.prefill_chunk = int(flag("FLAGS_gen_prefill_chunk")
+                                 if prefill_chunk is None
+                                 else prefill_chunk)
+        if self.prefill_chunk < 0:
+            raise InvalidArgumentError(
+                "prefill_chunk must be >= 0 (0 = whole-prompt prefill)")
         self.top_k = int(top_k)
         self.seed = int(seed)
         self.warmup = bool(warmup)
@@ -218,7 +260,8 @@ class _GenRequest:
                  "temperature", "future", "deadline_ms", "t_enqueue_ms",
                  "span", "slot", "pt_row", "toks", "next_pos", "ordinal",
                  "defer_logged", "stream", "ttft_deadline_ms",
-                 "prefix_tokens")
+                 "prefix_tokens", "prefill_pos", "pending_digests",
+                 "spec_accepted")
 
     _ids = itertools.count(1)
 
@@ -244,6 +287,11 @@ class _GenRequest:
         self.stream = stream            # TokenStream or None
         self.ttft_deadline_ms = ttft_deadline_ms  # HARD (streams)
         self.prefix_tokens = 0          # prompt tokens served from cache
+        self.prefill_pos = None         # chunked prefill: next prompt
+        #                                 position to prefill (None =
+        #                                 prefill complete / not chunked)
+        self.pending_digests = None     # prompt digests held across chunks
+        self.spec_accepted = 0          # draft tokens accepted (ISSUE 14)
 
 
 class GenerationEngine:
@@ -329,8 +377,27 @@ class GenerationEngine:
         # prefix cache (ISSUE 12): content-hash chain index over the
         # refcounted pages; None keeps the PR 8 ownership semantics
         # exactly (every page refcount 1, nothing cached or shared)
-        self._prefix = (PrefixCache(self._cache, name)
-                        if self._cfg.prefix_cache else None)
+        self._prefix = (PrefixCache(
+            self._cache, name,
+            max_pages=self._cfg.prefix_cache_max_pages)
+            if self._cfg.prefix_cache else None)
+        # chunked prefill (ISSUE 14): chunks ride the per-bucket tail
+        # programs, so a chunk can never be wider than the largest
+        # bucket; 0 keeps whole-prompt prefill at admission
+        self._cfg.prefill_chunk = min(self._cfg.prefill_chunk,
+                                      self._cfg.prefill_buckets[-1])
+        # the tail-extension programs serve BOTH prefix-cache hits and
+        # prefill chunks — warmed whenever either consumer exists
+        self._use_tail = (self._prefix is not None
+                          or self._cfg.prefill_chunk > 0)
+        # speculative decoding (ISSUE 14): model-free prompt-lookup
+        # drafts + ONE fixed-k verify program replacing the decode step
+        self._spec_k = self._cfg.spec_k
+        self._proposer = (NGramProposer(self._cfg.spec_ngram)
+                          if self._spec_k else None)
+        self._spec_drafted_total = 0
+        self._spec_accepted_total = 0
+        self._chunks_total = 0
 
         self._cv = threading.Condition()
         self._queue: deque = deque()
@@ -367,6 +434,8 @@ class GenerationEngine:
         self._it = {"admitted": 0, "completed": 0, "expired": 0,
                     "poisoned": 0, "aborted": 0, "freed": 0,
                     "prefix_tokens": 0, "cow_splits": 0,
+                    "tokens": 0, "spec_drafted": 0, "spec_accepted": 0,
+                    "prefill_chunks": 0,
                     "prefill_ms": 0.0, "decode_ms": 0.0}
 
         self._build_programs()
@@ -419,9 +488,12 @@ class GenerationEngine:
         import jax.numpy as jnp
 
         from ..models.gpt import (gpt_decode_step, gpt_logits,
-                                  gpt_prefill, gpt_prefill_extend)
+                                  gpt_prefill, gpt_prefill_extend,
+                                  gpt_spec_verify)
         from ..ops.paged_ops import (page_rows_for_positions,
-                                     paged_attention, paged_gather_layers,
+                                     paged_attention, paged_gather,
+                                     paged_gather_layers,
+                                     paged_gather_quantized,
                                      paged_prefix_attention, paged_write,
                                      paged_write_quantized)
 
@@ -572,6 +644,92 @@ class GenerationEngine:
             bad = active & ~jnp.all(jnp.isfinite(logits), axis=-1)
             return (*pools, jnp.where(active, nxt, 0), bad)
 
+        def verify_fn(W, *rest):
+            """Speculative verify step (ISSUE 14): score every live
+            slot's [current token + k drafts] block — k+1 positions —
+            in ONE pass over the paged cache (`gpt_spec_verify` on the
+            `_gen_block_pass` seam), accept the longest greedily-
+            agreeing draft prefix IN-GRAPH, and commit only the
+            consumed positions' K/V: rejected draft lanes, inactive
+            slots and clamped pad positions all scrub to the reserved
+            scratch page. That routing IS the rollback — a rejected
+            draft never dirties a real page, so the int8 scale grids
+            never widen from a token that was not kept and the PR 12
+            CoW/sharing invariants hold untouched (writes always land
+            past any shared prefix). Block queries attend the cached
+            pages READ-ONLY (per-slot prefix length = the slot's cache
+            position) plus the block's own in-flight K/V — the
+            `paged_prefix_attention` oracle, so greedy output is
+            token-identical to the plain decode program. Returns
+            (*pools, n_accepted [M], next_token [M], bad [M])."""
+            pools = rest[:NP]
+            pt, toks_blk, dmask, pos0, active, temps, smask, key = \
+                rest[NP:]
+            eng._note_trace(f"verify[k={toks_blk.shape[1] - 1}]")
+            M, K1 = toks_blk.shape
+            # pad/overflow positions clamp into wpe range; their writes
+            # are scratch-routed below regardless (the engine truncates
+            # real drafts to the request's token budget, so every
+            # CONSUMED position is in range by construction)
+            positions = jnp.clip(pos0[:, None] + jnp.arange(K1)[None, :],
+                                 0, eng._max_position - 1)
+
+            def ctx_attend(layer, q, k, v):
+                if quant:
+                    kp, vp, ksc, vsc = pools
+                    kb = paged_gather_quantized(kp[layer], ksc[layer],
+                                                pt, q.dtype)
+                    vb = paged_gather_quantized(vp[layer], vsc[layer],
+                                                pt, q.dtype)
+                else:
+                    kp, vp = pools
+                    kb = paged_gather(kp[layer], pt)
+                    vb = paged_gather(vp[layer], pt)
+                return paged_prefix_attention(q, kb, vb, k, v, pos0,
+                                              scale)
+
+            h, ks, vs = gpt_spec_verify(W, toks_blk, positions,
+                                        ctx_attend, num_heads=H)
+            logits = gpt_logits(W, h)                       # [M, K1, V]
+            greedy = jnp.argmax(logits, -1).astype(jnp.int32)
+            # n_acc = longest prefix of drafts the model agrees with
+            # (greedy[j] is the model's token AFTER position j, so
+            # draft j+1 is accepted iff it equals greedy[j])
+            agree = (greedy[:, :-1] == toks_blk[:, 1:]) & dmask
+            n_acc = jnp.sum(jnp.cumprod(agree.astype(jnp.int32),
+                                        axis=1), axis=1).astype(jnp.int32)
+            # sampled slots take no drafts (greedy acceptance would
+            # bias the distribution); they ride the verify program as
+            # plain one-token decode with the decode program's
+            # temperature/top-k sampling expression
+            n_acc = jnp.where(smask, 0, n_acc)
+            bonus = jnp.take_along_axis(greedy, n_acc[:, None], 1)[:, 0]
+            lg0 = logits[:, 0] / jnp.maximum(temps[:, None], 1e-6)
+            if top_k:
+                kth = jax.lax.top_k(lg0, int(top_k))[0][..., -1:]
+                lg0 = jnp.where(lg0 < kth, -1e30, lg0)
+            sampled = jax.random.categorical(key, lg0).astype(jnp.int32)
+            nxt = jnp.where(smask, sampled, bonus)
+            nxt = jnp.where(active, nxt, 0)
+            consumed = jnp.arange(K1)[None, :] <= n_acc[:, None]
+            finite = jnp.all(jnp.isfinite(logits), axis=-1)  # [M, K1]
+            bad = active & jnp.any(consumed & ~finite, axis=1)
+            commit = consumed & active[:, None]
+            page_ids, offs = page_rows_for_positions(pt, positions, P)
+            page_ids = jnp.where(commit, page_ids, TRASH_PAGE)
+            offs = jnp.where(commit, offs, 0)
+            L, D = ks.shape[0], ks.shape[-1]
+            # [L, M, H, K1, D] -> [L, H, M*K1, D]: the prefill-shaped
+            # all-layers scatter
+            ksf = jnp.moveaxis(ks, 1, 2).reshape(L, H, M * K1, D)
+            vsf = jnp.moveaxis(vs, 1, 2).reshape(L, H, M * K1, D)
+            # requant=True: commits land on the slot's current partial
+            # page, which already holds content (and, int8, a non-zero
+            # scale) — the tail-prefill contract, not the fresh-page one
+            pools = write_pages(pools, None, page_ids.reshape(-1),
+                                offs.reshape(-1), ksf, vsf, requant=True)
+            return (*pools, n_acc, nxt, bad)
+
         def zero_fn(*rest):
             # trash-padded page rows: the scratch page is re-zeroed with
             # every free, which also scrubs poisoned prefill tails; the
@@ -593,6 +751,8 @@ class GenerationEngine:
         self._prefill_jit = jax.jit(prefill_fn, donate_argnums=donate)
         self._tail_jit = jax.jit(tail_prefill_fn, donate_argnums=donate)
         self._decode_jit = jax.jit(decode_fn, donate_argnums=donate)
+        self._verify_jit = (jax.jit(verify_fn, donate_argnums=donate)
+                            if self._spec_k else None)
         self._zero_jit = jax.jit(zero_fn,
                                  donate_argnums=tuple(range(NP)))
         self._cow_jit = jax.jit(cow_fn, donate_argnums=tuple(range(NP)))
@@ -608,6 +768,12 @@ class GenerationEngine:
         per-slot failures)."""
         with self._dev_ctx():
             return self._decode_jit(*args)
+
+    def _verify_call(self, *args):
+        """One jitted speculative-verify dispatch (same test seam
+        discipline as `_decode_call`)."""
+        with self._dev_ctx():
+            return self._verify_jit(*args)
 
     def _zero_pages(self, pages):
         # chunked to the fixed zero-scatter width: one sequence's free
@@ -626,10 +792,11 @@ class GenerationEngine:
                                           np.int32(dst)))
 
     def _warmup(self):
-        """Compile every prefill bucket + the decode step + the zeroing
-        scatter up front: no live request pays a compile, and the
-        ledger's exactly-once invariant is observable from step one.
-        Warmup writes land only in the reserved scratch page."""
+        """Compile every prefill bucket + the decode step (or, with
+        speculation on, the ONE verify[k] program that replaces it) +
+        the zeroing scatter up front: no live request pays a compile,
+        and the ledger's exactly-once invariant is observable from step
+        one. Warmup writes land only in the reserved scratch page."""
         M, PP = self._cfg.max_slots, self._cfg.pages_per_seq
         trash = np.zeros((PP,), np.int32)
         with RecordEvent("generation::warmup"):
@@ -641,11 +808,12 @@ class GenerationEngine:
                         self._W, *self._pools(), trash, ids, np.int32(1))
                 self._set_pools(out[:-1])
                 np.asarray(out[-1])
-                if self._prefix is not None:
-                    # one tail-prefill compile per bucket too: a prefix
-                    # hit must never pay a runtime compile, and the
-                    # ledger's exactly-once invariant covers both
-                    # prefill shapes from step one
+                if self._use_tail:
+                    # one tail-prefill compile per bucket too: prefix
+                    # hits AND prefill chunks ride these programs, and
+                    # neither may pay a runtime compile — the ledger's
+                    # exactly-once invariant covers both prefill shapes
+                    # from step one
                     with self._dev_ctx():
                         # lint: allow(use-after-donate): donate covers only the NP pool args in the *splat; trash/ids ride AFTER them (positions NP+1/NP+2), read-only across warmup prefills
                         out = self._tail_jit(
@@ -655,10 +823,19 @@ class GenerationEngine:
                     np.asarray(out[-1])
             if self._prefix is not None:
                 self._cow_copy(TRASH_PAGE, TRASH_PAGE)
-            args = self._step_arrays()
-            out = self._decode_call(self._W, *self._pools(), *args)
-            np.asarray(out[-2])
-            self._set_pools(out[:-2])
+            if self._spec_k:
+                # speculation replaces the decode program outright: the
+                # engine's ledger shows ONE verify[k] trace and no
+                # decode entry at all (the acceptance-criteria shape)
+                args = self._spec_arrays()[0]
+                out = self._verify_call(self._W, *self._pools(), *args)
+                np.asarray(out[-2])
+                self._set_pools(out[:-3])
+            else:
+                args = self._step_arrays()
+                out = self._decode_call(self._W, *self._pools(), *args)
+                np.asarray(out[-2])
+                self._set_pools(out[:-2])
             self._zero_pages([])
 
     # -- request intake ----------------------------------------------------
@@ -817,8 +994,11 @@ class GenerationEngine:
                         return
                 self._admit()
                 self._expire_active()
+                if self._cfg.prefill_chunk:
+                    self._advance_prefills()
                 stepped = False
-                if self._num_active():
+                if any(r is not None and r.prefill_pos is None
+                       for r in self._slots):
                     self._step()
                     stepped = True
                 self._record_iteration()
@@ -849,7 +1029,9 @@ class GenerationEngine:
         it, self._it = self._it, {
             "admitted": 0, "completed": 0, "expired": 0, "poisoned": 0,
             "aborted": 0, "freed": 0, "prefix_tokens": 0,
-            "cow_splits": 0, "prefill_ms": 0.0, "decode_ms": 0.0}
+            "cow_splits": 0, "tokens": 0, "spec_drafted": 0,
+            "spec_accepted": 0, "prefill_chunks": 0,
+            "prefill_ms": 0.0, "decode_ms": 0.0}
         if self._step_log is None:
             return
         self._iters += 1
@@ -871,6 +1053,10 @@ class GenerationEngine:
             aborted=it["aborted"], freed=it["freed"],
             prefix_tokens=it["prefix_tokens"],
             cow_splits=it["cow_splits"],
+            tokens=it["tokens"],
+            spec_drafted=it["spec_drafted"],
+            spec_accepted=it["spec_accepted"],
+            prefill_chunks=it["prefill_chunks"],
             prefill_ms=round(it["prefill_ms"], 3),
             decode_ms=round(it["decode_ms"], 3))
         self._step_log.record(rec)
@@ -1108,7 +1294,18 @@ class GenerationEngine:
                 # the private copy; the shared original is never
                 # written under its other readers
                 self._cow_copy(cow_src, cow_dst)
-            self._do_prefill(req, digests)
+            chunk = self._cfg.prefill_chunk
+            if chunk and S - req.prefix_tokens > chunk:
+                # chunked prefill (ISSUE 14): the slot is admitted NOW
+                # (pages reserved, FIFO order kept) but prefills one
+                # chunk per engine iteration through the tail programs,
+                # interleaved with decode steps — a long prompt stops
+                # spiking every live sequence's TPOT. The slot joins
+                # decode only when prefill_pos reaches the prompt end.
+                req.prefill_pos = req.prefix_tokens
+                req.pending_digests = digests
+            else:
+                self._do_prefill(req, digests)
 
     def _expire_queued(self):
         """Fail every expired request and drop every cancelled one from
@@ -1191,35 +1388,79 @@ class GenerationEngine:
                 lg = np.asarray(out[-1])
         self._it["prefill_ms"] += _now_ms() - t0
         if not np.all(np.isfinite(lg)):
-            monitor.stat_add("STAT_gen_poisoned")
-            self._it["poisoned"] += 1
-            self._audit.audit("POISON_PREFILL", rid=req.rid,
-                              bucket=bucket)
-            slo.observe_request(self.name, ok=False)
-            flight_recorder.dump("gen_poisoned_sequence", {
-                "engine": self.name, "rid": req.rid, "stage": "prefill",
-                "bucket": bucket, "error": "non-finite prefill logits",
-                "step_log_tail": (self._step_log.tail(32)
-                                  if self._step_log is not None else []),
-                "audit_tail": self._audit.tail(64)})
-            self._release(req)
-            self._resolve_req_later(req, exc=FatalError(
-                f"{self.name}: non-finite prefill logits for request "
-                f"{req.rid} (poisoned prompt or weights)"))
+            self._poison_prefill(req, bucket)
             return
+        self._finish_prefill(req, lg, digests)
+
+    def _poison_decode(self, req: _GenRequest, slot: int):
+        """Non-finite decode/verify logits: only THIS sequence fails,
+        its pages return zeroed (shared by the plain and speculative
+        step paths — one poison diagnostic shape for both)."""
+        monitor.stat_add("STAT_gen_poisoned")
+        self._it["poisoned"] += 1
+        self._audit.audit("POISON_DECODE", rid=req.rid, slot=slot,
+                          generated=len(req.toks))
+        slo.observe_request(self.name, ok=False)
+        flight_recorder.dump("gen_poisoned_sequence", {
+            "engine": self.name, "rid": req.rid, "stage": "decode",
+            "slot": slot, "generated": len(req.toks),
+            "error": "non-finite decode logits",
+            "step_log_tail": (self._step_log.tail(32)
+                              if self._step_log is not None else []),
+            "audit_tail": self._audit.tail(64)})
+        self._evict(req, FatalError(
+            f"{self.name}: sequence {req.rid} produced "
+            f"non-finite logits at step {len(req.toks)}"))
+
+    def _poison_prefill(self, req: _GenRequest, bucket: int):
+        """Non-finite prefill logits (whole-prompt, tail or chunk): the
+        pools came back valid, so only THIS request fails and its pages
+        return zeroed."""
+        monitor.stat_add("STAT_gen_poisoned")
+        self._it["poisoned"] += 1
+        self._audit.audit("POISON_PREFILL", rid=req.rid,
+                          bucket=bucket)
+        slo.observe_request(self.name, ok=False)
+        flight_recorder.dump("gen_poisoned_sequence", {
+            "engine": self.name, "rid": req.rid, "stage": "prefill",
+            "bucket": bucket, "error": "non-finite prefill logits",
+            "step_log_tail": (self._step_log.tail(32)
+                              if self._step_log is not None else []),
+            "audit_tail": self._audit.tail(64)})
+        self._release(req)
+        self._resolve_req_later(req, exc=FatalError(
+            f"{self.name}: non-finite prefill logits for request "
+            f"{req.rid} (poisoned prompt or weights)"))
+
+    def _register_pages(self, req: _GenRequest, digests) -> None:
+        """Index full pages in the prefix cache (matched nodes touched,
+        fresh pages take a cache reference and outlive this request's
+        free). With FLAGS_gen_prefix_cache_max_pages set, registration
+        eagerly LRU-evicts OTHER chains back to budget — the freed
+        pages are zeroed here, same hygiene as the pre-alloc
+        eviction."""
+        freed = self._prefix.register(digests, req.pt_row)
+        if freed:
+            self._zero_pages(freed)
+            self._audit.audit("EVICT_PREFIX_BUDGET", rid=req.rid,
+                              pages=len(freed),
+                              free_pages=self._cache.free_pages)
+
+    def _finish_prefill(self, req: _GenRequest, lg: np.ndarray,
+                        digests) -> None:
+        """Shared tail of every prefill flavor (whole-prompt, prefix
+        tail, final chunk): register cacheable pages, sample the first
+        token, mark the slot decode-live."""
         self._prefills_total += 1
         monitor.stat_add("STAT_gen_prefills")
         if self._prefix is not None and digests:
-            # index this prompt's full pages for future hits: matched
-            # nodes are touched, freshly filled full pages (the tail's)
-            # join the chain with a cache reference — they now outlive
-            # this request's free, unzeroed, until LRU eviction
-            self._prefix.register(digests, req.pt_row)
+            self._register_pages(req, digests)
         tok = self._sample_host(req, lg)
         req.toks.append(tok)
-        req.next_pos = S
+        req.next_pos = int(req.prompt.size)
         self._tokens_total += 1
         monitor.stat_add("STAT_gen_tokens")
+        self._it["tokens"] += 1
         self._stage_token(req, tok)
         if req.span is not None:
             req.span.stamp("prefilled")
@@ -1227,6 +1468,50 @@ class GenerationEngine:
             req.span.stamp("last_token")
         if self._finished(req, tok):
             self._complete(req)
+
+    def _advance_prefills(self):
+        """Advance the OLDEST partially-prefilled slot by ONE chunk
+        through the per-bucket tail program (FLAGS_gen_prefill_chunk).
+        One chunk per engine iteration by design: between chunks the
+        loop runs a decode step for every live sequence, which is
+        exactly the TPOT protection chunked prefill exists for — the
+        long prompt's admission cost is spread across iterations
+        instead of stalling the step thread for its whole prefill."""
+        req = None
+        for r in self._slots:
+            if (r is not None and r.prefill_pos is not None
+                    and (req is None or r.ordinal < req.ordinal)):
+                req = r
+        if req is None:
+            return
+        S = int(req.prompt.size)
+        take = min(self._cfg.prefill_chunk, S - req.prefill_pos)
+        bucket = self._bucket_for(take)
+        ids = np.zeros((1, bucket), np.int32)
+        ids[0, :take] = req.prompt[req.prefill_pos:req.prefill_pos + take]
+        t0 = _now_ms()
+        with RecordEvent(f"generation::prefill_chunk[b={bucket}]"):
+            with self._dev_ctx():
+                out = self._tail_jit(
+                    self._W, *self._pools(), req.pt_row, ids,
+                    np.int32(take), np.int32(req.prefill_pos))
+            self._set_pools(out[:-1])
+            lg = np.asarray(out[-1])
+        self._it["prefill_ms"] += _now_ms() - t0
+        self._it["prefill_chunks"] += 1
+        self._chunks_total += 1
+        monitor.stat_add("STAT_gen_prefill_chunks")
+        if not np.all(np.isfinite(lg)):
+            req.prefill_pos = None
+            req.pending_digests = None
+            self._poison_prefill(req, bucket)
+            return
+        req.prefill_pos += take
+        if req.prefill_pos < S:
+            return
+        req.prefill_pos = None
+        digests, req.pending_digests = req.pending_digests, None
+        self._finish_prefill(req, lg, digests)
 
     def _sample_host(self, req: _GenRequest, logits: np.ndarray) -> int:
         """First-token sampling on host (prefill returns logits; decode
@@ -1256,8 +1541,8 @@ class GenerationEngine:
         smask = np.zeros((M,), bool)
         pt = np.zeros((M, PP), np.int32)
         for i, req in enumerate(self._slots):
-            if req is None:
-                continue
+            if req is None or req.prefill_pos is not None:
+                continue  # empty, or still chunk-prefilling (no toks)
             active[i] = True
             toks[i] = req.toks[-1]
             pos[i] = req.next_pos
@@ -1266,6 +1551,48 @@ class GenerationEngine:
             pt[i] = req.pt_row
         key = self._step_key()
         return pt, toks, pos, active, temps, smask, key
+
+    def _spec_arrays(self):
+        """Verify-step inputs (ISSUE 14): per-slot [current token + k
+        drafts] blocks. Drafts come from the prompt-lookup proposer
+        over each sequence's OWN token history, truncated to the
+        request's remaining token budget (so every consumed position
+        stays inside the pages the admission reserved); sampled slots
+        take no drafts. Returns (args, drafted_count)."""
+        M, PP = self._cfg.max_slots, self._cfg.pages_per_seq
+        K = self._spec_k
+        toks_blk = np.zeros((M, K + 1), np.int32)
+        dmask = np.zeros((M, K), bool)
+        pos = np.zeros((M,), np.int32)
+        active = np.zeros((M,), bool)
+        temps = np.ones((M,), np.float32)
+        smask = np.zeros((M,), bool)
+        pt = np.zeros((M, PP), np.int32)
+        drafted = 0
+        for i, req in enumerate(self._slots):
+            if req is None or req.prefill_pos is not None:
+                continue
+            active[i] = True
+            toks_blk[i, 0] = req.toks[-1]
+            pos[i] = req.next_pos
+            temps[i] = req.temperature
+            smask[i] = req.do_sample
+            pt[i] = req.pt_row
+            if not req.do_sample:
+                budget = min(K, req.max_new - len(req.toks) - 1)
+                if budget > 0:
+                    drafts = self._proposer.propose(
+                        np.concatenate([req.prompt,
+                                        np.asarray(req.toks, np.int32)]),
+                        budget)
+                    n = int(drafts.size)
+                    if n:
+                        toks_blk[i, 1:1 + n] = drafts
+                        dmask[i, :n] = True
+                        drafted += n
+        key = self._step_key()
+        return (pt, toks_blk, dmask, pos, active, temps, smask,
+                key), drafted
 
     def _step_key(self):
         import jax
@@ -1276,10 +1603,14 @@ class GenerationEngine:
     def _step(self):
         """ONE engine step: every live sequence advances one token
         through the single compiled decode program (inactive slots are
-        masked into the reserved scratch page). The np.asarray below is
-        the step's only host sync."""
+        masked into the reserved scratch page) — or, with speculation
+        on, 1 to k+1 tokens through the single compiled verify program.
+        The np.asarray below is the step's only host sync."""
         if self._pre_step_hook is not None:
             self._pre_step_hook(self)
+        if self._spec_k:
+            self._spec_step()
+            return
         args = self._step_arrays()
         t0 = _now_ms()
         with RecordEvent(f"generation::step[m={self._cfg.max_slots}]"):
@@ -1291,38 +1622,82 @@ class GenerationEngine:
         self._steps_total += 1
         monitor.stat_add("STAT_gen_steps")
         for i, req in enumerate(self._slots):
-            if req is None:
-                continue
+            if req is None or req.prefill_pos is not None:
+                continue  # empty, or chunk-prefilling (masked this step)
             if bad[i]:
                 # poison isolation: only THIS sequence fails; its pages
                 # are zeroed before reuse so the NaN cannot reach the
                 # next owner's masked attention
-                monitor.stat_add("STAT_gen_poisoned")
-                self._it["poisoned"] += 1
-                self._audit.audit("POISON_DECODE", rid=req.rid, slot=i,
-                                  generated=len(req.toks))
-                slo.observe_request(self.name, ok=False)
-                flight_recorder.dump("gen_poisoned_sequence", {
-                    "engine": self.name, "rid": req.rid, "stage": "decode",
-                    "slot": i, "generated": len(req.toks),
-                    "error": "non-finite decode logits",
-                    "step_log_tail": (self._step_log.tail(32)
-                                      if self._step_log is not None
-                                      else []),
-                    "audit_tail": self._audit.tail(64)})
-                self._evict(req, FatalError(
-                    f"{self.name}: sequence {req.rid} produced "
-                    f"non-finite logits at step {len(req.toks)}"))
+                self._poison_decode(req, i)
                 continue
             tok = int(nxt[i])
             req.toks.append(tok)
             req.next_pos += 1
             self._tokens_total += 1
             monitor.stat_add("STAT_gen_tokens")
+            self._it["tokens"] += 1
             self._stage_token(req, tok)
             if req.span is not None:
                 req.span.stamp("last_token")
             if self._finished(req, tok):
+                self._complete(req)
+
+    def _spec_step(self):
+        """ONE speculative engine step (ISSUE 14): every live sequence
+        advances 1 to k+1 tokens through the single compiled verify
+        program — the current token plus the longest prefix of its
+        prompt-lookup drafts the model greedily agrees with, plus the
+        bonus token the verify pass scored at the first disagreement.
+        Rejected draft positions were scratch-routed in-graph, so there
+        is nothing to undo on the host; acceptance is exact greedy
+        agreement, so the token stream is identical to the one the
+        plain decode program would have produced, just delivered in
+        fewer weight streams."""
+        args, drafted = self._spec_arrays()
+        t0 = _now_ms()
+        with RecordEvent(f"generation::verify[k={self._spec_k}]"):
+            out = self._verify_call(self._W, *self._pools(), *args)
+            n_acc = np.asarray(out[-3])
+            nxt = np.asarray(out[-2])
+            bad = np.asarray(out[-1])
+        self._set_pools(out[:-3])
+        self._it["decode_ms"] += _now_ms() - t0
+        self._steps_total += 1
+        monitor.stat_add("STAT_gen_steps")
+        if drafted:
+            monitor.stat_add("STAT_spec_drafted", drafted)
+            self._it["spec_drafted"] += drafted
+            self._spec_drafted_total += drafted
+        toks_blk = args[1]
+        for i, req in enumerate(self._slots):
+            if req is None or req.prefill_pos is not None:
+                continue
+            if bad[i]:
+                self._poison_decode(req, i)
+                continue
+            acc = int(n_acc[i])
+            if acc:
+                monitor.stat_add("STAT_spec_accepted", acc)
+                self._it["spec_accepted"] += acc
+                self._spec_accepted_total += acc
+                req.spec_accepted += acc
+            # accepted drafts in order, then the bonus token; EOS (or
+            # the max-new budget) inside the block ends the sequence
+            # there — later committed positions sit past next_pos,
+            # masked from every future attend and zeroed with the free
+            for tok in ([int(t) for t in toks_blk[i, 1:1 + acc]]
+                        + [int(nxt[i])]):
+                req.toks.append(tok)
+                req.next_pos += 1
+                self._tokens_total += 1
+                monitor.stat_add("STAT_gen_tokens")
+                self._it["tokens"] += 1
+                self._stage_token(req, tok)
+                if self._finished(req, tok):
+                    break
+            if req.span is not None:
+                req.span.stamp("last_token")
+            if self._finished(req, req.toks[-1]):
                 self._complete(req)
 
     def _finished(self, req: _GenRequest, tok: int) -> bool:
@@ -1339,9 +1714,18 @@ class GenerationEngine:
         a timeout (STAT_gen_timeouts, SLO error)."""
         t = _now_ms()
         for req in list(self._slots):
-            if req is None or req.deadline_ms is None:
+            if req is None:
                 continue
-            if t > req.deadline_ms:
+            deadlines = [req.deadline_ms] if req.deadline_ms else []
+            if req.ttft_deadline_ms is not None and not req.toks:
+                # a chunk-prefilling stream has been admitted but has
+                # no first token yet: its HARD TTFT deadline still
+                # applies (pre-chunking, admission implied an immediate
+                # prefill so this window could never be observed live)
+                deadlines.append(req.ttft_deadline_ms)
+            if not deadlines:
+                continue
+            if t > min(deadlines):
                 monitor.stat_add("STAT_gen_timeouts")
                 self._it["expired"] += 1
                 self._audit.audit(
@@ -1359,9 +1743,17 @@ class GenerationEngine:
                     if req.span is not None:
                         req.span.stamp("resolved")
                         req.span.finish(len(req.toks),
-                                        prefix_tokens=req.prefix_tokens)
+                                        prefix_tokens=req.prefix_tokens,
+                                        spec_tokens=req.spec_accepted)
                     continue
+                ttft_hit = (req.ttft_deadline_ms is not None
+                            and not req.toks
+                            and t > req.ttft_deadline_ms)
                 self._evict(req, ExecutionTimeoutError(
+                    f"{self.name}: request {req.rid} missed its HARD "
+                    f"TTFT deadline after {t - req.t_enqueue_ms:.1f}ms "
+                    f"admitted but still prefilling (no first token)"
+                    if ttft_hit else
                     f"{self.name}: request {req.rid} expired after "
                     f"{t - req.t_enqueue_ms:.1f}ms with "
                     f"{len(req.toks)}/{req.max_new} tokens decoded "
@@ -1384,9 +1776,21 @@ class GenerationEngine:
             self._cv.notify_all()
 
     def _complete(self, req: _GenRequest):
-        self._release(req)
         out = np.concatenate([req.prompt,
                               np.asarray(req.toks, np.int32)])
+        if self._prefix is not None and req.pt_row is not None:
+            # generated-suffix registration (ISSUE 14): index the full
+            # pages of prompt + answer BEFORE the release, so a
+            # follow-up turn whose prompt is this whole conversation
+            # (prompt_n+1 = prompt_n + answer_n, the agent-loop shape)
+            # walks the chain end-to-end. Only pages fully covered by
+            # WRITTEN positions qualify: the final token's K/V is never
+            # written (it was sampled, not stepped), so the chain stops
+            # at next_pos — registering past it would serve zeros
+            self._register_pages(
+                req, self._prefix.digests(out)[:req.next_pos
+                                               // self._cfg.page_size])
+        self._release(req)
         t_done = _now_ms()
         self._hist.observe(t_done - req.t_enqueue_ms)
         if req.deadline_ms is not None and t_done > req.deadline_ms:
@@ -1405,7 +1809,8 @@ class GenerationEngine:
                 if req.span is not None:
                     req.span.stamp("resolved")
                     req.span.finish(len(req.toks),
-                                    prefix_tokens=req.prefix_tokens)
+                                    prefix_tokens=req.prefix_tokens,
+                                    spec_tokens=req.spec_accepted)
                 return
             self._resolve_later(req.future, exc=ExecutionTimeoutError(
                 f"{self.name}: request expired after "
@@ -1429,7 +1834,8 @@ class GenerationEngine:
         if req.span is not None:
             req.span.stamp("resolved")
             req.span.finish(len(req.toks),
-                            prefix_tokens=req.prefix_tokens)
+                            prefix_tokens=req.prefix_tokens,
+                            spec_tokens=req.spec_accepted)
 
     def _evict(self, req: _GenRequest, err: BaseException):
         """Cancel a LIVE sequence mid-decode: free + zero its pages,
@@ -1463,6 +1869,7 @@ class GenerationEngine:
                       "prompt_len": int(r.prompt.size)
                       if r is not None else 0}
                      for i, r in enumerate(self._slots)]
+            decode_tokens = self._tokens_total - self._prefills_total
             slot_of = {r.rid: i for i, r in enumerate(self._slots)
                        if r is not None}
             ledger = dict(self._ledger)
@@ -1478,6 +1885,25 @@ class GenerationEngine:
             "steps": steps,
             "prefills": prefills,
             "tokens": tokens,
+            # speculative decoding + chunked prefill (ISSUE 14): the
+            # acceptance economics (tokens_per_step > 1 is the win) and
+            # the chunk count the bench + reports read
+            "spec": {
+                "enabled": bool(self._spec_k),
+                "k": self._spec_k,
+                "drafted": self._spec_drafted_total,
+                "accepted": self._spec_accepted_total,
+                "acceptance_rate": round(
+                    self._spec_accepted_total
+                    / max(1, self._spec_drafted_total), 4),
+                # decode-delivered tokens per decode step — every
+                # successful prefill delivers exactly one token, so
+                # subtracting prefills leaves the honest speculation
+                # signal (> 1.0 only when drafts were accepted)
+                "tokens_per_step": round(
+                    decode_tokens / max(1, steps), 4),
+            },
+            "prefill_chunks": self._chunks_total,
             "step_log": {
                 "enabled": self._step_log is not None,
                 "recorded": (self._step_log.recorded

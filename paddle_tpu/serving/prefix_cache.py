@@ -42,6 +42,7 @@ from typing import Dict, List, Optional, Tuple
 import numpy as np
 
 from ..framework import monitor
+from ..framework.flags import flag
 from .kv_cache import PagedKVCache
 
 __all__ = ["PrefixCache"]
@@ -67,11 +68,18 @@ class PrefixCache:
     """Block-chain index of cached prompt-prefix pages for ONE engine's
     `PagedKVCache` (the engine's step thread is the only writer)."""
 
-    def __init__(self, kv: PagedKVCache, engine: str = "generation"):
+    def __init__(self, kv: PagedKVCache, engine: str = "generation",
+                 max_pages: Optional[int] = None):
         self._kv = kv
         self.engine = engine
         self._nodes: Dict[bytes, _Node] = {}
         self._tick = itertools.count(1)
+        # byte budget as a page-count cap (ISSUE 14): register() evicts
+        # eagerly back to it, so the index can't grow without bound
+        # between admissions that happen to run short of free pages;
+        # 0/None = unbounded (evict-on-demand only, the ISSUE 12 shape)
+        self.max_pages = int(flag("FLAGS_gen_prefix_cache_max_pages")
+                             if max_pages is None else max_pages)
         # counted per ADMISSION via note_admitted, never per lookup — a
         # deferred head re-looks-up every engine iteration
         self.hits = 0           # admissions that matched >= 1 cached page
@@ -128,17 +136,26 @@ class PrefixCache:
         else:
             self.misses += 1
 
-    def register(self, digests: List[bytes], pt_row) -> int:
-        """Index a freshly prefilled prompt's full pages (called by the
-        step thread after the prefill wrote them). Existing nodes are
-        touched, new nodes take a cache reference on their page
-        (`cache_hold`). Returns the number of NEW nodes. A full-match
-        CoW split never re-registers: its node already exists and keeps
-        the ORIGINAL page — the private copy belongs to the sequence
-        alone."""
+    def register(self, digests: List[bytes], pt_row) -> List[int]:
+        """Index a freshly prefilled (or freshly decoded — generated
+        suffixes register at completion, ISSUE 14) sequence's full
+        pages (called by the step thread after the K/V landed).
+        Existing nodes are touched, new nodes take a cache reference on
+        their page (`cache_hold`). A full-match CoW split never
+        re-registers: its node already exists and keeps the ORIGINAL
+        page — the private copy belongs to the sequence alone.
+
+        With a `max_pages` budget set, registration that pushes the
+        cached-page count over it eagerly LRU-evicts OTHER chains back
+        to budget (the just-registered chain is excluded — evicting
+        what was registered a microsecond ago would be pure thrash).
+        Returns the page ids freed by that eviction (refcount hit 0) —
+        the engine zeroes them before reuse, exactly the evict()
+        contract."""
         added = 0
         tick = next(self._tick)
         parent: Optional[bytes] = None
+        own: List[int] = []
         for i, d in enumerate(digests):
             node = self._nodes.get(d)
             if node is None:
@@ -151,11 +168,26 @@ class PrefixCache:
                 added += 1
             else:
                 node.tick = tick
+            own.append(node.page)
             parent = d
-        if added:
+        freed: List[int] = []
+        if added and self.max_pages:
+            # eager budget enforcement: shrink the CACHED page count
+            # back to the cap (a live-shared victim releases the index
+            # reference without freeing bytes NOW — it still leaves the
+            # budget, and its page returns through the sharer's free)
+            refs = self._kv.refcounts()
+            exclude = set(own)
+            while (len(self._kv.cached_pages()) > self.max_pages
+                   and self._nodes):
+                victim = self._pick_victim(refs, exclude)
+                if victim is None:
+                    break
+                freed.extend(self._evict_node(victim, refs))
+        if added or freed:
             monitor.stat_set("STAT_prefix_cached_pages",
                              len(self._kv.cached_pages()))
-        return added
+        return freed
 
     # -- eviction ----------------------------------------------------------
 
@@ -180,26 +212,44 @@ class PrefixCache:
         exclude = set(exclude)
         freed: List[int] = []
         while len(freed) < need_pages and self._nodes:
-            leaves = [n for n in self._nodes.values()
-                      if not n.children and n.page not in exclude]
-            if not leaves:
-                break
-            victim = min((n for n in leaves if refs.get(n.page) == 1),
-                         key=lambda n: n.tick, default=None)
+            victim = self._pick_victim(refs, exclude)
             if victim is None:
-                # no freeable leaf: peel the LRU shared leaf to expose
-                # the freeable pages behind it (frees nothing itself)
-                victim = min(leaves, key=lambda n: n.tick)
-            del self._nodes[victim.key]
-            if victim.parent is not None and victim.parent in self._nodes:
-                self._nodes[victim.parent].children.discard(victim.key)
-            freed.extend(self._kv.cache_release([victim.page]))
-            refs.pop(victim.page, None)
-            self.evictions += 1
-            monitor.stat_add("STAT_prefix_evictions")
+                break
+            freed.extend(self._evict_node(victim, refs))
         monitor.stat_set("STAT_prefix_cached_pages",
                          len(self._kv.cached_pages()))
         return freed
+
+    def _pick_victim(self, refs: Dict[int, int],
+                     exclude: set) -> Optional[_Node]:
+        """The next LRU LEAF to evict: prefer leaves whose page only
+        the index holds (refcount 1 — the ones that actually free
+        bytes); fall back to the LRU shared leaf, which frees nothing
+        itself but exposes the freeable pages behind it (children must
+        leave the index before their parent). None when every leaf is
+        excluded."""
+        leaves = [n for n in self._nodes.values()
+                  if not n.children and n.page not in exclude]
+        if not leaves:
+            return None
+        victim = min((n for n in leaves if refs.get(n.page) == 1),
+                     key=lambda n: n.tick, default=None)
+        if victim is None:
+            victim = min(leaves, key=lambda n: n.tick)
+        return victim
+
+    def _evict_node(self, victim: _Node,
+                    refs: Dict[int, int]) -> List[int]:
+        """Drop one node from the index and release its cache
+        reference; returns the pages freed NOW (refcount 0)."""
+        del self._nodes[victim.key]
+        if victim.parent is not None and victim.parent in self._nodes:
+            self._nodes[victim.parent].children.discard(victim.key)
+        out = self._kv.cache_release([victim.page])
+        refs.pop(victim.page, None)
+        self.evictions += 1
+        monitor.stat_add("STAT_prefix_evictions")
+        return out
 
     # -- introspection -----------------------------------------------------
 
@@ -211,6 +261,7 @@ class PrefixCache:
         return {
             "enabled": True,
             "engine": self.engine,
+            "max_pages": self.max_pages,
             "nodes": len(self._nodes),
             "cached_pages": len(self._kv.cached_pages()),
             "evictable_pages": self._kv.evictable_pages,

@@ -1,0 +1,88 @@
+"""Model-free draft proposal for speculative decoding (ISSUE 14).
+
+Decode is weight-streaming-bound: one engine step reads every weight
+byte to advance each sequence ONE token. In that regime a single
+forward pass over k+1 positions costs barely more than one position —
+so if something cheap can GUESS the next k tokens, the verify program
+(`serving/generation.py`, built on the `gpt_spec_verify` seam) scores
+all k guesses plus the bonus position in one pass, the engine keeps
+the longest agreeing prefix, and accepted steps deliver up to k+1
+tokens for one weight stream.
+
+The proposer here is **prompt lookup** (n-gram continuation): the next
+tokens of a sequence are guessed from the sequence's OWN history —
+find the most recent earlier occurrence of the trailing n-gram and
+propose the tokens that followed it. No second model, no device work,
+no extra weights: pure numpy over the host-side token list, which is
+what makes the whole speculative path CPU-testable and keeps the draft
+cost invisible next to the verify dispatch. It shines exactly where
+production decode spends its tokens — code, quoting, JSON, multi-turn
+agent loops, and the repetition attractors of greedy decoding — and
+degrades to plain one-token-per-step decode when nothing matches
+(a miss costs only masked verify lanes, never a wrong token:
+acceptance is exact greedy agreement, so engine output is
+token-identical with speculation on or off).
+
+Proposal is per-slot and stateless across steps; the verify program
+and the acceptance bookkeeping live in the engine (single writer, its
+step thread). `FLAGS_gen_spec_k` sizes the draft block,
+`FLAGS_gen_spec_ngram` the longest pattern tried.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from ..framework.errors import InvalidArgumentError
+
+__all__ = ["NGramProposer"]
+
+
+class NGramProposer:
+    """Prompt-lookup draft proposer: continue the trailing n-gram from
+    its most recent earlier occurrence in the sequence's own tokens.
+
+    Tries pattern lengths `max_ngram` down to 1 (longer matches are
+    stronger evidence); within one length the RIGHTMOST earlier
+    occurrence **with k following tokens** wins — recent context beats
+    distant context (the locality assumption of prompt lookup), but a
+    match flush against the end of the history can only propose the
+    few tokens after it, which on a periodic tail (exactly where
+    lookup shines) would cap every proposal at one token; preferring
+    the nearest match that can fund a FULL draft block keeps the
+    proposal k long while staying as recent as possible. When no
+    occurrence has k followers the plain rightmost wins (partial
+    proposal). Returns at most `k` draft tokens; an empty proposal
+    means "no signal", and the engine runs that slot as plain
+    one-token decode inside the same verify program (its draft lanes
+    masked)."""
+
+    def __init__(self, max_ngram: int = 3):
+        if int(max_ngram) < 1:
+            raise InvalidArgumentError(
+                f"NGramProposer needs max_ngram >= 1, got {max_ngram}")
+        self.max_ngram = int(max_ngram)
+
+    def propose(self, tokens: np.ndarray, k: int) -> np.ndarray:
+        """Up to `k` draft tokens continuing `tokens` (1-D int array:
+        the sequence's prompt + generated tokens so far). Empty when
+        the history carries no matching n-gram."""
+        toks = np.asarray(tokens, np.int32)
+        T = int(toks.size)
+        k = int(k)
+        if k <= 0 or T < 2:
+            return np.zeros((0,), np.int32)
+        for n in range(min(self.max_ngram, T - 1), 0, -1):
+            pat = toks[T - n:]
+            # candidate starts s < T - n: the trailing pattern itself is
+            # excluded, and every candidate has >= 1 following token
+            windows = np.lib.stride_tricks.sliding_window_view(
+                toks[:T - 1], n)                    # [T-n, n]
+            hits = np.flatnonzero((windows == pat[None]).all(axis=1))
+            if hits.size == 0:
+                continue
+            full = hits[hits + n + k <= T]          # can fund k drafts
+            s = int(full[-1] if full.size else hits[-1])
+            out = toks[s + n:s + n + k]
+            if out.size:
+                return out.astype(np.int32, copy=True)
+        return np.zeros((0,), np.int32)

@@ -1,0 +1,515 @@
+"""Speculative decoding + chunked prefill (ISSUE 14).
+
+The load-bearing anchors:
+
+- **Parity** — engine greedy output with speculation on is
+  token-identical to speculation off (and to `GPTModel.generate`) for
+  fresh, mid-decode-joined, and chunk-prefilled requests: acceptance is
+  exact greedy agreement scored by ONE verify[k] program over the same
+  paged cache, so a wrong draft can never change the token stream, only
+  the number of weight streams it costs.
+- **Rejection hygiene** — rejected draft positions scrub to the
+  reserved scratch page in-graph (never a real page), so a
+  rejection-heavy sequence leaks nothing into a later owner of the same
+  physical pages (the PR 8 zero-on-free poison-isolation style) and
+  `pages_in_use` reconciles to zero at drain.
+- **Exact compile ledger** — one verify[k] program (no decode program
+  at all with speculation on), one tail program per bucket serving both
+  prefix hits and prefill chunks, zero runtime compiles as drafts are
+  accepted/rejected and chunks advance.
+- **Satellites** — prefix-cache byte budget (eager eviction at
+  register), generated-suffix registration (multi-turn agent loops hit
+  end-to-end), and the accepted-tokens/chunk observability plumbing
+  through the step ring and both report tools.
+"""
+import time
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import serving
+from paddle_tpu.framework import monitor
+from paddle_tpu.framework.errors import InvalidArgumentError
+from paddle_tpu.models import GPTConfig, GPTForCausalLM
+from paddle_tpu.serving.kv_cache import PagedKVCache
+from paddle_tpu.serving.prefix_cache import PrefixCache
+from paddle_tpu.serving.spec_decode import NGramProposer
+
+
+@pytest.fixture(scope="module")
+def model():
+    paddle.seed(11)
+    cfg = GPTConfig.tiny(dropout=0.0)
+    net = GPTForCausalLM(cfg)
+    net.eval()
+    return net
+
+
+def _engine(model, **kw):
+    kw.setdefault("max_slots", 2)
+    kw.setdefault("page_size", 4)
+    kw.setdefault("num_pages", 64)
+    kw.setdefault("prefill_buckets", (4, 16))
+    kw.setdefault("max_new_tokens", 8)
+    kw.setdefault("request_timeout_ms", 0)
+    return serving.GenerationEngine(model, **kw)
+
+
+def _prompts(n=3, size=11, seed=0, vocab=512):
+    rng = np.random.RandomState(seed)
+    return [rng.randint(0, vocab, size=(size,)).astype("int64")
+            for _ in range(n)]
+
+
+def _ref(model, p, max_new):
+    return model.generate(paddle.to_tensor(np.asarray(p)[None]),
+                          max_new_tokens=max_new).numpy()[0]
+
+
+class _OracleProposer:
+    """Drafts from the known continuation — forces full acceptance."""
+
+    def __init__(self, full_by_len):
+        self.full_by_len = full_by_len  # {prompt_len_key: full sequence}
+
+    def propose(self, tokens, k):
+        toks = np.asarray(tokens, np.int32)
+        for full in self.full_by_len:
+            full = np.asarray(full, np.int32)
+            if (toks.size <= full.size
+                    and np.array_equal(full[:toks.size], toks)):
+                return full[toks.size:toks.size + k].astype(np.int32)
+        return np.zeros((0,), np.int32)
+
+
+class _RejectProposer:
+    """Garbage drafts that can never match greedy continuation."""
+
+    def __init__(self, vocab=512):
+        self.vocab = vocab
+
+    def propose(self, tokens, k):
+        t = np.asarray(tokens, np.int32)
+        return ((np.repeat(t[-1:], k) + 7) % self.vocab).astype(np.int32)
+
+
+# -- proposer unit ----------------------------------------------------------
+
+def test_ngram_proposer_prompt_lookup():
+    prop = NGramProposer(3)
+    # trailing [7, 8] occurred earlier, followed by [9, 1, 2]
+    ctx = np.array([1, 2, 7, 8, 9, 1, 2, 5, 7, 8], np.int64)
+    np.testing.assert_array_equal(prop.propose(ctx, 3), [9, 1, 2])
+    # k truncation
+    np.testing.assert_array_equal(prop.propose(ctx, 1), [9])
+    # no signal: all-distinct tokens
+    assert prop.propose(np.arange(10), 4).size == 0
+    # rightmost match that can fund k followers wins over a nearer
+    # match flush against the end (the periodic-tail case)
+    per = np.array([4, 4, 4, 4, 4, 4], np.int64)
+    np.testing.assert_array_equal(prop.propose(per, 3), [4, 4, 4])
+    # tiny history degrades gracefully
+    assert prop.propose(np.array([3]), 4).size == 0
+    with pytest.raises(InvalidArgumentError):
+        NGramProposer(0)
+
+
+# -- engine parity on vs off ------------------------------------------------
+
+def test_spec_greedy_token_identical_on_off_and_generate(model):
+    prompts = _prompts(n=3)
+    refs = [_ref(model, p, 8) for p in prompts]
+    with _engine(model, spec_k=0, name="sp_off") as eng:
+        off = [eng.generate(p, max_new_tokens=8) for p in prompts]
+    with _engine(model, spec_k=3, name="sp_on") as eng:
+        on = [eng.generate(p, max_new_tokens=8) for p in prompts]
+        s = eng.stats()
+    for a, b, r in zip(on, off, refs):
+        np.testing.assert_array_equal(a, b)
+        np.testing.assert_array_equal(a, r)
+    # exact ledger: ONE verify[k] program, NO decode program at all,
+    # everything exactly once
+    assert s["compiles"]["verify[k=3]"] == 1
+    assert not any(k.startswith("decode") for k in s["compiles"])
+    assert all(v == 1 for v in s["compiles"].values())
+    assert s["spec"]["enabled"] and s["spec"]["k"] == 3
+
+
+def test_spec_oracle_acceptance_multi_token_steps(model):
+    """Full acceptance: k drafts + bonus land per step, far fewer steps
+    than tokens, still token-identical."""
+    p = _prompts(n=1)[0]
+    ref = _ref(model, p, 10)
+    with _engine(model, spec_k=3, max_new_tokens=10, name="sp_orc") as eng:
+        eng._proposer = _OracleProposer([ref])
+        out = eng.generate(p, max_new_tokens=10)
+        s = eng.stats()
+    np.testing.assert_array_equal(out, ref)
+    assert s["spec"]["accepted"] > 0
+    assert s["steps"] <= 4          # 10 tokens in <= 4 verify steps
+    assert s["spec"]["acceptance_rate"] == 1.0
+
+
+def test_spec_mid_decode_join_parity(model):
+    prompts = _prompts(n=2, seed=3)
+    ref_a = _ref(model, prompts[0], 40)
+    ref_b = _ref(model, prompts[1], 5)
+    with _engine(model, spec_k=2, num_pages=64, max_new_tokens=40,
+                 name="sp_join") as eng:
+        fa = eng.submit(prompts[0], max_new_tokens=40)
+        deadline = time.time() + 60
+        while eng.stats()["steps"] < 3:
+            assert time.time() < deadline, "engine never stepped"
+            time.sleep(0.002)
+        fb = eng.submit(prompts[1], max_new_tokens=5)  # joins mid-decode
+        out_b = fb.result(timeout=120)
+        out_a = fa.result(timeout=120)
+        s = eng.stats()
+    np.testing.assert_array_equal(out_a, ref_a)
+    np.testing.assert_array_equal(out_b, ref_b)
+    assert all(v == 1 for v in s["compiles"].values())
+
+
+def test_spec_eos_inside_accepted_drafts(model):
+    """EOS appearing among ACCEPTED drafts ends the sequence exactly
+    there — trailing accepted drafts and the bonus token are dropped,
+    matching the one-token-per-step engine's output exactly."""
+    p = _prompts(n=1, seed=5)[0]
+    ref = _ref(model, p, 12)
+    eos = int(ref[p.size + 4])      # 5th generated token acts as EOS
+    with _engine(model, spec_k=0, max_new_tokens=12, name="eos_off") as eng:
+        off = eng.generate(p, max_new_tokens=12, eos_token_id=eos)
+    with _engine(model, spec_k=4, max_new_tokens=12, name="eos_on") as eng:
+        eng._proposer = _OracleProposer([ref])
+        on = eng.generate(p, max_new_tokens=12, eos_token_id=eos)
+    np.testing.assert_array_equal(on, off)
+    assert int(on[-1]) == eos and on.size < p.size + 12
+
+
+def test_spec_sampled_slots_take_no_drafts(model):
+    """do_sample slots ride the verify program as plain one-token
+    decode (greedy acceptance would bias the distribution): drafts are
+    never proposed for them, output stays plausible (finite tokens,
+    right length)."""
+    p = _prompts(n=1, seed=7)[0]
+    with _engine(model, spec_k=3, name="sp_sample") as eng:
+        out = eng.generate(p, max_new_tokens=6, do_sample=True,
+                           temperature=0.9)
+        s = eng.stats()
+    assert out.shape[0] == p.size + 6
+    assert s["spec"]["drafted"] == 0
+
+
+# -- rejection-path hygiene (acceptance) ------------------------------------
+
+def test_forced_rejection_never_leaks_into_later_owner(model):
+    """Forced-rejection hook: every draft is wrong every step. The
+    co-resident clean sequence must stay token-identical, and a LATER
+    request that reuses the rejection-heavy sequence's freed physical
+    pages must decode exactly the clean-run tokens (scratch-routed
+    rejected writes + zero-on-free — nothing to leak)."""
+    prompts = _prompts(n=2, seed=9)
+    ref_a = _ref(model, prompts[0], 12)
+    ref_b = _ref(model, prompts[1], 12)
+    ref_c = _ref(model, prompts[0], 17)
+    with _engine(model, spec_k=3, num_pages=64, max_new_tokens=20,
+                 name="sp_rej") as eng:
+        eng._proposer = _RejectProposer()
+        fa = eng.submit(prompts[0], max_new_tokens=12)
+        fb = eng.submit(prompts[1], max_new_tokens=12)
+        np.testing.assert_array_equal(fa.result(timeout=120), ref_a)
+        np.testing.assert_array_equal(fb.result(timeout=120), ref_b)
+        s = eng.stats()
+        assert s["spec"]["drafted"] > 0 and s["spec"]["accepted"] == 0
+        # a wider request reaches into the freed pages (LIFO free list)
+        out_c = eng.generate(prompts[0], max_new_tokens=17)
+        pages_after = eng.stats()["pages"]["pages_in_use"]
+    np.testing.assert_array_equal(out_c, ref_c)
+    assert pages_after == 0
+    assert eng._cache.refcounts() == {}
+
+
+def test_rejection_heavy_and_mid_stream_expiry_reconcile(model):
+    """Acceptance criterion: zero leaked pages and exact refcount
+    reconciliation after rejection-heavy AND mid-stream-expiry runs —
+    stats()["kv"] owners empty at drain."""
+    prompts = _prompts(n=3, seed=13)
+    t0 = monitor.stat_get("STAT_gen_timeouts")
+    eng = _engine(model, spec_k=2, num_pages=64, max_new_tokens=100,
+                  name="sp_drain")
+    eng._proposer = _RejectProposer()
+    futs = [eng.submit(p, max_new_tokens=6) for p in prompts[:2]]
+    # a stream that expires mid-decode (soft deadline, partial result)
+    stream = eng.submit_stream(prompts[2], max_new_tokens=100,
+                               timeout_ms=80)
+    toks = list(stream)
+    assert 1 <= len(toks) < 100
+    for f in futs:
+        f.result(timeout=120)
+    eng.shutdown(drain=True, timeout_s=120)
+    assert monitor.stat_get("STAT_gen_timeouts") > t0
+    s = eng.stats()
+    assert s["kv"]["owners"] == []
+    assert s["pages"]["pages_in_use"] == 0
+    assert eng._cache.refcounts() == {}
+    assert s["pages"]["free_pages"] == s["pages"]["usable_pages"]
+
+
+# -- chunked prefill --------------------------------------------------------
+
+def test_chunked_prefill_parity_and_ledger(model):
+    """A long prompt prefilled in chunks through the per-bucket tail
+    programs is token-identical to whole-prompt prefill and to
+    generate(); chunks mint no new programs."""
+    rng = np.random.RandomState(21)
+    long_p = rng.randint(0, 512, size=(50,)).astype("int64")
+    ref = _ref(model, long_p, 6)
+    with _engine(model, prefill_buckets=(16, 64), max_new_tokens=6,
+                 name="ch_off") as eng:
+        off = eng.generate(long_p, max_new_tokens=6)
+    with _engine(model, prefill_buckets=(16, 64), max_new_tokens=6,
+                 prefill_chunk=16, name="ch_on") as eng:
+        on = eng.generate(long_p, max_new_tokens=6)
+        s = eng.stats()
+    np.testing.assert_array_equal(on, off)
+    np.testing.assert_array_equal(on, ref)
+    assert s["prefill_chunks"] >= 3          # 50 tokens / 16-chunks
+    assert all(v == 1 for v in s["compiles"].values())
+    # chunks ride the warmed tail buckets — no chunk-specific program
+    assert "prefill_tail[b=16]" in s["compiles"]
+
+
+def test_chunked_prefill_interleaves_with_decode(model):
+    """While a long prompt chunk-prefills, co-resident live sequences
+    keep taking decode steps (the step ring shows iterations that ran
+    BOTH a chunk and a decode pass), and both outputs stay exact."""
+    rng = np.random.RandomState(23)
+    long_p = rng.randint(0, 512, size=(60,)).astype("int64")
+    short_p = _prompts(n=1, seed=25)[0]
+    ref_long = _ref(model, long_p, 4)
+    ref_short = _ref(model, short_p, 40)
+    with _engine(model, prefill_buckets=(16, 64), max_new_tokens=40,
+                 prefill_chunk=16, num_pages=64, name="ch_il") as eng:
+        fa = eng.submit(short_p, max_new_tokens=40)
+        deadline = time.time() + 60
+        while eng.stats()["steps"] < 3:
+            assert time.time() < deadline
+            time.sleep(0.002)
+        fb = eng.submit(long_p, max_new_tokens=4)
+        np.testing.assert_array_equal(fb.result(timeout=120), ref_long)
+        np.testing.assert_array_equal(fa.result(timeout=120), ref_short)
+        from paddle_tpu.profiler import step_log
+        recs = step_log.steps_payload()["engines"]["ch_il"]["records"]
+    both = [r for r in recs
+            if r["prefill_chunks"] > 0 and r["decode_ms"] > 0]
+    assert both, "no iteration ran a chunk AND a decode step"
+
+
+def test_chunk_plus_prefix_hit_tail_chunks(model):
+    """A prefix-cache hit whose un-cached tail is still long chunks
+    ONLY the tail (offsets start at the cached prefix), token-exact."""
+    rng = np.random.RandomState(27)
+    pfx = rng.randint(0, 512, size=(16,)).astype("int64")
+    tails = [rng.randint(0, 512, size=(36,)).astype("int64")
+             for _ in range(2)]
+    prompts = [np.concatenate([pfx, t]) for t in tails]
+    refs = [_ref(model, p, 5) for p in prompts]
+    with _engine(model, prefill_buckets=(16, 64), max_new_tokens=5,
+                 prefill_chunk=16, prefix_cache=True,
+                 name="ch_pfx") as eng:
+        out0 = eng.generate(prompts[0], max_new_tokens=5)
+        c0 = eng.stats()["prefill_chunks"]
+        out1 = eng.generate(prompts[1], max_new_tokens=5)  # prefix hit
+        s = eng.stats()
+    np.testing.assert_array_equal(out0, refs[0])
+    np.testing.assert_array_equal(out1, refs[1])
+    assert s["kv"]["prefix"]["hits"] >= 1
+    # second request chunked only its 36-token tail (3 chunks), not the
+    # full 52-token prompt (4)
+    assert 0 < s["prefill_chunks"] - c0 <= 3
+    assert all(v == 1 for v in s["compiles"].values())
+
+
+def test_spec_plus_chunk_plus_prefix_full_stack(model):
+    """The whole stack composed: speculation + chunked prefill + prefix
+    cache, fresh and repeat prompts, token-identical to generate() with
+    an exactly-once ledger and clean drain."""
+    rng = np.random.RandomState(31)
+    long_p = rng.randint(0, 512, size=(50,)).astype("int64")
+    ref = _ref(model, long_p, 6)
+    eng = _engine(model, prefill_buckets=(16, 64), max_new_tokens=6,
+                  prefill_chunk=16, prefix_cache=True, spec_k=2,
+                  name="all_on")
+    o1 = eng.generate(long_p, max_new_tokens=6)
+    o2 = eng.generate(long_p, max_new_tokens=6)
+    eng.shutdown(drain=True, timeout_s=120)
+    s = eng.stats()
+    np.testing.assert_array_equal(o1, ref)
+    np.testing.assert_array_equal(o2, ref)
+    assert s["compiles"]["verify[k=2]"] == 1
+    assert all(v == 1 for v in s["compiles"].values())
+    assert s["kv"]["owners"] == []
+    # only the cached chains remain; every allocated page is cache-held
+    assert s["pages"]["pages_in_use"] == s["pages"]["cached_pages"]
+
+
+def test_spec_int8_pages_run_clean(model):
+    """Speculation over int8 KV pages: rejected drafts scrub to the
+    scratch page so real pages' quantization grids never widen from a
+    rejected token; the run completes, reconciles, and repeats
+    deterministically."""
+    p = _prompts(n=1, seed=33)[0]
+    with _engine(model, spec_k=3, kv_cache_dtype="int8",
+                 name="sp_int8") as eng:
+        a = eng.generate(p, max_new_tokens=8)
+        b = eng.generate(p, max_new_tokens=8)
+        s = eng.stats()
+    np.testing.assert_array_equal(a, b)   # bit-stable across repeats
+    assert s["pages"]["pages_in_use"] == 0
+    assert s["compiles"]["verify[k=3]"] == 1
+
+
+# -- satellites: prefix budget + generated-suffix registration --------------
+
+def test_prefix_budget_eager_eviction_at_register(model):
+    """FLAGS_gen_prefix_cache_max_pages caps the index: registration
+    beyond budget eagerly LRU-evicts OTHER chains back to the cap
+    (audit EVICT_PREFIX_BUDGET), instead of waiting for an admission
+    to run short."""
+    prompts = _prompts(n=3, size=12, seed=41)
+    e0 = monitor.stat_get("STAT_prefix_evictions")
+    with _engine(model, prefix_cache=True, prefix_cache_max_pages=3,
+                 max_new_tokens=4, name="pfx_budget") as eng:
+        for p in prompts:
+            eng.generate(p, max_new_tokens=4)
+            assert len(eng._cache.cached_pages()) <= 3
+        reasons = [ev["reason"] for ev in eng._audit.tail(64)]
+        s = eng.stats()
+    assert "EVICT_PREFIX_BUDGET" in reasons
+    assert monitor.stat_get("STAT_prefix_evictions") > e0
+    assert s["kv"]["prefix"]["max_pages"] == 3
+    assert s["pages"]["pages_in_use"] <= 3
+
+
+def test_prefix_budget_unbounded_by_default():
+    c = PagedKVCache(num_layers=1, num_heads=1, head_dim=4, page_size=4,
+                     num_pages=16, pages_per_seq=4)
+    idx = PrefixCache(c, "t")
+    assert idx.max_pages == 0
+    row = c.alloc(1, 16)
+    digests, _ = idx.lookup(np.arange(16, dtype=np.int64))
+    freed = idx.register(digests, row)
+    assert freed == [] and len(idx) == 4
+
+
+def test_generated_suffix_registration_multi_turn(model):
+    """Agent-loop shape: prompt_n+1 = prompt_n + answer_n. The answer's
+    full pages registered at completion make the follow-up turn hit the
+    chain END-TO-END (prefix tokens cover prompt + generated suffix),
+    token-identically."""
+    p1 = _prompts(n=1, size=8, seed=43)[0]      # 2 full 4-token pages
+    with _engine(model, prefill_buckets=(4, 16, 64), max_new_tokens=8,
+                 prefix_cache=True, num_pages=64,
+                 name="pfx_turns") as eng:
+        a1 = eng.generate(p1, max_new_tokens=8)
+        # turn 2: the whole first conversation + new user tokens
+        p2 = np.concatenate([a1, _prompts(n=1, size=3, seed=44)[0]])
+        ref2 = _ref(model, p2, 5)
+        h0 = eng.stats()["kv"]["prefix"]["hit_tokens"]
+        a2 = eng.generate(p2, max_new_tokens=5)
+        hit = eng.stats()["kv"]["prefix"]["hit_tokens"] - h0
+    np.testing.assert_array_equal(a2, ref2)
+    # the hit covers GENERATED pages too: more than the 8 prompt-only
+    # tokens of turn 1 (a1 is 16 tokens; its written positions fund
+    # 3 full pages = 12 cached tokens)
+    assert hit >= 12
+
+
+# -- observability plumbing -------------------------------------------------
+
+def test_step_ring_and_reports_carry_spec_fields(model, tmp_path):
+    import importlib.util
+    import json
+    import os
+    from paddle_tpu import profiler
+    from paddle_tpu.profiler import step_log
+
+    p = _prompts(n=1, seed=51)[0]
+    ref = _ref(model, p, 10)
+    rng = np.random.RandomState(52)
+    long_p = rng.randint(0, 512, size=(40,)).astype("int64")
+    with _engine(model, spec_k=3, max_new_tokens=10,
+                 prefill_buckets=(16, 64), prefill_chunk=16,
+                 name="sp_obs") as eng:
+        eng._proposer = _OracleProposer([ref])
+        out = eng.generate(p, max_new_tokens=10)
+        eng.generate(long_p, max_new_tokens=4)
+        payload = step_log.steps_payload()
+        recs = payload["engines"]["sp_obs"]["records"]
+    np.testing.assert_array_equal(out, ref)
+    assert sum(r["spec_accepted"] for r in recs) > 0
+    assert sum(r["spec_drafted"] for r in recs) > 0
+    assert sum(r["prefill_chunks"] for r in recs) >= 2
+    assert sum(r["tokens"] for r in recs) == 14
+
+    tools = os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "tools")
+
+    def load(name):
+        spec = importlib.util.spec_from_file_location(
+            name, os.path.join(tools, name + ".py"))
+        mod = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(mod)
+        return mod
+
+    er = load("engine_report")
+    summ = er.summarize(recs)
+    assert summ["spec_accepted"] > 0 and summ["prefill_chunks"] >= 2
+    assert summ["tokens"] == 14 and summ["tokens_per_step"] > 1.0
+    # records from BEFORE this PR (no spec/chunk/tokens fields) still
+    # summarize and render — the PR 12 field-count lesson
+    old = [{k: v for k, v in r.items()
+            if k not in ("tokens", "spec_drafted", "spec_accepted",
+                         "prefill_chunks")} for r in recs]
+    old_summ = er.summarize(old)
+    assert old_summ["spec_accepted"] == 0 and old_summ["tokens"] == 0
+    path = str(tmp_path / "steps.json")
+    with open(path, "w") as f:
+        json.dump({"enabled": True,
+                   "engines": {"sp_obs": {"records": old,
+                                          "audit": []}}}, f)
+    assert er.main([path, "--engine", "sp_obs"]) == 0
+
+    # latency_report: acc= parsed per request; old-style instants
+    # (no acc, or no pfx) parse as 0
+    lr = load("latency_report")
+    trace = str(tmp_path / "trace.json")
+    profiler.export_chrome_tracing(trace)
+    gens = [g for g in lr.parse_gen_trace(trace)
+            if g["engine"] == "sp_obs"]
+    assert gens and any(g["acc"] > 0 for g in gens)
+    rep = lr.gen_report(gens, top=3)
+    assert rep["spec_accepted_tokens"] > 0
+    assert rep["tokens_per_step"] > 1.0
+    old_events = [
+        {"name": "reqspan:1:old:slot0:n=8:ttft=1.0,tpot=2.0,e=20.0",
+         "ph": "i", "ts": 1.0},
+        {"name": "reqspan:2:old:slot1:n=4:ttft=1.0,tpot=2.0,e=9.0,"
+                 "pfx=4", "ph": "i", "ts": 2.0}]
+    olds = lr.parse_gen_trace(trace, events=old_events)
+    assert len(olds) == 2
+    assert all(g["acc"] == 0 for g in olds)
+    assert olds[1]["pfx"] == 4
+
+
+def test_spec_reqspan_carries_accepted_tokens(model):
+    p = _prompts(n=1, seed=61)[0]
+    ref = _ref(model, p, 10)
+    with _engine(model, spec_k=3, max_new_tokens=10,
+                 name="sp_span") as eng:
+        eng._proposer = _OracleProposer([ref])
+        out = eng.generate(p, max_new_tokens=10)
+        s = eng.stats()
+    np.testing.assert_array_equal(out, ref)
+    assert s["spec"]["tokens_per_step"] > 1.0

@@ -12,11 +12,15 @@ dump whose `extra` carries `step_log_tail`/`audit_tail` (engine death,
 poison, allocator exhaustion). The report shows, per iteration: decode
 slots in use (as a bar), scheduler decisions (admit/complete/expire/
 poison/abort), queue depth + oldest-request age, page-pool occupancy,
-prefix-cache hit tokens + copy-on-write splits (pfx/cow), and
-prefill-vs-decode wall — then the audit tail with reason codes (per
-request: ADMIT_PREFIX_HIT carries prefix_tokens, COW_SPLIT the split
-pages), so "why did this request wait/die" reads straight off the
-artifact.
+prefix-cache hit tokens + copy-on-write splits (pfx/cow), tokens
+delivered + speculative drafts accepted + prefill chunks run
+(tok/acc/chk — ISSUE 14: tok > slots on a decode iteration is
+speculation paying off, chk interleaved with decode wall is chunked
+prefill protecting TPOT), and prefill-vs-decode wall — then the audit
+tail with reason codes (per request: ADMIT_PREFIX_HIT carries
+prefix_tokens, COW_SPLIT the split pages), so "why did this request
+wait/die" reads straight off the artifact. Records predating ISSUE 14
+parse unchanged: every field reads by name with a zero default.
 
 `--json` emits the parsed + summarized structure for scripting.
 """
@@ -56,12 +60,26 @@ def summarize(records: List[dict]) -> dict:
         return {"iterations": 0}
     tot = {k: sum(r.get(k, 0) for r in records)
            for k in ("admitted", "completed", "expired", "poisoned",
-                     "aborted", "freed", "prefix_tokens", "cow_splits")}
+                     "aborted", "freed", "prefix_tokens", "cow_splits",
+                     "tokens", "spec_drafted", "spec_accepted",
+                     "prefill_chunks")}
+    decode_steps = sum(1 for r in records if r.get("decode_ms", 0) > 0)
     return {
         "iterations": len(records),
-        "decode_steps": sum(1 for r in records
-                            if r.get("decode_ms", 0) > 0),
+        "decode_steps": decode_steps,
         **tot,
+        # tokens delivered per decode step over the window. NOTE: the
+        # numerator includes prefill FIRST tokens (the ring does not
+        # record prefill completions separately), so short-request
+        # traffic reads slightly above 1.0 even with speculation off —
+        # spec_accepted_per_step below is the exact speculation signal
+        # (accepted drafts are the only way a decode step delivers
+        # more than one token per live slot)
+        "tokens_per_step": round(tot["tokens"] / decode_steps, 3)
+        if decode_steps else 0.0,
+        "spec_accepted_per_step": round(
+            tot["spec_accepted"] / decode_steps, 3)
+        if decode_steps else 0.0,
         "peak_live": max(r.get("live", 0) for r in records),
         "peak_queue_depth": max(r.get("queue_depth", 0)
                                 for r in records),
@@ -113,10 +131,21 @@ def render(name: str, eng: dict, last: int = 0,
             print(f"   prefix cache: {summ['prefix_tokens']} prompt "
                   f"tokens served from cached pages, "
                   f"{summ['cow_splits']} copy-on-write splits", file=out)
+        # the speculative economics in one line: tokens delivered per
+        # decode step (incl. prefill first tokens), the exact accepted-
+        # drafts-per-step signal, the draft acceptance split, and any
+        # prefill chunks run (ISSUE 14)
+        print(f"   {summ['tokens']} tokens / {summ['decode_steps']} "
+              f"decode steps = {summ['tokens_per_step']} tokens/step "
+              f"(+{summ['spec_accepted_per_step']}/step from spec: "
+              f"{summ['spec_accepted']}/{summ['spec_drafted']} drafts "
+              f"accepted, {summ['prefill_chunks']} prefill chunks)",
+              file=out)
         hdr = (f"   {'it':>6} {'step':>6} {'slots':<10} {'adm':>3} "
                f"{'done':>4} {'exp':>3} {'psn':>3} {'abt':>3} "
                f"{'queue':>5} {'age_ms':>8} {'pages':>5} {'free':>5} "
-               f"{'pfx':>4} {'cow':>3} {'prefill':>8} {'decode':>8}")
+               f"{'pfx':>4} {'cow':>3} {'tok':>4} {'acc':>4} "
+               f"{'chk':>3} {'prefill':>8} {'decode':>8}")
         print(hdr, file=out)
         for r in records:
             print(f"   {r.get('it', 0):>6} {r.get('step', 0):>6} "
@@ -132,6 +161,9 @@ def render(name: str, eng: dict, last: int = 0,
                   f"{r.get('free_pages', 0):>5} "
                   f"{r.get('prefix_tokens', 0):>4} "
                   f"{r.get('cow_splits', 0):>3} "
+                  f"{r.get('tokens', 0):>4} "
+                  f"{r.get('spec_accepted', 0):>4} "
+                  f"{r.get('prefill_chunks', 0):>3} "
                   f"{r.get('prefill_ms', 0.0):>7.1f}ms "
                   f"{r.get('decode_ms', 0.0):>7.1f}ms", file=out)
     audit = eng.get("audit", [])

@@ -20,11 +20,15 @@ The continuous-batching GenerationEngine emits a second, slot-flavored
 reqspan shape per resolved request (profiler/spans.py GenSpan):
 
     reqspan:<rid>:<engine>:slot<slot>:n=<tokens>:ttft=…,tpot=…,e=…
+                                                [,pfx=…][,acc=…]
 
 with TTFT (queue + prefill to first token), TPOT (steady decode cadence
-per output token) and end-to-end milliseconds. Both shapes are parsed;
-whichever is present gets its own report section (phase percentiles +
-top-N slowest).
+per output token) and end-to-end milliseconds; `pfx` (ISSUE 12) counts
+prompt tokens served from the prefix cache, `acc` (ISSUE 14) the
+speculative draft tokens accepted — both optional, so traces from any
+era parse. Both shapes are parsed; whichever is present gets its own
+report section (phase percentiles + top-N slowest, plus a
+tokens-per-step summary for generation spans).
 
 Usage:  python tools/latency_report.py trace.json [--top 10]
                                        [--engine NAME] [--json]
@@ -46,7 +50,7 @@ _GENSPAN = re.compile(
     r"^reqspan:(?P<rid>\d+):(?P<engine>.*):slot(?P<slot>[^:]*):"
     r"n=(?P<n>\d+):"
     r"ttft=(?P<ttft>[0-9.]+),tpot=(?P<tpot>[0-9.]+),e=(?P<e>[0-9.]+)"
-    r"(?:,pfx=(?P<pfx>\d+))?$")
+    r"(?:,pfx=(?P<pfx>\d+))?(?:,acc=(?P<acc>\d+))?$")
 
 PHASES = (("queue", "q"), ("pad", "p"), ("device", "d"), ("resolve", "r"))
 GEN_PHASES = (("ttft", "ttft"), ("tpot", "tpot"))
@@ -78,10 +82,12 @@ def parse_trace(path, events=None):
 
 
 def parse_gen_trace(path, events=None):
-    """[{rid, engine, slot, n, pfx, ttft, tpot, e, ts_us}] from the
-    trace's generation-engine reqspan instants (`pfx` = prompt tokens
-    served from the prefix cache; 0 in traces predating ISSUE 12 —
-    the field is optional in the regex, so old traces still parse)."""
+    """[{rid, engine, slot, n, pfx, acc, ttft, tpot, e, ts_us}] from
+    the trace's generation-engine reqspan instants (`pfx` = prompt
+    tokens served from the prefix cache, 0 in traces predating
+    ISSUE 12; `acc` = speculative draft tokens accepted, 0 in traces
+    predating ISSUE 14 — both fields are optional in the regex, so old
+    traces still parse)."""
     events = _load_events(path) if events is None else events
     out = []
     for ev in events:
@@ -92,6 +98,7 @@ def parse_gen_trace(path, events=None):
         out.append({"rid": int(g["rid"]), "engine": g["engine"],
                     "slot": g["slot"], "n": int(g["n"]),
                     "pfx": int(g["pfx"] or 0),
+                    "acc": int(g["acc"] or 0),
                     "ttft": float(g["ttft"]), "tpot": float(g["tpot"]),
                     "e": float(g["e"]), "ts_us": ev.get("ts", 0.0)})
     return out
@@ -148,10 +155,20 @@ def gen_phase_stats(gens):
 
 
 def gen_report(gens, top=10):
+    toks = sum(g["n"] for g in gens)
+    acc = sum(g["acc"] for g in gens)
     return {"requests": len(gens), "phases_ms": gen_phase_stats(gens),
-            "tokens": sum(g["n"] for g in gens),
+            "tokens": toks,
             "prefix_hit_requests": sum(1 for g in gens if g["pfx"] > 0),
             "prefix_hit_tokens": sum(g["pfx"] for g in gens),
+            # speculative decoding (ISSUE 14): accepted draft tokens
+            # arrived without their own decode step — the tokens-per-
+            # step summary is total tokens over the steps actually paid
+            "spec_accepted_requests": sum(1 for g in gens
+                                          if g["acc"] > 0),
+            "spec_accepted_tokens": acc,
+            "tokens_per_step": round(toks / (toks - acc), 3)
+            if toks > acc else (1.0 if toks else 0.0),
             "slowest": sorted(gens, key=lambda g: -g["e"])[:top]}
 
 
@@ -160,6 +177,10 @@ def render_gen(rep, file=sys.stdout):
           f"{rep['tokens']} tokens "
           f"({rep['prefix_hit_requests']} prefix-cache hit(s), "
           f"{rep['prefix_hit_tokens']} prompt tokens served from cache)",
+          file=file)
+    print(f"speculative decoding: {rep['spec_accepted_tokens']} draft "
+          f"tokens accepted across {rep['spec_accepted_requests']} "
+          f"request(s) — {rep['tokens_per_step']} tokens/step",
           file=file)
     print(f"\n{'phase':<10}{'p50(ms)':>10}{'p99(ms)':>10}"
           f"{'mean':>10}{'max':>10}", file=file)
@@ -170,11 +191,12 @@ def render_gen(rep, file=sys.stdout):
     if rep["slowest"]:
         print(f"\ntop {len(rep['slowest'])} slowest:", file=file)
         print(f"{'rid':>8} {'engine':<16}{'slot':>5}{'toks':>6}"
-              f"{'pfx':>5}{'e2e(ms)':>10}{'ttft':>9}{'tpot':>9}",
-              file=file)
+              f"{'pfx':>5}{'acc':>5}{'e2e(ms)':>10}{'ttft':>9}"
+              f"{'tpot':>9}", file=file)
         for g in rep["slowest"]:
             print(f"{g['rid']:>8} {g['engine']:<16}{g['slot']:>5}"
-                  f"{g['n']:>6}{g['pfx']:>5}{g['e']:>10.3f}"
+                  f"{g['n']:>6}{g['pfx']:>5}{g['acc']:>5}"
+                  f"{g['e']:>10.3f}"
                   f"{g['ttft']:>9.3f}{g['tpot']:>9.3f}", file=file)
 
 
