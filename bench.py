@@ -984,6 +984,134 @@ def bench_generation():
     return eng_tps, extra
 
 
+def bench_recovery():
+    """Engine resurrection under load (ISSUE 15): the SAME concurrent
+    prompt load runs through two supervised engines — a fault-free arm
+    and an arm where one decode-step exception is injected mid-load
+    (`FLAGS_failpoints decode_step_raise@N`, the deterministic
+    registry). Gates: every request in the fault arm resolves
+    successfully with greedy output token-identical to the fault-free
+    arm (exactly-once replay), exactly one restart, recovery wall
+    (backoff + pool rebuild + replay enqueue) bounded, aggregate
+    goodput >= 0.7x the fault-free arm, ZERO new compiles after the
+    restart (the rebuilt engine re-warms from the shared program
+    pack's jit caches, ledger-proven), and zero leaked pages."""
+    import paddle_tpu as paddle
+    from paddle_tpu import serving
+    from paddle_tpu.models import GPTConfig, GPTForCausalLM
+    from paddle_tpu.serving import failpoints
+
+    if _SMOKE:
+        HID, LAYERS, HEADS, VOCAB = 512, 4, 8, 2048
+        SLOTS, REQUESTS, MAX_NEW, PROMPT = 8, 24, 16, 16
+        RECOVERY_MS_BOUND = 5000.0
+    else:
+        HID, LAYERS, HEADS, VOCAB = 768, 8, 12, 32000
+        SLOTS, REQUESTS, MAX_NEW, PROMPT = 16, 48, 32, 64
+        RECOVERY_MS_BOUND = 10000.0
+    PAGE = 16
+
+    paddle.seed(0)
+    cfg = GPTConfig(vocab_size=VOCAB, hidden_size=HID, num_layers=LAYERS,
+                    num_heads=HEADS, intermediate_size=4 * HID,
+                    max_position_embeddings=PROMPT + MAX_NEW, dropout=0.0)
+    net = GPTForCausalLM(cfg)
+    net.eval()
+    rng = np.random.RandomState(0)
+    prompts = [rng.randint(0, VOCAB, size=(PROMPT,)).astype("int64")
+               for _ in range(REQUESTS)]
+    pages = SLOTS * -(-(PROMPT + MAX_NEW) // PAGE) + 1
+    # one decode-step fault MID-LOAD: total decode steps ≈
+    # ceil(REQUESTS / SLOTS) * MAX_NEW; fire a bit under halfway so
+    # live slots AND a queued tail both ride the crash manifest
+    fault_step = max(2, (-(-REQUESTS // SLOTS) * MAX_NEW) // 3)
+
+    def arm(name, spec):
+        failpoints.reset()
+        prev = paddle.get_flags(["FLAGS_failpoints",
+                                 "FLAGS_gen_restart_backoff_ms"])
+        paddle.set_flags({"FLAGS_failpoints": spec,
+                          "FLAGS_gen_restart_backoff_ms": 20.0})
+        try:
+            sup = serving.EngineSupervisor(
+                net, max_slots=SLOTS, page_size=PAGE, num_pages=pages,
+                prefill_buckets=(PROMPT,), max_new_tokens=MAX_NEW,
+                max_queue_depth=2 * REQUESTS, request_timeout_ms=0,
+                name=name)
+            ledger0 = dict(sup.engine._ledger)
+            start = threading.Barrier(REQUESTS + 1)
+            futs = [None] * REQUESTS
+            errors = []
+
+            def client(i):
+                try:
+                    start.wait()
+                    futs[i] = sup.submit(prompts[i],
+                                         max_new_tokens=MAX_NEW)
+                except Exception as e:  # noqa: BLE001
+                    errors.append(e)
+
+            threads = [threading.Thread(target=client, args=(i,),
+                                        daemon=True)
+                       for i in range(REQUESTS)]
+            for t in threads:
+                t.start()
+            start.wait()
+            t0 = time.perf_counter()
+            for t in threads:
+                t.join()
+            if errors:
+                raise RuntimeError(
+                    f"{len(errors)}/{REQUESTS} recovery clients "
+                    f"failed to submit: {errors[0]!r}")
+            outs, resolve_errors = [], []
+            for f in futs:
+                try:
+                    outs.append(np.asarray(f.result(timeout=300)))
+                except Exception as e:  # noqa: BLE001
+                    outs.append(None)
+                    resolve_errors.append(repr(e))
+            wall = time.perf_counter() - t0
+            toks = sum(len(o) - PROMPT for o in outs if o is not None)
+            s = sup.stats()
+            res = {
+                "goodput_tokens_per_sec": round(toks / wall, 2),
+                "resolved": sum(1 for o in outs if o is not None),
+                "resolve_errors": resolve_errors[:4],
+                "restarts": s["supervisor"]["restarts"],
+                "recovery_ms": s["supervisor"]["last_recovery_ms"],
+                "replayed": s["supervisor"]["replayed_requests"],
+                "new_compiles_after_start":
+                    dict(sup.engine._ledger) != ledger0,
+                "pages_in_use": s["pages"]["pages_in_use"],
+                "outs": outs,
+            }
+            sup.shutdown()
+            return res
+        finally:
+            paddle.set_flags(prev)
+            failpoints.reset()
+
+    clean = arm("bench_recovery_clean", "")
+    fault = arm("bench_recovery_fault",
+                f"decode_step_raise@{fault_step}")
+    identical = all(
+        a is not None and b is not None and np.array_equal(a, b)
+        for a, b in zip(clean.pop("outs"), fault.pop("outs")))
+    ratio = round(fault["goodput_tokens_per_sec"]
+                  / max(clean["goodput_tokens_per_sec"], 1e-9), 3)
+    extra = {
+        "clean": clean,
+        "fault": fault,
+        "requests": REQUESTS,
+        "fault_step": fault_step,
+        "goodput_ratio_fault_vs_clean": ratio,
+        "token_identical_fault_vs_clean": identical,
+        "recovery_ms_bound": RECOVERY_MS_BOUND,
+    }
+    return fault["goodput_tokens_per_sec"], extra
+
+
 def bench_quant():
     """Quantized serving (ISSUE 9), three arms with regression gates:
 
@@ -1791,7 +1919,8 @@ def _run_mode(mode="train", backend=None):
                 "input": "input_pipeline_sharded_buffered_steps_per_sec",
                 "packing": "packing_effective_tokens_per_sec",
                 "generation": "generation_engine_tokens_per_sec",
-                "quant": "quant_generation_engine_tokens_per_sec"}\
+                "quant": "quant_generation_engine_tokens_per_sec",
+                "recovery": "recovery_goodput_tokens_per_sec"}\
         .get(mode, _HEADLINE)
     if mode == "input":
         # the input bench exercises the sharded fit path; on a CPU host
@@ -1968,6 +2097,55 @@ def _run_mode(mode="train", backend=None):
                   extra={"error": str(e)[:300]})
         return
 
+    if mode == "recovery":
+        try:
+            tps, extra = _with_retries(bench_recovery)
+            _emit(headline, tps, "tokens/sec", extra=extra)
+            f = extra["fault"]
+            if f["resolved"] != extra["requests"]:
+                sys.stderr.write(
+                    f"REGRESSION: only {f['resolved']}/"
+                    f"{extra['requests']} requests resolved across the "
+                    f"injected engine death — the supervisor must "
+                    f"replay every queued and live request "
+                    f"({f['resolve_errors']})\n")
+            if not extra["token_identical_fault_vs_clean"]:
+                sys.stderr.write(
+                    "REGRESSION: greedy output differs between the "
+                    "fault arm and the fault-free arm — replay must be "
+                    "exactly-once (continuations re-derive the same "
+                    "tokens)\n")
+            if f["restarts"] != 1:
+                sys.stderr.write(
+                    f"REGRESSION: {f['restarts']} restarts for ONE "
+                    f"injected fault — expected exactly 1\n")
+            if (f["recovery_ms"] is None
+                    or f["recovery_ms"] > extra["recovery_ms_bound"]):
+                sys.stderr.write(
+                    f"REGRESSION: recovery took {f['recovery_ms']}ms "
+                    f"(bound {extra['recovery_ms_bound']}ms) — restart "
+                    f"must be pool-rebuild + replay, not recompilation\n")
+            if extra["goodput_ratio_fault_vs_clean"] < 0.7:
+                sys.stderr.write(
+                    f"REGRESSION: fault-arm goodput is only "
+                    f"{extra['goodput_ratio_fault_vs_clean']}x the "
+                    f"fault-free arm — below the 0.7x floor\n")
+            if f["new_compiles_after_start"]:
+                sys.stderr.write(
+                    "REGRESSION: the compile ledger moved after the "
+                    "restart — a resurrected engine must re-warm from "
+                    "the shared program pack with zero new traces\n")
+            if f["pages_in_use"] != 0:
+                sys.stderr.write(
+                    f"REGRESSION: {f['pages_in_use']} KV pages still "
+                    f"allocated after the recovery arm drained — the "
+                    f"replay path is leaking pages\n")
+        except Exception as e:  # noqa: BLE001
+            traceback.print_exc()
+            _emit(headline, 0.0, "tokens/sec",
+                  extra={"error": str(e)[:300]})
+        return
+
     if mode == "quant":
         try:
             tps, extra = _with_retries(bench_quant)
@@ -2104,7 +2282,8 @@ if __name__ == "__main__":
     import argparse
     ap = argparse.ArgumentParser(description=__doc__)
     ap.add_argument("--mode", choices=("train", "serving", "input",
-                                       "packing", "generation", "quant"),
+                                       "packing", "generation", "quant",
+                                       "recovery"),
                     default="train",
                     help="train: the round training configs (default); "
                          "serving: multi-lane InferenceEngine qps/latency/"
@@ -2132,7 +2311,14 @@ if __name__ == "__main__":
                          "Predictor parity + quantized-artifact engine "
                          "qps, and int8-vs-fp32 KV pools at equal HBM "
                          "bytes (1.9x admits, 1.5x tokens/sec, "
-                         "exactly-once ledgers)")
+                         "exactly-once ledgers); recovery: supervised "
+                         "engine resurrection under load — one injected "
+                         "decode-step fault mid-run; gates: all "
+                         "requests resolve token-identical to the "
+                         "fault-free arm, exactly one restart, bounded "
+                         "recovery wall, goodput >= 0.7x fault-free, "
+                         "zero new compiles after restart "
+                         "(ledger-proven), zero leaked pages")
     ap.add_argument("--backend", default=None,
                     help="pin the jax platform (cpu/tpu/gpu) — same effect "
                          "as JAX_PLATFORMS but works under launchers that "

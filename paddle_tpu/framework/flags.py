@@ -171,6 +171,54 @@ register_flag("FLAGS_gen_audit_log", "",
               "admit/defer/evict/expire/poison decision appends one "
               "reason-coded line to this path; '' keeps the bounded "
               "in-memory ring only")
+register_flag("FLAGS_failpoints", "",
+              "deterministic fault-injection spec (serving/failpoints.py): "
+              "';'-separated `site@trigger[:arg]` terms where trigger is "
+              "`N` (fire on the Nth hit only) or `every:K` (every Kth "
+              "hit) and arg is a site-specific number (slow_step_ms "
+              "sleep). Sites: decode_step_raise, prefill_raise, "
+              "decode_poison_nan, alloc_exhaust, slow_step_ms. '' "
+              "disables injection entirely (the zero-cost no-op path)")
+register_flag("FLAGS_gen_retry_limit", 2,
+              "serving.EngineSupervisor: per-request replay budget — a "
+              "request may survive at most this many engine restarts "
+              "before it fails with a typed UnavailableError "
+              "(audit code RETRY_EXHAUSTED)")
+register_flag("FLAGS_gen_restart_backoff_ms", 100.0,
+              "serving.EngineSupervisor base backoff between consecutive "
+              "engine deaths (doubles per consecutive death, capped at "
+              "32x; also the serving lane-restart base backoff)")
+register_flag("FLAGS_gen_breaker_threshold", 5,
+              "serving.EngineSupervisor crash-storm circuit breaker: "
+              "this many engine deaths inside "
+              "FLAGS_gen_breaker_window_s opens the breaker — the "
+              "supervisor stays down, /readyz reports 503 with the "
+              "breaker reason, and pending work fails typed "
+              "(audit code BREAKER_OPEN)")
+register_flag("FLAGS_gen_breaker_window_s", 30.0,
+              "rolling window the crash-storm breaker counts engine "
+              "deaths over (see FLAGS_gen_breaker_threshold)")
+register_flag("FLAGS_gen_poison_degrade_k", 0,
+              "serving.GenerationEngine degraded mode: this many poison "
+              "events (non-finite logits) inside "
+              "FLAGS_gen_degraded_window_s flips speculative decoding "
+              "OFF for the engine (audit code DEGRADED_SPEC_OFF; the "
+              "plain decode program is pre-warmed so the flip mints no "
+              "compile). 0 disables the detector; snapshotted at "
+              "engine construction")
+register_flag("FLAGS_gen_exhaust_clamp_k", 0,
+              "serving.GenerationEngine degraded mode: this many "
+              "page-blocked admission iterations inside "
+              "FLAGS_gen_degraded_window_s clamps admission — new "
+              "submits that cannot be covered by the pool RIGHT NOW "
+              "fail fast with ResourceExhaustedError instead of "
+              "queueing toward a timeout (audit code "
+              "DEGRADED_ADMIT_CLAMP; clears on the next successful "
+              "admission). 0 disables; snapshotted at construction")
+register_flag("FLAGS_gen_degraded_window_s", 60.0,
+              "rolling window both degraded-mode detectors "
+              "(FLAGS_gen_poison_degrade_k / "
+              "FLAGS_gen_exhaust_clamp_k) count events over")
 register_flag("FLAGS_slo_ttft_p99_ms", 0.0,
               "SLO objective: generative time-to-first-token p99 target "
               "in ms — at most 1% of requests in a window may exceed it "
@@ -248,6 +296,15 @@ register_flag("FLAGS_serving_request_timeout_ms", 30000.0,
               "enforced while queued AND again at completion — a request "
               "that expired while its batch was on-device fails with "
               "ExecutionTimeoutError, never a late result (0 disables)")
+register_flag("FLAGS_serving_lane_restarts", 0,
+              "serving.InferenceEngine: how many CONSECUTIVE times a "
+              "dead dispatch lane is rebuilt in place (fresh threads, "
+              "same replica/device) with exponential backoff "
+              "(FLAGS_gen_restart_backoff_ms base) before it stays "
+              "permanently out of rotation; deaths separated by more "
+              "than FLAGS_gen_breaker_window_s reset the budget and "
+              "the backoff. 0 keeps the legacy behavior: lane death "
+              "permanently shrinks capacity")
 register_flag("FLAGS_trace_ring_size", 16384,
               "profiler.tracer: per-thread trace event ring capacity; the "
               "ring overwrites its oldest events instead of growing, so "

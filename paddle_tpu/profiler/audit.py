@@ -67,6 +67,23 @@ REASONS = frozenset({
     "EVICT_SHUTDOWN_QUEUED",  # queued (never admitted) request dropped
                               # by shutdown(drain=False)
     "ENGINE_DIED",         # stranded by engine death (step-loop error)
+    "ENGINE_RESTART",      # supervisor rebuilt the engine after a death
+                           # (ISSUE 15; detail: incarnation, backoff)
+    "REPLAY_ADMIT",        # crash-manifest request re-enqueued on the
+                           # rebuilt engine (continuation or scratch)
+    "RETRY_EXHAUSTED",     # request failed typed: its replay budget
+                           # (FLAGS_gen_retry_limit) ran out
+    "REPLAY_IMPOSSIBLE",   # request failed typed: no exactly-once
+                           # replay exists (sampled stream whose
+                           # continuation exceeds the prefill buckets)
+                           # — no retry-limit tuning can fix this
+    "BREAKER_OPEN",        # crash-storm circuit breaker opened — the
+                           # supervisor stays down (/readyz 503)
+    "DEGRADED_SPEC_OFF",   # poison storm flipped speculation off for
+                           # this engine (FLAGS_gen_poison_degrade_k)
+    "DEGRADED_ADMIT_CLAMP",  # repeated allocator exhaustion clamped
+                             # admission: uncoverable submits now fail
+                             # fast (FLAGS_gen_exhaust_clamp_k)
 })
 
 _CAP = 2048   # per-engine ring bound (≈ a few minutes of decisions)

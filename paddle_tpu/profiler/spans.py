@@ -217,15 +217,19 @@ class GenSpan:
     multi-token steps from plain decode."""
 
     __slots__ = ("rid", "engine", "slot", "stamps", "prefix_tokens",
-                 "spec_tokens")
+                 "spec_tokens", "incarnation")
 
-    def __init__(self, engine: str):
+    def __init__(self, engine: str, incarnation: int = 0):
         self.rid = next(_next_id)
         self.engine = engine
         self.slot: Optional[int] = None
         self.stamps = {}
         self.prefix_tokens = 0
         self.spec_tokens = 0
+        # which engine generation served this request (ISSUE 15 — a
+        # supervised restart bumps it); rides the reqspan as `inc=` so
+        # offline reports split pre- from post-restart requests
+        self.incarnation = int(incarnation)
 
     def stamp(self, phase: str, t: Optional[float] = None) -> None:
         self.stamps[phase] = time.perf_counter() if t is None else t
@@ -267,7 +271,8 @@ class GenSpan:
         tracer.instant(
             f"reqspan:{self.rid}:{self.engine}:slot{self.slot}:"
             f"n={n_tokens}:ttft={ttft:.3f},tpot={tpot:.3f},e={e2e:.3f},"
-            f"pfx={self.prefix_tokens},acc={self.spec_tokens}",
+            f"pfx={self.prefix_tokens},acc={self.spec_tokens},"
+            f"inc={self.incarnation}",
             t=s.get("resolved", last))
 
     def to_dict(self) -> dict:
@@ -278,12 +283,12 @@ class GenSpan:
                 if "queued" in self.stamps else None}
 
 
-def start_gen(engine: str) -> Optional[GenSpan]:
+def start_gen(engine: str, incarnation: int = 0) -> Optional[GenSpan]:
     """GenSpan for one accepted generative request (None when spans are
     off — same FLAGS_serving_spans gate as the serving pipeline)."""
     if not enabled():
         return None
-    span = GenSpan(engine)
+    span = GenSpan(engine, incarnation)
     span.stamp("queued")
     span.flow("s")
     return span

@@ -73,7 +73,12 @@ _FIELDS = ("it", "step", "t", "live", "admitted", "completed", "expired",
            "poisoned", "aborted", "freed", "queue_depth", "oldest_age_ms",
            "pages_in_use", "free_pages", "prefix_tokens", "cow_splits",
            "prefill_ms", "decode_ms", "tokens", "spec_drafted",
-           "spec_accepted", "prefill_chunks")
+           "spec_accepted", "prefill_chunks",
+           # ISSUE 15: which engine GENERATION (supervised-restart
+           # ordinal) recorded this iteration — appended after the
+           # older fields so ring consumers reading by name with
+           # defaults parse records from every era unchanged
+           "incarnation")
 
 
 def enabled() -> bool:

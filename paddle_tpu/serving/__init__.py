@@ -27,6 +27,12 @@ pre-compiled bucketed shapes).
 - **observability** — `framework.monitor` STAT counters (global +
   per-lane `STAT_serving_lane*`) + streaming latency and in-flight-depth
   histograms, `profiler.RecordEvent` scopes.
+- **fault tolerance (ISSUE 15)** — `EngineSupervisor` resurrects a dead
+  `GenerationEngine` in place (crash-manifest request replay,
+  exactly-once streams, crash-storm breaker, degraded modes), dispatch
+  lanes restart per-slot (`FLAGS_serving_lane_restarts`), and
+  `failpoints` injects deterministic faults into every hardened seam
+  (`FLAGS_failpoints`).
 """
 from __future__ import annotations
 
@@ -38,13 +44,18 @@ class EngineOverloaded(ResourceExhaustedError):
     is full — explicit load-shedding backpressure, never silent growth."""
 
 
+from . import failpoints  # noqa: E402
 from .engine import EngineConfig, InferenceEngine  # noqa: E402
-from .generation import (GenerationConfig, GenerationEngine,  # noqa: E402
-                         TokenStream)
+from .generation import (CrashManifest, GenerationConfig,  # noqa: E402
+                         GenerationEngine, ReplayEntry, TokenStream)
 from .kv_cache import PagedKVCache  # noqa: E402
 from .prefix_cache import PrefixCache  # noqa: E402
+from .restart import CrashBreaker, RestartBackoff  # noqa: E402
 from .spec_decode import NGramProposer  # noqa: E402
+from .supervisor import EngineSupervisor  # noqa: E402
 
 __all__ = ["InferenceEngine", "EngineConfig", "EngineOverloaded",
+           "EngineSupervisor", "CrashBreaker", "CrashManifest",
            "GenerationEngine", "GenerationConfig", "NGramProposer",
-           "PagedKVCache", "PrefixCache", "TokenStream"]
+           "PagedKVCache", "PrefixCache", "ReplayEntry",
+           "RestartBackoff", "TokenStream", "failpoints"]

@@ -36,6 +36,7 @@ from ..framework.errors import (ExecutionTimeoutError, InvalidArgumentError,
 from ..framework.flags import flag
 from ..profiler import (RecordEvent, device_telemetry, exporter,
                         flight_recorder, spans)
+from .restart import RestartBackoff
 
 __all__ = ["EngineConfig", "InferenceEngine"]
 
@@ -131,6 +132,13 @@ class _Lane:
         self.device = device
         self.alive = True
         self.death_cause: Optional[BaseException] = None
+        self.restarts = 0           # times this lane slot was rebuilt
+        self.will_restart = False   # restart RESERVED in _die's locked
+        #                             section, so the collector never
+        #                             sees all-dead with a rebuild
+        #                             still unannounced
+        self.quiet_death = False    # previous death was > a quiet
+        #                             window ago: budget+backoff reset
         self.inflight = 0           # routed batches not yet resolved (engine._cv)
         self.batches = 0            # completed device batches (engine._stats_lock)
         self.rows = 0
@@ -318,7 +326,7 @@ class _Lane:
                 f"{self.engine.name}: request expired after "
                 f"{t_ms - req.t_enqueue_ms:.1f}ms (deadline passed while "
                 f"the batch was in flight)"))
-        except Exception:  # racing caller-side cancel
+        except Exception:  # lint: allow(except-pass): racing caller-side cancel — the future is already settled, the timeout has nowhere to land
             pass
         return True
 
@@ -344,7 +352,7 @@ class _Lane:
                 monitor.stat_add("STAT_serving_request_errors")
                 try:
                     reqs[0].future.set_exception(err)
-                except Exception:
+                except Exception:  # lint: allow(except-pass): racing caller-side cancel — the future is already settled
                     pass
                 return
             # poisoned batch: isolate — each request reruns alone so the
@@ -406,7 +414,7 @@ class _Lane:
                 continue  # abandoned span: phase hists mean DELIVERED work
             try:
                 req.future.set_result(res)
-            except Exception:  # racing caller-side cancel
+            except Exception:  # lint: allow(except-pass): racing caller-side cancel — the future is already settled, the result has nowhere to land
                 pass
             else:
                 if req.span is not None:
@@ -444,7 +452,7 @@ class _Lane:
         for req in reqs:
             try:
                 req.future.set_exception(err)
-            except Exception:
+            except Exception:  # lint: allow(except-pass): racing caller-side cancel — the future is already settled
                 pass
 
     def _drain_pending(self, span_sink=None) -> int:
@@ -479,6 +487,28 @@ class _Lane:
             self.alive = False
             if self.death_cause is None:
                 self.death_cause = exc
+            if first:
+                # reserve the restart UNDER the same lock that marks
+                # this lane dead: a collector waking on the notify
+                # below must never observe all-dead with zero pending
+                # rebuilds and wrongly close the engine (ISSUE 15)
+                limit = int(flag("FLAGS_serving_lane_restarts"))
+                if (limit > 0 and not eng._closed
+                        and eng._lanes[self.index] is self):
+                    backoff = eng._lane_backoffs.setdefault(
+                        self.index, RestartBackoff(
+                            float(flag("FLAGS_gen_restart_backoff_ms"))))
+                    # shared quiet-window policy (restart.py): a slot
+                    # that survived a full quiet window earns its base
+                    # backoff AND its restart budget back — the budget
+                    # check must see that verdict, or a long-lived
+                    # lane's occasional transients exhaust it forever
+                    self.quiet_death = backoff.note_death(
+                        float(flag("FLAGS_gen_breaker_window_s")))
+                    used = 0 if self.quiet_death else self.restarts
+                    if used < limit:
+                        self.will_restart = True
+                        eng._restarting += 1
             while True:  # puts happen under _cv, so this drain is consistent
                 try:
                     item = self.inbox.get_nowait()
@@ -529,8 +559,15 @@ class _Lane:
                 "error": repr(exc), "dropped_batches": dropped,
                 "lane_batches_completed": self.batches,
                 "lane_rows_completed": self.rows,
+                "lane_restarts": self.restarts,
                 "inflight_spans": [r.span.to_dict() for r in died_reqs
                                    if r.span is not None][:64]})
+            # per-lane resurrection (ISSUE 15): with
+            # FLAGS_serving_lane_restarts > 0, rebuild this lane slot
+            # in place (fresh threads, same replica/device) so a
+            # transient fault no longer permanently shrinks capacity —
+            # runs on the dying thread, AFTER its own work is failed
+            eng._maybe_restart_lane(self)
 
 
 class InferenceEngine:
@@ -592,6 +629,12 @@ class InferenceEngine:
         self._cv = threading.Condition()
         self._closed = False
         self._rr = 0
+        # lane resurrection (ISSUE 15): lanes mid-rebuild count here so
+        # the collector WAITS through an all-dead-but-restarting window
+        # instead of declaring the engine dead; one backoff policy per
+        # lane slot (a flapping lane escalates, its neighbors don't)
+        self._restarting = 0
+        self._lane_backoffs = {}
         # set once a multi-request batch proves the model's outputs can't
         # be sliced per request; later batches then skip the wasted
         # batched execution and go straight to per-request dispatch
@@ -832,7 +875,7 @@ class InferenceEngine:
                     req.future.set_exception(ExecutionTimeoutError(
                         f"{self.name}: request expired after "
                         f"{_now_ms() - req.t_enqueue_ms:.1f}ms in queue"))
-                except Exception:  # racing caller-side cancel
+                except Exception:  # lint: allow(except-pass): racing caller-side cancel — the future is already settled
                     pass
                 continue
             if req.future.cancelled():
@@ -891,15 +934,75 @@ class InferenceEngine:
                         break
             return batch
 
+    def _maybe_restart_lane(self, lane: _Lane) -> None:
+        """Rebuild one dead lane slot in place (ISSUE 15): fresh
+        dispatcher/completer threads around the SAME replica/device —
+        the replica's jit wrapper keeps its compiled executables, so a
+        restarted lane re-serves without a single new trace. Gated by
+        FLAGS_serving_lane_restarts (0 = legacy permanent death), with
+        per-slot exponential backoff (FLAGS_gen_restart_backoff_ms
+        base, the shared restart primitive); a lane that exhausts its
+        budget stays down, and all-lanes-down still closes the engine.
+        Runs on the dying lane's own thread, after `_die` failed that
+        lane's in-flight work."""
+        if not lane.will_restart:  # reservation made in _die's locked
+            return                 # section (or none: legacy death)
+        try:
+            # unblock the dead lane's surviving twin thread (the
+            # dispatcher when the completer died, and vice versa): a
+            # replaced lane's threads must exit, not leak blocked on
+            # queues nobody will ever drain
+            lane.inbox.put(None)
+            # the death (and its quiet-window verdict) was already
+            # noted on this slot's shared backoff in _die's reservation
+            delay = self._lane_backoffs[lane.index].next_delay_ms()
+            if delay:
+                time.sleep(delay / 1000.0)
+            fresh = _Lane(self, lane.index, lane.runner, lane.predictor,
+                          lane.device)
+            fresh.restarts = 1 if lane.quiet_death else lane.restarts + 1
+            # accounting continuity: the slot's compile ledger and
+            # throughput totals describe the (device, bucket) history,
+            # not one thread generation — carrying them forward keeps
+            # the exactly-once ledger exact (a callable lane's
+            # first-dispatch compile marker must not re-fire)
+            fresh.bucket_compiles = dict(lane.bucket_compiles)
+            fresh.batches = lane.batches
+            fresh.rows = lane.rows
+            # start BEFORE the swap: once the lane is visible in
+            # self._lanes, a racing shutdown() may Thread.join() it —
+            # joining a never-started thread raises out of shutdown
+            fresh.start()
+            with self._cv:
+                if self._closed:
+                    fresh.inbox.put(None)  # drain sentinel: the threads
+                    return                 # we just started exit clean
+                self._lanes[lane.index] = fresh
+            monitor.stat_add("STAT_serving_lane_restarts")
+        except BaseException as e:  # noqa: BLE001
+            # a failed rebuild (e.g. thread-start refusal under the
+            # very resource exhaustion that killed the lane) degrades
+            # to legacy permanent lane death — it must NOT propagate
+            # into the dying thread's death path, which still has its
+            # own exit sentinels to post
+            flight_recorder.dump("serving_lane_restart_failed", {
+                "engine": self.name, "lane": lane.index,
+                "error": repr(e)})
+        finally:
+            with self._cv:
+                self._restarting -= 1
+                self._cv.notify_all()
+
     def _wait_capacity(self) -> bool:
         """Block until some alive lane has a free in-flight slot — BEFORE
         claiming requests from the queue, so backpressure stays at the
         front door (submit sees true depth → EngineOverloaded) instead of
-        leaking into lane inboxes. False = every lane is dead."""
+        leaking into lane inboxes. False = every lane is dead (and none
+        is mid-restart)."""
         with self._cv:
             while True:
                 alive = [l for l in self._lanes if l.alive]
-                if not alive:
+                if not alive and self._restarting == 0:
                     return False
                 if any(l.inflight < self._cfg.max_inflight for l in alive):
                     return True
@@ -912,6 +1015,9 @@ class InferenceEngine:
             while True:
                 alive = [l for l in self._lanes if l.alive]
                 if not alive:
+                    if self._restarting:
+                        self._cv.wait()  # a lane is mid-rebuild: hold
+                        continue         # the batch for it
                     raise UnavailableError(
                         f"{self.name}: all {len(self._lanes)} dispatch "
                         f"lanes dead")
@@ -963,7 +1069,7 @@ class InferenceEngine:
                 try:
                     req.future.set_exception(UnavailableError(
                         f"{self.name}: collector died: {e!r}"))
-                except Exception:
+                except Exception:  # lint: allow(except-pass): racing caller-side cancel — the future is already settled
                     pass
             flight_recorder.dump("serving_collector_death", {
                 "engine": self.name, "error": repr(e),
@@ -1029,6 +1135,7 @@ class InferenceEngine:
                       "device": str(l.device) if l.device is not None
                       else None,
                       "alive": l.alive,
+                      "restarts": l.restarts,
                       "inflight": l.inflight} for l in self._lanes]
         with self._stats_lock:
             buckets = {b: dict(s) for b, s in self._bucket_stats.items()}
@@ -1104,7 +1211,7 @@ class InferenceEngine:
                     try:
                         req.future.set_exception(UnavailableError(
                             f"{self.name}: engine shut down"))
-                    except Exception:
+                    except Exception:  # lint: allow(except-pass): racing caller-side cancel — the future is already settled
                         pass
             self._cv.notify_all()
         # one deadline for the WHOLE shutdown: timeout_s bounds the caller's

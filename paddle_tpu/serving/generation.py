@@ -107,6 +107,7 @@ from ..framework.errors import (ExecutionTimeoutError, FatalError,
 from ..framework.flags import flag
 from ..profiler import (RecordEvent, audit, device_telemetry, exporter,
                         flight_recorder, slo, spans, step_log)
+from . import failpoints
 from .kv_cache import TRASH_PAGE, PagedKVCache
 from .prefix_cache import PrefixCache
 from .spec_decode import NGramProposer
@@ -117,7 +118,8 @@ from .spec_decode import NGramProposer
 # (monitor is the single registry of gauge names — ISSUE 11)
 monitor.register_gauge("STAT_gen_queue_depth", updown=True)
 
-__all__ = ["GenerationConfig", "GenerationEngine", "TokenStream"]
+__all__ = ["CrashManifest", "GenerationConfig", "GenerationEngine",
+           "ReplayEntry", "TokenStream"]
 
 
 def _now_ms() -> float:
@@ -261,7 +263,7 @@ class _GenRequest:
                  "span", "slot", "pt_row", "toks", "next_pos", "ordinal",
                  "defer_logged", "stream", "ttft_deadline_ms",
                  "prefix_tokens", "prefill_pos", "pending_digests",
-                 "spec_accepted")
+                 "spec_accepted", "claimed", "retries", "skip_stream")
 
     _ids = itertools.count(1)
 
@@ -292,6 +294,114 @@ class _GenRequest:
         #                                 prefill complete / not chunked)
         self.pending_digests = None     # prompt digests held across chunks
         self.spec_accepted = 0          # draft tokens accepted (ISSUE 14)
+        self.claimed = False            # future claimed running (_admit)
+        self.retries = 0                # supervised restarts survived
+        self.skip_stream = 0            # stream tokens to suppress on a
+        #                                 from-scratch greedy replay
+        #                                 (exactly-once across restarts)
+
+
+class ReplayEntry:
+    """One request's restartable state inside a `CrashManifest`
+    (ISSUE 15): the immutable submit parameters verbatim, the generated
+    prefix so a live slot replays as a prompt+generated continuation,
+    the preserved future/stream the caller still holds, and the
+    bookkeeping exactly-once replay needs (`delivered` streamed tokens,
+    `claimed` future state, the `retries` budget already spent)."""
+
+    __slots__ = ("rid", "ordinal", "prompt", "toks", "max_new", "eos",
+                 "do_sample", "temperature", "future", "stream",
+                 "deadline_ms", "ttft_deadline_ms", "t_enqueue_ms",
+                 "claimed", "retries", "delivered", "queued")
+
+    def __init__(self, req: "_GenRequest", queued: bool):
+        self.rid = req.rid
+        self.ordinal = req.ordinal
+        self.prompt = req.prompt
+        self.toks = list(req.toks)
+        self.max_new = req.max_new
+        self.eos = req.eos
+        self.do_sample = req.do_sample
+        self.temperature = req.temperature
+        self.future = req.future
+        self.stream = req.stream
+        self.deadline_ms = req.deadline_ms
+        self.ttft_deadline_ms = req.ttft_deadline_ms
+        self.t_enqueue_ms = req.t_enqueue_ms
+        self.claimed = req.claimed
+        self.retries = req.retries
+        # _die flushes the staged stream queue before the manifest is
+        # built, so every generated token was either delivered or —
+        # during a from-scratch replay — SUPPRESSED because an earlier
+        # incarnation already delivered it (skip_stream counts the
+        # suppressions still owed). Total tokens the CALLER has seen =
+        # generated here + still-owed suppressions; dropping the
+        # residual would re-deliver tokens if THIS replay dies too.
+        self.delivered = (len(req.toks) + req.skip_stream
+                          if req.stream is not None else 0)
+        self.queued = queued
+
+
+class CrashManifest:
+    """Everything `EngineSupervisor` needs to resurrect a dead engine
+    (ISSUE 15): the replayable requests in original admission order
+    (live slots first, then the still-queued tail), the fatal error,
+    the KV-pool postmortem snapshot, the compile ledger at death (the
+    zero-new-traces baseline the rebuilt engine is held to), and the
+    degraded-mode state that must survive the restart."""
+
+    __slots__ = ("engine", "incarnation", "error", "entries",
+                 "degraded_spec_off", "kv", "compiles")
+
+    def __init__(self, engine: str, incarnation: int,
+                 error: BaseException, entries: List[ReplayEntry],
+                 degraded_spec_off: bool, kv: dict, compiles: dict):
+        self.engine = engine
+        self.incarnation = incarnation
+        self.error = error
+        self.entries = entries
+        self.degraded_spec_off = degraded_spec_off
+        self.kv = kv
+        self.compiles = compiles
+
+    def summary(self) -> dict:
+        """Flight-dump payload: counts + per-entry state, no futures."""
+        return {
+            "engine": self.engine, "incarnation": self.incarnation,
+            "error": repr(self.error),
+            "entries": [{"rid": e.rid, "queued": e.queued,
+                         "generated": len(e.toks),
+                         "delivered": e.delivered,
+                         "stream": e.stream is not None,
+                         "retries": e.retries}
+                        for e in self.entries],
+            "degraded_spec_off": self.degraded_spec_off,
+            "kv": self.kv, "compiles": dict(self.compiles)}
+
+
+class _ProgramPack:
+    """The engine's jitted program set + its exactly-once compile
+    ledger, shareable across supervised-restart incarnations
+    (ISSUE 15). `jax.jit` caches compiled executables on the WRAPPER
+    object, so a rebuilt engine that reuses the same wrappers (same
+    config, same model → identical signatures) re-warms entirely from
+    cache: zero new in-process traces, and because the ledger dict is
+    owned here — not by any one engine — the shared count proves it."""
+
+    __slots__ = ("ledger", "prefill", "tail", "decode", "verify",
+                 "zero", "cow", "npool", "W")
+
+    def __init__(self, ledger, prefill, tail, decode, verify, zero, cow,
+                 npool, W):
+        self.ledger = ledger
+        self.prefill = prefill
+        self.tail = tail
+        self.decode = decode
+        self.verify = verify
+        self.zero = zero
+        self.cow = cow
+        self.npool = npool
+        self.W = W
 
 
 class GenerationEngine:
@@ -324,7 +434,9 @@ class GenerationEngine:
 
     def __init__(self, model, config: Optional[GenerationConfig] = None,
                  name: str = "generation", device=None,
-                 metrics_port: Optional[int] = None, **overrides):
+                 metrics_port: Optional[int] = None,
+                 incarnation: int = 0, on_death=None, _carryover=None,
+                 **overrides):
         if config is None:
             config = GenerationConfig(**overrides)
         elif overrides:
@@ -334,6 +446,17 @@ class GenerationEngine:
         import copy
         self._cfg = copy.copy(config)
         self.name = name
+        # supervised-restart seam (ISSUE 15, serving/supervisor.py):
+        # `incarnation` is this engine generation's ordinal (rides every
+        # step-ring record + reqspan so reports distinguish
+        # generations); `on_death` — when set — makes _die hand a
+        # CrashManifest to the supervisor instead of stranding work,
+        # and the supervisor (not this engine) owns the exporter
+        # registration; `_carryover` passes the previous incarnation's
+        # program pack + step/audit rings + degraded state forward
+        self.incarnation = int(incarnation)
+        self._on_death = on_death
+        carry = _carryover or {}
         from ..models.gpt import GPTForCausalLM
         if not isinstance(model, GPTForCausalLM):
             raise InvalidArgumentError(
@@ -341,7 +464,10 @@ class GenerationEngine:
                 f"(got {type(model).__name__})")
         self._model = model
         mcfg = model.gpt.config
-        self._W = model.decode_weights()  # raises for MoE
+        pack: Optional[_ProgramPack] = carry.get("pack")
+        # raises for MoE; a resurrection reuses the pack's exact weight
+        # pytree so the rebuilt programs see identical leaves
+        self._W = pack.W if pack is not None else model.decode_weights()
         self._H = mcfg.num_heads
         self._D = mcfg.hidden_size // mcfg.num_heads
         self._scale = 1.0 / self._D ** 0.5
@@ -423,13 +549,30 @@ class GenerationEngine:
         self._pre_step_hook = None     # test seam: runs on the step thread
         self._hist = monitor.histogram(f"{name}_request_ms")
         self._base_key = None          # PRNGKey, built lazily on first use
+        # degraded modes (ISSUE 15): detector knobs snapshotted at
+        # construction (a runtime flag flip must not flip speculation
+        # onto an un-warmed program); the spec-off verdict itself rides
+        # the crash manifest so a restart stays degraded
+        self._poison_degrade_k = int(flag("FLAGS_gen_poison_degrade_k"))
+        self._exhaust_clamp_k = int(flag("FLAGS_gen_exhaust_clamp_k"))
+        self._degraded_window_s = float(flag("FLAGS_gen_degraded_window_s"))
+        self._degraded_spec_off = bool(carry.get("degraded_spec_off"))
+        self._poison_times: deque = deque()
+        self._exhaust_times: deque = deque()
+        self._admit_clamped = False
         # scheduler X-ray (ISSUE 11): decision audit ring (always on —
         # one deque append per decision) + per-iteration step ring
         # (FLAGS_gen_step_log; snapshot at construction so one engine's
-        # A/B arm can't half-enable the other's)
-        self._audit = audit.AuditLog(name)
-        self._step_log = (step_log.StepLog(name)
-                          if step_log.enabled() else None)
+        # A/B arm can't half-enable the other's). A resurrection reuses
+        # the previous incarnation's rings: the restart's own events
+        # land in the SAME postmortem trail as the death that caused it
+        self._audit = carry.get("audit") or audit.AuditLog(name)
+        self._step_log = carry.get("step_log") or (
+            step_log.StepLog(name) if step_log.enabled() else None)
+        if carry.get("step_log") is not None:
+            # re-register the carried ring: a failed rebuild attempt's
+            # error path unregisters it, and the retry must restore it
+            step_log.register(self._step_log)
         self._iters = 0
         self._it = {"admitted": 0, "completed": 0, "expired": 0,
                     "poisoned": 0, "aborted": 0, "freed": 0,
@@ -438,10 +581,15 @@ class GenerationEngine:
                     "prefill_chunks": 0,
                     "prefill_ms": 0.0, "decode_ms": 0.0}
 
-        self._build_programs()
+        self._build_programs(pack)
         flight_recorder.touch()
         device_telemetry.touch()
-        exporter.register_engine(self)
+        if self._on_death is None:
+            # supervised engines never register themselves: the
+            # SUPERVISOR is the stable /readyz + /stats entity across
+            # incarnations (a restarted engine re-registering would
+            # evict it from the exporter's name-keyed registry)
+            exporter.register_engine(self)
         try:
             if self._cfg.warmup:
                 self._warmup()
@@ -455,19 +603,13 @@ class GenerationEngine:
             self.metrics_server = exporter.start_metrics_server(
                 metrics_port)
         except Exception:
-            exporter.unregister_engine(self)
+            exporter.unregister_engine(self)  # identity-guarded no-op
+            #                                   for supervised engines
             if self._step_log is not None:
                 step_log.unregister(self._step_log)
             raise
 
     # -- jitted programs ---------------------------------------------------
-
-    def _note_trace(self, key: str):
-        # runs at TRACE time only (python side effect under jit), so the
-        # ledger counts compiles exactly — the same accounting trick as
-        # Predictor.compile_count
-        self._ledger[key] = self._ledger.get(key, 0) + 1
-        monitor.stat_add("STAT_gen_compiles")
 
     def _pools(self):
         """The donated device-pool tuple the jitted programs thread:
@@ -483,7 +625,23 @@ class GenerationEngine:
         else:
             self._kp, self._vp = pools
 
-    def _build_programs(self):
+    def _build_programs(self, pack: Optional[_ProgramPack] = None):
+        if pack is not None:
+            # resurrection path (ISSUE 15): adopt the previous
+            # incarnation's jit wrappers and SHARE its ledger dict —
+            # warmup re-runs against the jit caches (identical
+            # signatures), so the ledger not moving IS the
+            # zero-new-traces proof
+            self._ledger = pack.ledger
+            self._npool = pack.npool
+            self._prefill_jit = pack.prefill
+            self._tail_jit = pack.tail
+            self._decode_jit = pack.decode
+            self._verify_jit = pack.verify
+            self._zero_jit = pack.zero
+            self._cow_jit = pack.cow
+            self._pack = pack
+            return
         import jax
         import jax.numpy as jnp
 
@@ -504,7 +662,18 @@ class GenerationEngine:
         # the int8 mode's scale pools ride (and are donated) alongside
         # the pages so quantize-on-append updates both in place
         NP = self._npool = 4 if quant else 2
-        eng = self
+        # the trace-time closures capture the LEDGER and scalars, never
+        # the engine object: the pack outlives any one incarnation, and
+        # a closure pinning the dead engine would pin its pools too
+        ledger = self._ledger
+        max_position = self._max_position
+
+        def note(key: str):
+            # runs at TRACE time only (python side effect under jit),
+            # so the pack-owned ledger counts compiles exactly — the
+            # same accounting trick as Predictor.compile_count
+            ledger[key] = ledger.get(key, 0) + 1
+            monitor.stat_add("STAT_gen_compiles")
 
         def write_pages(pools, layer, page_ids, offs, k, v,
                         requant=False):
@@ -529,7 +698,7 @@ class GenerationEngine:
 
         def prefill_fn(W, *rest):
             pools, (pt_row, ids, length) = rest[:NP], rest[NP:]
-            eng._note_trace(f"prefill[b={ids.shape[1]}]")
+            note(f"prefill[b={ids.shape[1]}]")
             h, ks, vs = gpt_prefill(W, ids, num_heads=H, scale=scale)
             S_b = ids.shape[1]
             pos = jnp.arange(S_b)
@@ -558,7 +727,7 @@ class GenerationEngine:
             pad write). One compiled program per tail bucket."""
             pools = rest[:NP]
             pt_row, ids, length, offset = rest[NP:]
-            eng._note_trace(f"prefill_tail[b={ids.shape[1]}]")
+            note(f"prefill_tail[b={ids.shape[1]}]")
             S_b = ids.shape[1]
             ar = jnp.arange(S_b)
             valid = ar < length
@@ -602,7 +771,7 @@ class GenerationEngine:
             original."""
             pools = rest[:NP]
             src, dst = rest[NP], rest[NP + 1]
-            eng._note_trace("cow_copy")
+            note("cow_copy")
             if quant:
                 kp, vp, ksc, vsc = pools
                 return (kp.at[:, :, dst].set(kp[:, :, src]),
@@ -630,7 +799,7 @@ class GenerationEngine:
         def decode_fn(W, *rest):
             pools = rest[:NP]
             pt, tok, pos, active, temps, smask, key = rest[NP:]
-            eng._note_trace(f"decode[m={tok.shape[0]}]")
+            note(f"decode[m={tok.shape[0]}]")
             logits, (pools, _) = gpt_decode_step(
                 W, tok, pos, (pools, pt), write_kv, attend,
                 num_heads=H, scale=scale)
@@ -665,14 +834,14 @@ class GenerationEngine:
             pools = rest[:NP]
             pt, toks_blk, dmask, pos0, active, temps, smask, key = \
                 rest[NP:]
-            eng._note_trace(f"verify[k={toks_blk.shape[1] - 1}]")
+            note(f"verify[k={toks_blk.shape[1] - 1}]")
             M, K1 = toks_blk.shape
             # pad/overflow positions clamp into wpe range; their writes
             # are scratch-routed below regardless (the engine truncates
             # real drafts to the request's token budget, so every
             # CONSUMED position is in range by construction)
             positions = jnp.clip(pos0[:, None] + jnp.arange(K1)[None, :],
-                                 0, eng._max_position - 1)
+                                 0, max_position - 1)
 
             def ctx_attend(layer, q, k, v):
                 if quant:
@@ -756,6 +925,11 @@ class GenerationEngine:
         self._zero_jit = jax.jit(zero_fn,
                                  donate_argnums=tuple(range(NP)))
         self._cow_jit = jax.jit(cow_fn, donate_argnums=tuple(range(NP)))
+        self._pack = _ProgramPack(
+            ledger=self._ledger, prefill=self._prefill_jit,
+            tail=self._tail_jit, decode=self._decode_jit,
+            verify=self._verify_jit, zero=self._zero_jit,
+            cow=self._cow_jit, npool=self._npool, W=self._W)
 
     def _dev_ctx(self):
         import jax
@@ -831,6 +1005,17 @@ class GenerationEngine:
                 out = self._verify_call(self._W, *self._pools(), *args)
                 np.asarray(out[-2])
                 self._set_pools(out[:-3])
+                if self._poison_degrade_k or self._degraded_spec_off:
+                    # the poison-storm detector (ISSUE 15) may flip this
+                    # engine to the plain decode program mid-flight —
+                    # pre-warm it so the DEGRADED_SPEC_OFF flip mints no
+                    # runtime compile (the ledger then shows BOTH
+                    # verify[k] and decode[m], each exactly once)
+                    args = self._step_arrays()
+                    out = self._decode_call(self._W, *self._pools(),
+                                            *args)
+                    np.asarray(out[-2])
+                    self._set_pools(out[:-2])
             else:
                 args = self._step_arrays()
                 out = self._decode_call(self._W, *self._pools(), *args)
@@ -921,6 +1106,18 @@ class GenerationEngine:
                     f"pool holds {self._cache.usable_pages} "
                     f"(pages_per_seq={self._cache.pages_per_seq}); raise "
                     f"FLAGS_paged_num_pages or shrink the request")
+            if self._admit_clamped and not self._cache.can_admit(total):
+                # degraded admission clamp (ISSUE 15): the allocator
+                # has been exhausted repeatedly — a request the pool
+                # cannot cover RIGHT NOW would only queue toward a
+                # timeout, so shed it fast with a typed error
+                monitor.stat_add("STAT_gen_rejected")
+                raise ResourceExhaustedError(
+                    f"{self.name}: admission clamped after repeated "
+                    f"allocator exhaustion "
+                    f"(FLAGS_gen_exhaust_clamp_k) and the pool cannot "
+                    f"cover {total} tokens now; retry later or shrink "
+                    f"the request")
             t = _now_ms()
             tmo = (self._cfg.request_timeout_ms if timeout_ms is None
                    else float(timeout_ms))
@@ -939,7 +1136,8 @@ class GenerationEngine:
                         float(temperature),
                         stream.future if stream is not None else Future(),
                         None if not tmo else t + tmo, t,
-                        spans.start_gen(self.name),
+                        spans.start_gen(self.name,
+                                        incarnation=self.incarnation),
                         stream=stream,
                         ttft_deadline_ms=(t + ttft_tmo if ttft_tmo
                                           else None))
@@ -966,6 +1164,54 @@ class GenerationEngine:
     def generate(self, prompt_ids, **kw) -> np.ndarray:
         """Synchronous submit: blocks for this prompt's full sequence."""
         return self.submit(prompt_ids, **kw).result()
+
+    def replay_submit(self, entry: ReplayEntry, prompt: np.ndarray,
+                      max_new: int, skip_stream: int = 0) -> None:
+        """Re-enqueue a crash-manifest entry on THIS (rebuilt) engine
+        (ISSUE 15, the supervisor seam). The caller-held future and
+        stream are preserved verbatim; `prompt`/`max_new` are the
+        supervisor's continuation (prompt + generated-so-far, remaining
+        budget) or the original pair for a from-scratch replay, where
+        `skip_stream` suppresses re-delivery of already-streamed greedy
+        tokens. Deadlines carry over unchanged — a replay never buys a
+        request more time. Bypasses the queue-depth bound: the request
+        was admitted once already and must not be shed by the very
+        restart that interrupted it."""
+        prompt = np.asarray(prompt, np.int32)
+        with self._cv:
+            if self._closed:
+                raise UnavailableError(
+                    f"{self.name}: engine is shut down")
+            # the hard TTFT deadline applies to the FIRST token ever
+            # delivered, and an entry that generated anything met it in
+            # a previous incarnation — carrying the (likely elapsed)
+            # deadline onto the replay would expire a request the
+            # caller already saw streaming (the whole-request deadline
+            # still carries over unchanged)
+            ttft = (entry.ttft_deadline_ms
+                    if not entry.toks and not entry.delivered else None)
+            req = _GenRequest(
+                prompt, int(max_new), entry.eos, entry.do_sample,
+                entry.temperature, entry.future, entry.deadline_ms,
+                entry.t_enqueue_ms,
+                spans.start_gen(self.name,
+                                incarnation=self.incarnation),
+                stream=entry.stream,
+                ttft_deadline_ms=ttft)
+            req.claimed = entry.claimed
+            req.retries = entry.retries + 1
+            req.skip_stream = int(skip_stream)
+            self._req_seq += 1
+            req.ordinal = self._req_seq
+            self._queue.append(req)
+            monitor.stat_add("STAT_gen_queue_depth")
+            self._cv.notify_all()
+        monitor.stat_add("STAT_gen_replayed_requests")
+        self._audit.audit(
+            "REPLAY_ADMIT", rid=req.rid, orig_rid=entry.rid,
+            retries=req.retries, generated=len(entry.toks),
+            continuation=int(prompt.size) > int(entry.prompt.size),
+            skip_stream=int(skip_stream))
 
     # -- step loop ---------------------------------------------------------
 
@@ -1015,7 +1261,10 @@ class GenerationEngine:
                             # wait so queued deadlines still expire
                             self._cv.wait(0.01)
         except BaseException as e:  # noqa: BLE001 — never hang submitters
-            self._die(e)
+            if self._die(e):
+                return  # supervised: the death was handed over and
+                #         handled — no stderr traceback for a recovery
+                #         that worked
             raise
 
     def _record_iteration(self):
@@ -1058,16 +1307,20 @@ class GenerationEngine:
             spec_accepted=it["spec_accepted"],
             prefill_chunks=it["prefill_chunks"],
             prefill_ms=round(it["prefill_ms"], 3),
-            decode_ms=round(it["decode_ms"], 3))
+            decode_ms=round(it["decode_ms"], 3),
+            incarnation=self.incarnation)
         self._step_log.record(rec)
 
-    def _resolve_later(self, fut, result=None, exc=None):
+    def _resolve_later(self, req: Optional[_GenRequest], fut,
+                       result=None, exc=None):
         """Hold a future's resolution until after this iteration's
         _record_iteration(): a caller woken by result() must observe a
         step ring / audit tail that already includes its own outcome —
         resolving mid-iteration let a reader hit /steps before the
-        record landed and see counts that don't reconcile."""
-        self._resolve_q.append((fut, result, exc))
+        record landed and see counts that don't reconcile. `req` rides
+        along so _die can dedupe by rid: a request with a staged
+        outcome must never ALSO receive the death error."""
+        self._resolve_q.append((req, fut, result, exc))
 
     def _resolve_req_later(self, req: _GenRequest, result=None, exc=None):
         """Request-level resolution: the stream (when present) gets its
@@ -1077,12 +1330,20 @@ class GenerationEngine:
             self._stream_q.append((req.stream,
                                    exc if exc is not None
                                    else TokenStream._END))
-        self._resolve_later(req.future, result, exc)
+        self._resolve_later(req, req.future, result, exc)
 
     def _stage_token(self, req: _GenRequest, tok: int):
-        """Stage one decoded token for post-barrier stream delivery."""
-        if req.stream is not None:
-            self._stream_q.append((req.stream, tok))
+        """Stage one decoded token for post-barrier stream delivery.
+        A from-scratch greedy replay (ISSUE 15) suppresses the first
+        `skip_stream` tokens — they were already delivered by the
+        previous incarnation, and greedy re-derivation makes them
+        byte-identical, so suppression preserves exactly-once."""
+        if req.stream is None:
+            return
+        if req.skip_stream > 0:
+            req.skip_stream -= 1
+            return
+        self._stream_q.append((req.stream, tok))
 
     def _flush_resolutions(self):
         # streams first: a stream's final token / terminal marker must
@@ -1092,34 +1353,83 @@ class GenerationEngine:
         for stream, item in sq:
             stream._put(item)
         q, self._resolve_q = self._resolve_q, []
-        for fut, result, exc in q:
+        for _req, fut, result, exc in q:
             try:
                 if exc is not None:
                     fut.set_exception(exc)
                 else:
                     fut.set_result(result)
-            except Exception:  # racing caller-side cancel pre-admission
+            except Exception:  # lint: allow(except-pass): racing caller-side cancel pre-admission — the future is already settled, there is nothing left to deliver
                 pass
 
     def _die(self, e: BaseException):
+        # two INDEPENDENT try blocks: a ring-record failure on a
+        # half-broken engine must not also strand the staged
+        # resolutions (they carry real results/errors already decided)
         try:
             # flush whatever the dying iteration already counted, so
             # the dump's step_log_tail reconciles with the audit tail
             self._record_iteration()
+        except Exception:  # lint: allow(except-pass): best-effort ring record on a dying engine — the death path must keep going
+            pass
+        # settled BEFORE the flush: these requests already have an
+        # outcome staged this iteration — after the flush delivers it,
+        # the death error below must never reach them too (a request
+        # observing BOTH a result and the death error was the ISSUE 15
+        # resolution race)
+        settled = {req.rid for req, _f, _r, _e in self._resolve_q
+                   if req is not None}
+        try:
             self._flush_resolutions()
-        except Exception:
+        except Exception:  # lint: allow(except-pass): best-effort flush on a dying engine — per-future failures are already guarded inside
             pass
         stranded = []
         with self._cv:
             self._closed = True
             self._death = e
             while self._queue:
-                stranded.append(self._queue.popleft())
+                req = self._queue.popleft()
                 monitor.stat_sub("STAT_gen_queue_depth")
+                if req.rid not in settled:
+                    stranded.append(req)
             self._cv.notify_all()
+        active = [r for r in self._slots
+                  if r is not None and r.rid not in settled]
+        if self._on_death is not None:
+            # supervised (ISSUE 15): hand the queued + live work to the
+            # supervisor as a crash manifest instead of stranding it —
+            # the supervisor rebuilds the engine and replays
+            manifest = CrashManifest(
+                engine=self.name, incarnation=self.incarnation,
+                error=e,
+                entries=([ReplayEntry(r, queued=False)
+                          for r in sorted(active,
+                                          key=lambda r: r.ordinal)]
+                         + [ReplayEntry(r, queued=True)
+                            for r in stranded]),
+                degraded_spec_off=self._degraded_spec_off,
+                kv=self._cache.manifest(), compiles=dict(self._ledger))
+            self._audit.flush_sink()
+            flight_recorder.dump("gen_engine_death", {
+                "engine": self.name, "error": repr(e),
+                "supervised": True,
+                "manifest": manifest.summary(),
+                "inflight_spans": [r.span.to_dict() for r in active
+                                   if r.span is not None][:64],
+                "step_log_tail": (self._step_log.tail(32)
+                                  if self._step_log is not None else []),
+                "audit_tail": self._audit.tail(64)})
+            try:
+                self._on_death(manifest)
+                return True
+            except Exception as sup_e:  # supervisor itself failed:
+                #                         fall through and strand typed
+                #                         rather than hang the callers
+                e = RuntimeError(
+                    f"supervisor failed during restart: {sup_e!r} "
+                    f"(original death: {e!r})")
         err = UnavailableError(f"{self.name}: generation engine died: "
                                f"{e!r}")
-        active = [r for r in self._slots if r is not None]
         for req in active + stranded:
             if req.stream is not None:
                 # direct put (no barrier): the step loop is dead, no
@@ -1127,7 +1437,7 @@ class GenerationEngine:
                 req.stream._put(err)
             try:
                 req.future.set_exception(err)
-            except Exception:
+            except Exception:  # lint: allow(except-pass): racing caller-side cancel — the future is already settled
                 pass
             self._audit.audit("ENGINE_DIED", rid=req.rid,
                               error=repr(e))
@@ -1144,6 +1454,7 @@ class GenerationEngine:
             "step_log_tail": (self._step_log.tail(32)
                               if self._step_log is not None else []),
             "audit_tail": self._audit.tail(64)})
+        return False
 
     # -- admission ---------------------------------------------------------
 
@@ -1197,8 +1508,18 @@ class GenerationEngine:
                     # reclaim the very pages this admission maps
                     self._cache.pin(hit_pages)
                 try:
-                    if fresh_needed > self._cache.reclaimable_pages:
+                    # alloc_exhaust failpoint: force the exhaustion
+                    # verdict without draining the pool — the DEFER /
+                    # clamp machinery downstream runs unchanged
+                    if (fresh_needed > self._cache.reclaimable_pages
+                            or failpoints.fire("alloc_exhaust")
+                            is not None):
                         monitor.stat_add("STAT_gen_admit_blocked")
+                        # every blocked ITERATION counts toward the
+                        # clamp detector (head-of-line blocking means
+                        # only the head defers — a per-request count
+                        # would see one event per episode)
+                        self._note_exhaust()
                         if "pages" not in req.defer_logged:
                             req.defer_logged.add("pages")
                             self._audit.audit(
@@ -1245,13 +1566,20 @@ class GenerationEngine:
                             return
                     self._queue.popleft()
                     monitor.stat_sub("STAT_gen_queue_depth")
-                    if not req.future.set_running_or_notify_cancel():
-                        self._audit.audit("CANCELLED", rid=req.rid)
-                        if req.stream is not None:
-                            from concurrent.futures import CancelledError
-                            self._stream_q.append(
-                                (req.stream, CancelledError()))
-                        continue
+                    if not req.claimed:
+                        # a REPLAYED request's future is already in the
+                        # RUNNING state from its first admission — a
+                        # second set_running_or_notify_cancel would
+                        # raise InvalidStateError (ISSUE 15)
+                        if not req.future.set_running_or_notify_cancel():
+                            self._audit.audit("CANCELLED", rid=req.rid)
+                            if req.stream is not None:
+                                from concurrent.futures import \
+                                    CancelledError
+                                self._stream_q.append(
+                                    (req.stream, CancelledError()))
+                            continue
+                        req.claimed = True
                     req.slot = slot
                     req.pt_row = self._cache.alloc_shared(
                         req.rid, total, hit_pages)
@@ -1274,6 +1602,11 @@ class GenerationEngine:
                 self._it["prefix_tokens"] += req.prefix_tokens
                 self._slots[slot] = req
                 self._it["admitted"] += 1
+                if self._admit_clamped:
+                    # the pool covered an admission again: the
+                    # exhaustion episode is over, lift the clamp
+                    self._admit_clamped = False
+                    self._exhaust_times.clear()
                 if matched:
                     self._audit.audit(
                         "ADMIT_PREFIX_HIT", rid=req.rid, slot=slot,
@@ -1360,6 +1693,8 @@ class GenerationEngine:
         already be consumed — touching them again (even to zero this
         request's pages) would dereference deleted buffers (same
         contract as a decode-step exception)."""
+        failpoints.maybe_raise("prefill_raise")  # engine-fatal, like a
+        #                                          real prefill jit error
         S = int(req.prompt.size)
         pfx = req.prefix_tokens
         tail = S - pfx
@@ -1392,12 +1727,70 @@ class GenerationEngine:
             return
         self._finish_prefill(req, lg, digests)
 
+    def _inject_poison(self, bad: np.ndarray) -> np.ndarray:
+        """`decode_poison_nan` failpoint: mark the first live slot's
+        logits non-finite host-side — the exact verdict the decode
+        program's in-graph isfinite check would have returned, so the
+        whole poison-isolation path downstream is exercised unchanged."""
+        bad = np.array(bad, copy=True)
+        for i, r in enumerate(self._slots):
+            if r is not None and r.prefill_pos is None:
+                bad[i] = True
+                break
+        return bad
+
+    def _note_poison(self):
+        """Poison-storm detector (ISSUE 15): k poison events inside the
+        rolling window flip speculation OFF for this engine —
+        non-finite logits keep arriving, so stop spending verify-wide
+        commits on them and fall back to the (pre-warmed) one-token
+        decode program. The verdict survives restarts via the crash
+        manifest."""
+        if (not self._poison_degrade_k or not self._spec_k
+                or self._degraded_spec_off):
+            return
+        now = time.monotonic()
+        self._poison_times.append(now)
+        while (self._poison_times
+               and now - self._poison_times[0] > self._degraded_window_s):
+            self._poison_times.popleft()
+        if len(self._poison_times) >= self._poison_degrade_k:
+            self._degraded_spec_off = True
+            monitor.stat_add("STAT_gen_degraded_spec_off")
+            self._audit.audit(
+                "DEGRADED_SPEC_OFF",
+                poison_events=len(self._poison_times),
+                window_s=self._degraded_window_s)
+
+    def _note_exhaust(self):
+        """Admission-clamp detector (ISSUE 15): k page-blocked
+        admission iterations inside the rolling window clamp admission
+        — new submits the pool cannot cover RIGHT NOW fail fast with
+        ResourceExhaustedError instead of queueing toward a timeout.
+        Cleared by the next successful admission."""
+        if not self._exhaust_clamp_k or self._admit_clamped:
+            return
+        now = time.monotonic()
+        self._exhaust_times.append(now)
+        while (self._exhaust_times
+               and now - self._exhaust_times[0] > self._degraded_window_s):
+            self._exhaust_times.popleft()
+        if len(self._exhaust_times) >= self._exhaust_clamp_k:
+            self._admit_clamped = True
+            monitor.stat_add("STAT_gen_admit_clamped")
+            self._audit.audit(
+                "DEGRADED_ADMIT_CLAMP",
+                exhaust_events=len(self._exhaust_times),
+                window_s=self._degraded_window_s,
+                free_pages=self._cache.free_pages)
+
     def _poison_decode(self, req: _GenRequest, slot: int):
         """Non-finite decode/verify logits: only THIS sequence fails,
         its pages return zeroed (shared by the plain and speculative
         step paths — one poison diagnostic shape for both)."""
         monitor.stat_add("STAT_gen_poisoned")
         self._it["poisoned"] += 1
+        self._note_poison()
         self._audit.audit("POISON_DECODE", rid=req.rid, slot=slot,
                           generated=len(req.toks))
         slo.observe_request(self.name, ok=False)
@@ -1418,6 +1811,7 @@ class GenerationEngine:
         return zeroed."""
         monitor.stat_add("STAT_gen_poisoned")
         self._it["poisoned"] += 1
+        self._note_poison()
         self._audit.audit("POISON_PREFILL", rid=req.rid,
                           bucket=bucket)
         slo.observe_request(self.name, ok=False)
@@ -1484,6 +1878,7 @@ class GenerationEngine:
                 req = r
         if req is None:
             return
+        failpoints.maybe_raise("prefill_raise")
         S = int(req.prompt.size)
         take = min(self._cfg.prefill_chunk, S - req.prefill_pos)
         bucket = self._bucket_for(take)
@@ -1608,7 +2003,15 @@ class GenerationEngine:
         The np.asarray below is the step's only host sync."""
         if self._pre_step_hook is not None:
             self._pre_step_hook(self)
-        if self._spec_k:
+        # fault-injection seams (ISSUE 15, serving/failpoints.py): a
+        # slow step first (SLO exercises), then the engine-fatal raise
+        # — InjectedFault escapes to _loop exactly like a real decode
+        # jit exception (the pools-donated contract)
+        ms = failpoints.fire("slow_step_ms")
+        if ms:
+            time.sleep(ms / 1000.0)
+        failpoints.maybe_raise("decode_step_raise")
+        if self._spec_k and not self._degraded_spec_off:
             self._spec_step()
             return
         args = self._step_arrays()
@@ -1617,6 +2020,8 @@ class GenerationEngine:
             out = self._decode_call(self._W, *self._pools(), *args)
             nxt = np.asarray(out[-2])
             bad = np.asarray(out[-1])
+        if failpoints.fire("decode_poison_nan") is not None:
+            bad = self._inject_poison(bad)
         self._set_pools(out[:-2])
         self._it["decode_ms"] += _now_ms() - t0
         self._steps_total += 1
@@ -1660,6 +2065,8 @@ class GenerationEngine:
             n_acc = np.asarray(out[-3])
             nxt = np.asarray(out[-2])
             bad = np.asarray(out[-1])
+        if failpoints.fire("decode_poison_nan") is not None:
+            bad = self._inject_poison(bad)
         self._set_pools(out[:-3])
         self._it["decode_ms"] += _now_ms() - t0
         self._steps_total += 1
@@ -1734,9 +2141,15 @@ class GenerationEngine:
                     stream=req.stream is not None,
                     age_ms=round(t - req.t_enqueue_ms, 3))
                 slo.observe_request(self.name, ok=False)
-                if req.stream is not None and req.toks:
+                if (req.stream is not None and req.toks
+                        and req.skip_stream == 0):
                     # soft: pages freed now, stream closed normally,
-                    # future resolves with the partial sequence
+                    # future resolves with the partial sequence.
+                    # skip_stream > 0 (a from-scratch replay still
+                    # re-deriving tokens an earlier incarnation
+                    # delivered) takes the HARD path below instead —
+                    # resolving now would hand back FEWER generated
+                    # tokens than the caller already streamed
                     self._release(req)
                     self._resolve_req_later(req, result=np.concatenate(
                         [req.prompt, np.asarray(req.toks, np.int32)]))
@@ -1812,7 +2225,7 @@ class GenerationEngine:
                                     prefix_tokens=req.prefix_tokens,
                                     spec_tokens=req.spec_accepted)
                 return
-            self._resolve_later(req.future, exc=ExecutionTimeoutError(
+            self._resolve_later(req, req.future, exc=ExecutionTimeoutError(
                 f"{self.name}: request expired after "
                 f"{t_done - req.t_enqueue_ms:.1f}ms"))
             return
@@ -1904,6 +2317,15 @@ class GenerationEngine:
                     decode_tokens / max(1, steps), 4),
             },
             "prefill_chunks": self._chunks_total,
+            # fault tolerance (ISSUE 15): which engine generation this
+            # is, and whether a degraded mode is active
+            "incarnation": self.incarnation,
+            "degraded": {
+                "spec_off": self._degraded_spec_off,
+                "admit_clamped": self._admit_clamped,
+                "poison_degrade_k": self._poison_degrade_k,
+                "exhaust_clamp_k": self._exhaust_clamp_k,
+            },
             "step_log": {
                 "enabled": self._step_log is not None,
                 "recorded": (self._step_log.recorded
@@ -1995,7 +2417,7 @@ class GenerationEngine:
                         # barrier to honor, nothing was recorded
                     try:
                         req.future.set_exception(err)
-                    except Exception:
+                    except Exception:  # lint: allow(except-pass): racing caller-side cancel — the future is already settled
                         pass
             self._cv.notify_all()
         for req in dropped:

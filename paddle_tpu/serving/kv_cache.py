@@ -445,3 +445,12 @@ class PagedKVCache:
             "evictable_pages": self.evictable_pages,
             "reclaimable_pages": self.reclaimable_pages,
         }
+
+    def manifest(self) -> dict:
+        """Crash-manifest snapshot (ISSUE 15): pool stats plus the
+        ownership and refcount maps, captured at engine death so the
+        flight dump records exactly which sequences held which pages
+        when the pools were lost — the rebuilt engine starts from a
+        FRESH pool, so this is the only record of the dead layout."""
+        return {"stats": self.stats(), "owners": self.owners(),
+                "refcounts": self.refcounts()}

@@ -137,6 +137,18 @@ def test_audit_reasons_corpus():
         assert not any(code in f.message for f in fs)
 
 
+def test_except_pass_corpus():
+    fs = run_fixture("except_pass", ["except-pass"])
+    _bad_only(fs, "except-pass")
+    # both seeded forms flagged: the typed handler and the bare except
+    assert len(fs) == 2
+    assert any("except Exception" in f.message for f in fs)
+    assert any("bare except" in f.message for f in fs)
+    # the subtree scope holds: pkg/other.py sits OUTSIDE serving/ and
+    # carries the same pattern — never flagged
+    assert not any("other.py" in f.path for f in fs)
+
+
 def test_stats_doc_corpus():
     fs = run_fixture("stats_doc", ["stats-doc"])
     assert {f.rule for f in fs} == {"stats-doc"}

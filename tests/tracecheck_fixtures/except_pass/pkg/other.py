@@ -1,0 +1,9 @@
+"""Outside serving/: the rule stays silent — framework cleanup paths
+have their own trade-offs (this twin proves the subtree scoping)."""
+
+
+def teardown(resource):
+    try:
+        resource.release()
+    except Exception:
+        pass

@@ -1,0 +1,16 @@
+"""Seeded except-pass violations: silent handlers on serving failure
+paths with no written reason (typed AND bare except forms)."""
+
+
+def resolve(future, err):
+    try:
+        future.set_exception(err)
+    except Exception:  # BAD: swallowed with no reason
+        pass
+
+
+def cleanup(handle):
+    try:
+        handle.close()
+    except:  # noqa: E722  BAD: bare except, still silent
+        pass

@@ -1,0 +1,16 @@
+"""Good twins: a reasoned suppression, and a handler that actually
+handles (logging is handling — the rule only targets `pass` bodies)."""
+
+
+def resolve(future, err):
+    try:
+        future.set_exception(err)
+    except Exception:  # lint: allow(except-pass): racing caller-side cancel — the future is already settled
+        pass
+
+
+def cleanup(handle, log):
+    try:
+        handle.close()
+    except Exception as e:
+        log.append(repr(e))

@@ -16,11 +16,15 @@ prefix-cache hit tokens + copy-on-write splits (pfx/cow), tokens
 delivered + speculative drafts accepted + prefill chunks run
 (tok/acc/chk — ISSUE 14: tok > slots on a decode iteration is
 speculation paying off, chk interleaved with decode wall is chunked
-prefill protecting TPOT), and prefill-vs-decode wall — then the audit
-tail with reason codes (per request: ADMIT_PREFIX_HIT carries
-prefix_tokens, COW_SPLIT the split pages), so "why did this request
-wait/die" reads straight off the artifact. Records predating ISSUE 14
-parse unchanged: every field reads by name with a zero default.
+prefill protecting TPOT), the engine generation (`inc` — a supervised
+restart bumps the incarnation counter, ISSUE 15, so a ring spanning a
+death + resurrection reads as two generations with the
+ENGINE_RESTART/REPLAY_ADMIT audit events between them), and
+prefill-vs-decode wall — then the audit tail with reason codes (per
+request: ADMIT_PREFIX_HIT carries prefix_tokens, COW_SPLIT the split
+pages), so "why did this request wait/die" reads straight off the
+artifact. Records predating ISSUE 14/15 parse unchanged: every field
+reads by name with a zero default.
 
 `--json` emits the parsed + summarized structure for scripting.
 """
@@ -64,9 +68,15 @@ def summarize(records: List[dict]) -> dict:
                      "tokens", "spec_drafted", "spec_accepted",
                      "prefill_chunks")}
     decode_steps = sum(1 for r in records if r.get("decode_ms", 0) > 0)
+    # engine generations in the window (ISSUE 15): a supervised restart
+    # bumps `incarnation`, so >1 distinct value means the ring spans an
+    # engine death + resurrection (records predating the field read 0)
+    incarnations = sorted({r.get("incarnation", 0) for r in records})
     return {
         "iterations": len(records),
         "decode_steps": decode_steps,
+        "incarnations": incarnations,
+        "restarts_in_window": max(0, len(incarnations) - 1),
         **tot,
         # tokens delivered per decode step over the window. NOTE: the
         # numerator includes prefill FIRST tokens (the ring does not
@@ -127,6 +137,11 @@ def render(name: str, eng: dict, last: int = 0,
               f"{summ['peak_oldest_age_ms']}ms), peak pages "
               f"{summ['peak_pages_in_use']}, min free pages "
               f"{summ['min_free_pages']}", file=out)
+        if summ.get("restarts_in_window"):
+            print(f"   {summ['restarts_in_window']} engine "
+                  f"restart(s) in window — incarnations "
+                  f"{summ['incarnations']} (see ENGINE_RESTART / "
+                  f"REPLAY_ADMIT audit events)", file=out)
         if summ.get("prefix_tokens") or summ.get("cow_splits"):
             print(f"   prefix cache: {summ['prefix_tokens']} prompt "
                   f"tokens served from cached pages, "
@@ -141,14 +156,16 @@ def render(name: str, eng: dict, last: int = 0,
               f"{summ['spec_accepted']}/{summ['spec_drafted']} drafts "
               f"accepted, {summ['prefill_chunks']} prefill chunks)",
               file=out)
-        hdr = (f"   {'it':>6} {'step':>6} {'slots':<10} {'adm':>3} "
+        hdr = (f"   {'inc':>3} {'it':>6} {'step':>6} {'slots':<10} "
+               f"{'adm':>3} "
                f"{'done':>4} {'exp':>3} {'psn':>3} {'abt':>3} "
                f"{'queue':>5} {'age_ms':>8} {'pages':>5} {'free':>5} "
                f"{'pfx':>4} {'cow':>3} {'tok':>4} {'acc':>4} "
                f"{'chk':>3} {'prefill':>8} {'decode':>8}")
         print(hdr, file=out)
         for r in records:
-            print(f"   {r.get('it', 0):>6} {r.get('step', 0):>6} "
+            print(f"   {r.get('incarnation', 0):>3} "
+                  f"{r.get('it', 0):>6} {r.get('step', 0):>6} "
                   f"[{_bar(r.get('live', 0), peak_live)}] "
                   f"{r.get('admitted', 0):>3} "
                   f"{r.get('completed', 0):>4} "

@@ -20,13 +20,14 @@ The continuous-batching GenerationEngine emits a second, slot-flavored
 reqspan shape per resolved request (profiler/spans.py GenSpan):
 
     reqspan:<rid>:<engine>:slot<slot>:n=<tokens>:ttft=…,tpot=…,e=…
-                                                [,pfx=…][,acc=…]
+                                          [,pfx=…][,acc=…][,inc=…]
 
 with TTFT (queue + prefill to first token), TPOT (steady decode cadence
 per output token) and end-to-end milliseconds; `pfx` (ISSUE 12) counts
 prompt tokens served from the prefix cache, `acc` (ISSUE 14) the
-speculative draft tokens accepted — both optional, so traces from any
-era parse. Both shapes are parsed; whichever is present gets its own
+speculative draft tokens accepted, `inc` (ISSUE 15) the engine
+incarnation that resolved the request (>0 = served after a supervised
+restart) — all optional, so traces from any era parse. Both shapes are parsed; whichever is present gets its own
 report section (phase percentiles + top-N slowest, plus a
 tokens-per-step summary for generation spans).
 
@@ -50,7 +51,8 @@ _GENSPAN = re.compile(
     r"^reqspan:(?P<rid>\d+):(?P<engine>.*):slot(?P<slot>[^:]*):"
     r"n=(?P<n>\d+):"
     r"ttft=(?P<ttft>[0-9.]+),tpot=(?P<tpot>[0-9.]+),e=(?P<e>[0-9.]+)"
-    r"(?:,pfx=(?P<pfx>\d+))?(?:,acc=(?P<acc>\d+))?$")
+    r"(?:,pfx=(?P<pfx>\d+))?(?:,acc=(?P<acc>\d+))?"
+    r"(?:,inc=(?P<inc>\d+))?$")
 
 PHASES = (("queue", "q"), ("pad", "p"), ("device", "d"), ("resolve", "r"))
 GEN_PHASES = (("ttft", "ttft"), ("tpot", "tpot"))
@@ -99,6 +101,7 @@ def parse_gen_trace(path, events=None):
                     "slot": g["slot"], "n": int(g["n"]),
                     "pfx": int(g["pfx"] or 0),
                     "acc": int(g["acc"] or 0),
+                    "inc": int(g["inc"] or 0),
                     "ttft": float(g["ttft"]), "tpot": float(g["tpot"]),
                     "e": float(g["e"]), "ts_us": ev.get("ts", 0.0)})
     return out
@@ -169,6 +172,11 @@ def gen_report(gens, top=10):
             "spec_accepted_tokens": acc,
             "tokens_per_step": round(toks / (toks - acc), 3)
             if toks > acc else (1.0 if toks else 0.0),
+            # engine resurrection (ISSUE 15): requests resolved by a
+            # restarted incarnation (inc > 0) — the replayed/late share
+            "incarnations": sorted({g["inc"] for g in gens}),
+            "post_restart_requests": sum(1 for g in gens
+                                         if g["inc"] > 0),
             "slowest": sorted(gens, key=lambda g: -g["e"])[:top]}
 
 
@@ -182,6 +190,10 @@ def render_gen(rep, file=sys.stdout):
           f"tokens accepted across {rep['spec_accepted_requests']} "
           f"request(s) — {rep['tokens_per_step']} tokens/step",
           file=file)
+    if rep.get("post_restart_requests"):
+        print(f"engine resurrection: {rep['post_restart_requests']} "
+              f"request(s) resolved after a supervised restart "
+              f"(incarnations {rep['incarnations']})", file=file)
     print(f"\n{'phase':<10}{'p50(ms)':>10}{'p99(ms)':>10}"
           f"{'mean':>10}{'max':>10}", file=file)
     for label, _ in GEN_PHASES + (("e2e", "e"),):

@@ -3,10 +3,10 @@
 import time)."""
 from __future__ import annotations
 
-from . import (audit_reasons, flag_in_trace, flags_inventory,  # noqa: F401
-               gauge_discipline, lock_discipline, scatter_batch_dim,
-               stats_doc, use_after_donate)
+from . import (audit_reasons, except_pass, flag_in_trace,  # noqa: F401
+               flags_inventory, gauge_discipline, lock_discipline,
+               scatter_batch_dim, stats_doc, use_after_donate)
 
-__all__ = ["audit_reasons", "flag_in_trace", "flags_inventory",
-           "gauge_discipline", "lock_discipline", "scatter_batch_dim",
-           "stats_doc", "use_after_donate"]
+__all__ = ["audit_reasons", "except_pass", "flag_in_trace",
+           "flags_inventory", "gauge_discipline", "lock_discipline",
+           "scatter_batch_dim", "stats_doc", "use_after_donate"]

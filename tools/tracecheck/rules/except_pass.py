@@ -1,0 +1,54 @@
+"""except-pass: silent exception swallowing in the serving stack needs
+a written reason.
+
+Origin (ISSUE 15): the fault-tolerance layer lives or dies on failure
+paths actually FIRING — a `except ...: pass` that swallows the wrong
+exception turns an engine death, a stranded future or a leaked page
+into silence, which is exactly how resurrection bugs hide. The serving
+tree (`paddle_tpu/serving/**`) is where every such handler sits on a
+hardened path, so there the bar is explicit: a handler whose entire
+body is `pass` must carry a reasoned suppression
+
+    except Exception:  # lint: allow(except-pass): <why this is safe>
+        pass
+
+The legitimate cases (racing caller-side future cancels, best-effort
+flushes on a dying engine) are real — the rule does not ban the
+pattern, it bans the UNDOCUMENTED pattern. Outside `serving/` the rule
+stays silent: framework-level cleanup paths have different trade-offs
+and their own review history.
+"""
+from __future__ import annotations
+
+import ast
+import os
+from typing import List
+
+from ..core import Context, Finding, rule
+
+_SUBTREE = os.sep + "serving" + os.sep
+
+
+@rule("except-pass",
+      "an `except ...: pass` handler in paddle_tpu/serving/** "
+      "silently swallows errors on hardened failure paths — each one "
+      "needs a reasoned `# lint: allow(except-pass): <why>` "
+      "suppression")
+def check(ctx: Context) -> List[Finding]:
+    out: List[Finding] = []
+    for mod in ctx.modules:
+        if _SUBTREE not in mod.rel:
+            continue
+        for node in ast.walk(mod.tree):
+            if not isinstance(node, ast.ExceptHandler):
+                continue
+            if len(node.body) == 1 and isinstance(node.body[0], ast.Pass):
+                what = ("bare except" if node.type is None else
+                        f"except {ast.unparse(node.type)}")
+                out.append(Finding(
+                    "except-pass", mod.rel, node.lineno,
+                    f"`{what}: pass` swallows errors silently on a "
+                    f"serving failure path — say why that is safe "
+                    f"(`# lint: allow(except-pass): <reason>`) or "
+                    f"handle the error"))
+    return out
