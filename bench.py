@@ -1112,6 +1112,109 @@ def bench_recovery():
     return fault["goodput_tokens_per_sec"], extra
 
 
+def bench_coldstart():
+    """Warm start via the program store (ISSUE 16): time-to-first-
+    served-token for a fresh engine PROCESS-equivalent, three arms —
+    cold (empty store: every program traces + compiles, then writes
+    back), warm (the store the cold arm just populated: every covered
+    program deserializes, ledger-proven zero compiles), and store-off
+    (the greedy-parity baseline). Each arm constructs a brand-new
+    engine with brand-new jit wrappers, so an in-process warm arm
+    without the store WOULD pay the full compile bill — XLA's jit
+    cache keys on the wrapper object, making this an honest
+    cross-process proxy the subprocess test in
+    tests/test_program_store.py anchors for real. Gates: warm TTFST
+    >= 2x faster than cold, warm compile ledger empty (all covered
+    programs report `loaded`), greedy output token-identical across
+    all three arms."""
+    import shutil
+    import tempfile
+
+    import paddle_tpu as paddle
+    from paddle_tpu import device as pdevice
+    from paddle_tpu import serving
+    from paddle_tpu.models import GPTConfig, GPTForCausalLM
+
+    if _SMOKE:
+        HID, LAYERS, HEADS, VOCAB = 256, 3, 4, 1024
+        SLOTS, MAX_NEW, PROMPT = 4, 16, 16
+    else:
+        HID, LAYERS, HEADS, VOCAB = 768, 8, 12, 32000
+        SLOTS, MAX_NEW, PROMPT = 16, 32, 64
+    PAGE = 16
+
+    paddle.seed(0)
+    cfg = GPTConfig(vocab_size=VOCAB, hidden_size=HID, num_layers=LAYERS,
+                    num_heads=HEADS, intermediate_size=4 * HID,
+                    max_position_embeddings=PROMPT + MAX_NEW, dropout=0.0)
+    net = GPTForCausalLM(cfg)
+    net.eval()
+    rng = np.random.RandomState(0)
+    prompt = rng.randint(0, VOCAB, size=(PROMPT,)).astype("int64")
+    pages = SLOTS * -(-(PROMPT + MAX_NEW) // PAGE) + 1
+    # the CPU smoke rides the forced store: the shared device gate
+    # refuses serialized executables there (the PR 1 aliasing-drop
+    # class), and force is exactly the self-check-guarded override the
+    # store was built around
+    force = pdevice.serialization_unsafe_backend()
+    store = tempfile.mkdtemp(prefix="paddle_tpu_pack_store_")
+
+    def arm(label, store_dir):
+        """One fresh engine; returns (ttfst_s, tokens, stats). TTFST
+        counts EVERYTHING a cold replica pays before serving: engine
+        construction (warmup = compile or load) + queue + prefill +
+        first decoded token, via submit_stream."""
+        t0 = time.perf_counter()
+        eng = serving.GenerationEngine(
+            net, max_slots=SLOTS, page_size=PAGE, num_pages=pages,
+            prefill_buckets=(PROMPT,), max_new_tokens=MAX_NEW,
+            request_timeout_ms=0, program_store=store_dir,
+            program_store_force=force, name=f"coldstart_{label}")
+        stream = eng.submit_stream(prompt, max_new_tokens=MAX_NEW)
+        next(iter(stream))                    # first served token
+        ttfst = time.perf_counter() - t0
+        toks = np.asarray(stream.result(timeout=120))
+        s = eng.stats()
+        eng.shutdown()
+        return ttfst, toks, s
+
+    try:
+        ttfst_cold, toks_cold, s_cold = arm("cold", store)
+        ttfst_warm, toks_warm, s_warm = arm("warm", store)
+        ttfst_off, toks_off, s_off = arm("off", None)
+    finally:
+        shutil.rmtree(store, ignore_errors=True)
+
+    speedup = ttfst_cold / max(ttfst_warm, 1e-9)
+    extra = {
+        "ttfst_cold_s": round(ttfst_cold, 3),
+        "ttfst_warm_s": round(ttfst_warm, 3),
+        "ttfst_storeless_s": round(ttfst_off, 3),
+        "coldstart_speedup": round(speedup, 2),
+        # the exact loaded-vs-compiled ledgers, embedded (acceptance)
+        "ledger": {
+            "cold": {"compiles": s_cold["compiles"],
+                     "loaded": s_cold["loaded"],
+                     "programs": s_cold["programs"]},
+            "warm": {"compiles": s_warm["compiles"],
+                     "loaded": s_warm["loaded"],
+                     "programs": s_warm["programs"]},
+            "off": {"compiles": s_off["compiles"],
+                    "loaded": s_off["loaded"]},
+        },
+        "warm_zero_compiles": not s_warm["compiles"],
+        "warm_all_loaded": bool(s_warm["loaded"]) and all(
+            v == "loaded" for v in s_warm["programs"].values()),
+        "token_identical_warm_vs_off":
+            bool(np.array_equal(toks_warm, toks_off)),
+        "token_identical_cold_vs_off":
+            bool(np.array_equal(toks_cold, toks_off)),
+        "store_forced": bool(force),
+        "store_key": s_warm["program_store"]["key"],
+    }
+    return speedup, extra
+
+
 def bench_quant():
     """Quantized serving (ISSUE 9), three arms with regression gates:
 
@@ -1920,7 +2023,8 @@ def _run_mode(mode="train", backend=None):
                 "packing": "packing_effective_tokens_per_sec",
                 "generation": "generation_engine_tokens_per_sec",
                 "quant": "quant_generation_engine_tokens_per_sec",
-                "recovery": "recovery_goodput_tokens_per_sec"}\
+                "recovery": "recovery_goodput_tokens_per_sec",
+                "coldstart": "coldstart_ttfst_speedup_warm_vs_cold"}\
         .get(mode, _HEADLINE)
     if mode == "input":
         # the input bench exercises the sharded fit path; on a CPU host
@@ -2146,6 +2250,38 @@ def _run_mode(mode="train", backend=None):
                   extra={"error": str(e)[:300]})
         return
 
+    if mode == "coldstart":
+        try:
+            speedup, extra = _with_retries(bench_coldstart)
+            _emit(headline, speedup, "x ttfst cold/warm", extra=extra)
+            if extra["coldstart_speedup"] < 2.0:
+                sys.stderr.write(
+                    f"REGRESSION: warm start from the program store is "
+                    f"only {extra['coldstart_speedup']}x faster to the "
+                    f"first served token than a cold compile "
+                    f"({extra['ttfst_warm_s']}s vs "
+                    f"{extra['ttfst_cold_s']}s) — below the 2x "
+                    f"acceptance floor\n")
+            if not extra["warm_zero_compiles"] \
+                    or not extra["warm_all_loaded"]:
+                sys.stderr.write(
+                    f"REGRESSION: the warm arm's ledger "
+                    f"{extra['ledger']['warm']} is not all-`loaded` — "
+                    f"a key-matched store must cover every engine "
+                    f"program with zero XLA compiles\n")
+            if not extra["token_identical_warm_vs_off"] \
+                    or not extra["token_identical_cold_vs_off"]:
+                sys.stderr.write(
+                    "REGRESSION: greedy output differs store-on vs "
+                    "store-off — a deserialized program must be the "
+                    "same math as the live compile (the self-check + "
+                    "smoke probe exist to guarantee exactly this)\n")
+        except Exception as e:  # noqa: BLE001
+            traceback.print_exc()
+            _emit(headline, 0.0, "x ttfst cold/warm",
+                  extra={"error": str(e)[:300]})
+        return
+
     if mode == "quant":
         try:
             tps, extra = _with_retries(bench_quant)
@@ -2283,7 +2419,7 @@ if __name__ == "__main__":
     ap = argparse.ArgumentParser(description=__doc__)
     ap.add_argument("--mode", choices=("train", "serving", "input",
                                        "packing", "generation", "quant",
-                                       "recovery"),
+                                       "recovery", "coldstart"),
                     default="train",
                     help="train: the round training configs (default); "
                          "serving: multi-lane InferenceEngine qps/latency/"
@@ -2318,7 +2454,14 @@ if __name__ == "__main__":
                          "fault-free arm, exactly one restart, bounded "
                          "recovery wall, goodput >= 0.7x fault-free, "
                          "zero new compiles after restart "
-                         "(ledger-proven), zero leaked pages")
+                         "(ledger-proven), zero leaked pages; "
+                         "coldstart: warm start via the program store "
+                         "(ISSUE 16) — time-to-first-served-token for "
+                         "a fresh engine, cold (empty store) vs warm "
+                         "(populated store) vs store-off; gates: warm "
+                         ">= 2x faster TTFST, warm compile ledger empty "
+                         "(every covered program `loaded`), greedy "
+                         "output token-identical across the arms")
     ap.add_argument("--backend", default=None,
                     help="pin the jax platform (cpu/tpu/gpu) — same effect "
                          "as JAX_PLATFORMS but works under launchers that "

@@ -19,9 +19,18 @@ donated train step reads freed buffers and silently corrupts numerics
 (reproduced on jax 0.4.37 with the dp-sharded step — second process
 reading the cache diverges to ~1e18). TPU executables round-trip
 aliasing correctly; CPU callers who accept the risk can pass
-`enable_compilation_cache(force=True)`.
+`enable_compilation_cache(force=True)`, which now warns ONCE naming
+that corruption class instead of overriding silently.
+
+The same gate guards the serving program store
+(`serving/program_store.py`, ISSUE 16): both policies call
+`serialization_unsafe_backend()` here, so "is a deserialized
+executable trustworthy on this backend" has exactly one answer — the
+two refusals cannot drift apart. The store's root directory resolves
+through `program_store_dir()` (FLAGS_gen_program_store_dir).
 """
 import os as _os
+import warnings as _warnings
 
 from ..framework.place import (CPUPlace, CUDAPlace, TPUPlace, device_count,
                                get_device, is_compiled_with_cuda,
@@ -30,10 +39,12 @@ from ..framework.place import (CPUPlace, CUDAPlace, TPUPlace, device_count,
 __all__ = ["set_device", "get_device", "CPUPlace", "CUDAPlace", "TPUPlace",
            "device_count", "is_compiled_with_cuda", "is_compiled_with_tpu",
            "cuda", "enable_compilation_cache", "maybe_enable_compilation_cache",
-           "compilation_cache_dir"]
+           "compilation_cache_dir", "serialization_unsafe_backend",
+           "warn_forced_serialization", "program_store_dir"]
 
 _compile_cache_dir = None  # active dir once enable_compilation_cache ran
 _cache_decision_pending = False  # JAX_PLATFORMS unset: decide at 1st compile
+_force_warned = False  # one warning per process across BOTH policies
 
 
 def _cpu_backend() -> bool:
@@ -51,16 +62,50 @@ def _cpu_backend() -> bool:
         return True  # no backend at all — nothing to cache
 
 
+def serialization_unsafe_backend() -> bool:
+    """THE gate (ISSUE 16): True when executables deserialized on this
+    backend cannot be trusted to keep input/output buffer aliasing —
+    the PR 1 XLA:CPU corruption class, where a donated program read
+    from a serialized artifact silently reads freed buffers. Both the
+    persistent compilation cache (`enable_compilation_cache`) and the
+    serving program store (`serving/program_store.py`) consult this
+    single predicate, so the two refusal policies cannot drift."""
+    return _cpu_backend()
+
+
+def warn_forced_serialization(context: str) -> None:
+    """One warning per process when a caller overrides the CPU gate
+    (`force=True`) — names the PR 1 corruption class so the override
+    is never silent. Shared by the compilation cache and the program
+    store; whichever forces first emits it."""
+    global _force_warned
+    if _force_warned:
+        return
+    _force_warned = True
+    _warnings.warn(
+        f"{context}: forcing serialized-executable reuse on the CPU "
+        f"backend. XLA:CPU deserialized executables have dropped "
+        f"input/output donation aliasing on this stack (jax 0.4.37, "
+        f"the PR 1 corruption class: a donated program silently reads "
+        f"freed buffers and diverges ~1e18); every load therefore "
+        f"runs the donation-aliasing self-check and a numeric smoke "
+        f"probe, and falls back to live compile on any mismatch.",
+        RuntimeWarning, stacklevel=3)
+
+
 def enable_compilation_cache(path=None, force=False):
     """Point JAX's persistent compilation cache at `path` (defaults to
     FLAGS_xla_compilation_cache_dir). Returns the active directory, or
     None when the cache config is unsupported — or when the backend is
     CPU, where deserialized executables lose donation aliasing and give
-    wrong results (see module docstring); `force=True` overrides."""
+    wrong results (see module docstring); `force=True` overrides, with
+    a one-time warning naming that corruption class."""
     global _compile_cache_dir
     from ..framework.flags import flag
-    if not force and _cpu_backend():
-        return None
+    if serialization_unsafe_backend():
+        if not force:
+            return None
+        warn_forced_serialization("enable_compilation_cache(force=True)")
     d = _os.path.expanduser(path or flag("FLAGS_xla_compilation_cache_dir"))
     try:
         import jax
@@ -75,6 +120,16 @@ def enable_compilation_cache(path=None, force=False):
 def compilation_cache_dir():
     """Directory of the active persistent compile cache (None if off)."""
     return _compile_cache_dir
+
+
+def program_store_dir():
+    """Root directory configured for the serving program store
+    (FLAGS_gen_program_store_dir, expanded; None when unset = store
+    off). Resolution only — the CPU-soundness decision lives in
+    `serialization_unsafe_backend()`, applied by the store itself."""
+    from ..framework.flags import flag
+    d = str(flag("FLAGS_gen_program_store_dir") or "").strip()
+    return _os.path.expanduser(d) if d else None
 
 
 def maybe_enable_compilation_cache():

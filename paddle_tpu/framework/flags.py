@@ -154,6 +154,23 @@ register_flag("FLAGS_gen_prefix_cache_max_pages", 0,
               "EVICT_PREFIX_BUDGET) instead of waiting for an "
               "admission to run short of free pages. 0 = unbounded "
               "(evict-on-demand only, the ISSUE 12 behavior)")
+register_flag("FLAGS_gen_program_store_dir", "",
+              "serving.GenerationEngine: root directory of the on-disk "
+              "AOT executable store (serving/program_store.py) — warmup "
+              "loads serialized prefill/tail/decode/verify/cow programs "
+              "under a content key instead of tracing when the key "
+              "matches (miss compiles as today, then writes back), so a "
+              "fresh PROCESS warm-starts in seconds. Empty = off. "
+              "Refused on the CPU backend (the PR 1 aliasing-drop "
+              "corruption class, device.serialization_unsafe_backend) "
+              "unless FLAGS_gen_program_store_force")
+register_flag("FLAGS_gen_program_store_force", False,
+              "serving.GenerationEngine: use the program store even on "
+              "a backend where device.serialization_unsafe_backend() "
+              "is True (XLA:CPU) — emits the one-time PR 1 corruption-"
+              "class warning; every load still runs the donation-"
+              "aliasing self-check + numeric smoke probe and falls "
+              "back to live compile on any mismatch")
 register_flag("FLAGS_gen_step_log", True,
               "serving.GenerationEngine: record one compact scheduler "
               "record per engine iteration into the bounded per-engine "

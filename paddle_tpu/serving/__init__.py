@@ -33,6 +33,12 @@ pre-compiled bucketed shapes).
   lanes restart per-slot (`FLAGS_serving_lane_restarts`), and
   `failpoints` injects deterministic faults into every hardened seam
   (`FLAGS_failpoints`).
+- **warm start (ISSUE 16)** — `ProgramStore`: a keyed on-disk AOT
+  executable store; `GenerationEngine` warmup loads serialized
+  prefill/tail/decode/verify/cow programs under a content key instead
+  of tracing (miss → compile + write back), every load gated by a
+  donation-aliasing self-check + numeric smoke probe, refused on
+  XLA:CPU (the PR 1 corruption class) unless forced.
 """
 from __future__ import annotations
 
@@ -50,6 +56,7 @@ from .generation import (CrashManifest, GenerationConfig,  # noqa: E402
                          GenerationEngine, ReplayEntry, TokenStream)
 from .kv_cache import PagedKVCache  # noqa: E402
 from .prefix_cache import PrefixCache  # noqa: E402
+from .program_store import ProgramStore  # noqa: E402
 from .restart import CrashBreaker, RestartBackoff  # noqa: E402
 from .spec_decode import NGramProposer  # noqa: E402
 from .supervisor import EngineSupervisor  # noqa: E402
@@ -57,5 +64,5 @@ from .supervisor import EngineSupervisor  # noqa: E402
 __all__ = ["InferenceEngine", "EngineConfig", "EngineOverloaded",
            "EngineSupervisor", "CrashBreaker", "CrashManifest",
            "GenerationEngine", "GenerationConfig", "NGramProposer",
-           "PagedKVCache", "PrefixCache", "ReplayEntry",
+           "PagedKVCache", "PrefixCache", "ProgramStore", "ReplayEntry",
            "RestartBackoff", "TokenStream", "failpoints"]

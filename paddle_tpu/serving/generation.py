@@ -145,6 +145,8 @@ class GenerationConfig:
                  spec_k: Optional[int] = None,
                  spec_ngram: Optional[int] = None,
                  prefill_chunk: Optional[int] = None,
+                 program_store: Optional[str] = None,
+                 program_store_force: Optional[bool] = None,
                  top_k: int = 0, seed: int = 0, warmup: bool = True):
         self.max_slots = int(flag("FLAGS_gen_max_slots")
                              if max_slots is None else max_slots)
@@ -203,6 +205,19 @@ class GenerationConfig:
         if self.prefill_chunk < 0:
             raise InvalidArgumentError(
                 "prefill_chunk must be >= 0 (0 = whole-prompt prefill)")
+        # warm start (ISSUE 16): root of the on-disk AOT executable
+        # store; None/"" = off (device.program_store_dir resolves the
+        # flag default). force engages the store even where
+        # device.serialization_unsafe_backend() refuses it (XLA:CPU —
+        # the PR 1 aliasing-drop corruption class, warned once)
+        if program_store is None:
+            from .. import device as _device
+            self.program_store = _device.program_store_dir()
+        else:
+            self.program_store = str(program_store) or None
+        self.program_store_force = bool(
+            flag("FLAGS_gen_program_store_force")
+            if program_store_force is None else program_store_force)
         self.top_k = int(top_k)
         self.seed = int(seed)
         self.warmup = bool(warmup)
@@ -386,14 +401,24 @@ class _ProgramPack:
     object, so a rebuilt engine that reuses the same wrappers (same
     config, same model → identical signatures) re-warms entirely from
     cache: zero new in-process traces, and because the ledger dict is
-    owned here — not by any one engine — the shared count proves it."""
+    owned here — not by any one engine — the shared count proves it.
 
-    __slots__ = ("ledger", "prefill", "tail", "decode", "verify",
-                 "zero", "cow", "npool", "W")
+    ISSUE 16 adds the cross-PROCESS half: `execs` maps program name
+    (the ledger's own keys) → the AOT `jax.stages.Compiled` the engine
+    resolved at warmup — store-loaded OR live-compiled-and-written-back
+    — and `loaded` counts the store loads the way `ledger` counts
+    traces. A resurrection adopts both, so a supervised rebuild of a
+    store-started engine still performs zero traces AND zero disk
+    loads."""
+
+    __slots__ = ("ledger", "loaded", "execs", "prefill", "tail",
+                 "decode", "verify", "zero", "cow", "npool", "W")
 
     def __init__(self, ledger, prefill, tail, decode, verify, zero, cow,
-                 npool, W):
+                 npool, W, loaded=None, execs=None):
         self.ledger = ledger
+        self.loaded = {} if loaded is None else loaded
+        self.execs = {} if execs is None else execs
         self.prefill = prefill
         self.tail = tail
         self.decode = decode
@@ -640,6 +665,14 @@ class GenerationEngine:
             self._verify_jit = pack.verify
             self._zero_jit = pack.zero
             self._cow_jit = pack.cow
+            # ISSUE 16: adopt the resolved AOT executables + the load
+            # ledger too — a resurrection of a store-started engine
+            # re-warms through `execs` directly: zero traces AND zero
+            # disk loads (rebuilds prefer the pack, the pack prefers
+            # the store)
+            self._execs = pack.execs
+            self._loaded = pack.loaded
+            self._store = None
             self._pack = pack
             return
         import jax
@@ -925,11 +958,68 @@ class GenerationEngine:
         self._zero_jit = jax.jit(zero_fn,
                                  donate_argnums=tuple(range(NP)))
         self._cow_jit = jax.jit(cow_fn, donate_argnums=tuple(range(NP)))
+        # warm start (ISSUE 16): resolved AOT executables by program
+        # name (ledger keys) + the store-load ledger; warmup fills them
+        self._execs = {}
+        self._loaded = {}
+        self._store = None
+        if self._cfg.program_store:
+            from .program_store import ProgramStore
+            self._store = ProgramStore(
+                self._cfg.program_store, self._store_key_material(),
+                force=self._cfg.program_store_force)
+            if self._store.refused:
+                self._store = None
         self._pack = _ProgramPack(
             ledger=self._ledger, prefill=self._prefill_jit,
             tail=self._tail_jit, decode=self._decode_jit,
             verify=self._verify_jit, zero=self._zero_jit,
-            cow=self._cow_jit, npool=self._npool, W=self._W)
+            cow=self._cow_jit, npool=self._npool, W=self._W,
+            loaded=self._loaded, execs=self._execs)
+
+    def _store_key_material(self) -> dict:
+        """Everything that shapes the traced programs, JSON-able — the
+        content key the store directories hang off. The decode-weight
+        pytree spec doubles as the quant-manifest digest (int8 leaves
+        + scale rows have their own dtypes/shapes); the FLAGS listed
+        are the kernel selections the compiled programs bake in."""
+        import jax
+        import jaxlib
+
+        from ..jit import pytree_spec
+        mcfg = self._model.gpt.config
+        dev = jax.devices()[0]
+        return {
+            "model": {k: v for k, v in sorted(vars(mcfg).items())},
+            "weights_spec": pytree_spec(self._W),
+            "engine": {
+                "max_slots": self._cfg.max_slots,
+                "page_size": self._cfg.page_size,
+                "num_pages": self._cfg.num_pages,
+                "pages_per_seq": self._cfg.pages_per_seq,
+                "prefill_buckets": list(self._cfg.prefill_buckets),
+                "kv_dtype": self._cache.dtype,
+                "quant_kv": bool(self._quant_kv),
+                "use_tail": bool(self._use_tail),
+                "prefix_cache": self._prefix is not None,
+                "spec_k": self._spec_k,
+                "top_k": self._cfg.top_k,
+            },
+            "jax": jax.__version__,
+            "jaxlib": jaxlib.__version__,
+            "backend": jax.default_backend(),
+            "device_kind": getattr(dev, "device_kind", "unknown"),
+            "device": str(self._device) if self._device is not None
+            else None,
+            "flags": {
+                "FLAGS_use_paged_attention":
+                    bool(flag("FLAGS_use_paged_attention")),
+                "FLAGS_paged_compute_block_pages":
+                    int(flag("FLAGS_paged_compute_block_pages")),
+                "FLAGS_flash_attention_interpret":
+                    bool(flag("FLAGS_flash_attention_interpret")),
+            },
+        }
 
     def _dev_ctx(self):
         import jax
@@ -937,17 +1027,26 @@ class GenerationEngine:
         return (jax.default_device(self._device)
                 if self._device is not None else contextlib.nullcontext())
 
+    def _prog(self, name, jit_fn):
+        """The program to run for `name`: the AOT executable warmup
+        resolved (store-loaded or live-compiled-and-written-back) when
+        present, else the jax.jit wrapper — the store-off path,
+        behaviorally identical (ISSUE 16)."""
+        return self._execs.get(name, jit_fn)
+
     def _decode_call(self, *args):
         """One jitted decode dispatch (seam: tests wrap this to inject
         per-slot failures)."""
         with self._dev_ctx():
-            return self._decode_jit(*args)
+            return self._prog(f"decode[m={self._cfg.max_slots}]",
+                              self._decode_jit)(*args)
 
     def _verify_call(self, *args):
         """One jitted speculative-verify dispatch (same test seam
         discipline as `_decode_call`)."""
         with self._dev_ctx():
-            return self._verify_jit(*args)
+            return self._prog(f"verify[k={self._spec_k}]",
+                              self._verify_jit)(*args)
 
     def _zero_pages(self, pages):
         # chunked to the fixed zero-scatter width: one sequence's free
@@ -962,15 +1061,116 @@ class GenerationEngine:
     def _cow_copy(self, src: int, dst: int):
         """Device-side CoW clone of one page (content + int8 scale row)."""
         with self._dev_ctx():
-            self._set_pools(self._cow_jit(*self._pools(), np.int32(src),
-                                          np.int32(dst)))
+            fn = self._prog("cow_copy", self._cow_jit)
+            self._set_pools(fn(*self._pools(), np.int32(src),
+                               np.int32(dst)))
+
+    # -- program-store warmup seam (ISSUE 16) ------------------------------
+
+    def _reset_pools(self):
+        """Rebuild zeroed device pools after a failed store probe
+        DONATED the live ones into a broken executable. Warmup-time
+        only: at that point the pools hold nothing but scratch-page
+        writes, so zeros are the correct state (shape/dtype metadata
+        survives buffer deletion)."""
+        import jax.numpy as jnp
+        self._kp = jnp.zeros(self._kp.shape, self._kp.dtype)
+        self._vp = jnp.zeros(self._vp.shape, self._vp.dtype)
+        if self._quant_kv:
+            self._ks = jnp.zeros(self._ks.shape, self._ks.dtype)
+            self._vs = jnp.zeros(self._vs.shape, self._vs.dtype)
+
+    def _selfcheck_alias(self, compiled, recorded: str):
+        """The PR 1 structural gate on a LOADED executable: its
+        input/output aliasing must match the spec the live compile
+        recorded at write time, and must not be empty — every covered
+        program donates its pools, so an executable that aliases
+        nothing is exactly the aliasing-drop corruption class (it
+        would read freed buffers at the second call). Returns an error
+        string, or None when the check passes."""
+        from ..jit import compiled_alias_spec
+        live = compiled_alias_spec(compiled)
+        if live != recorded:
+            return (f"alias spec mismatch: loaded={live!r} vs "
+                    f"recorded={recorded!r}")
+        if not live.strip():
+            return ("empty alias spec on a donating program — the "
+                    "PR 1 aliasing-drop corruption class")
+        return None
+
+    @staticmethod
+    def _probe_ok(name: str, out) -> bool:
+        """Numeric smoke verdict on one warmup execution of a loaded
+        executable: prefill-family programs must return finite logits,
+        decode/verify must not raise their in-graph poison flag;
+        cow_copy completing `block_until_ready` is the probe (it
+        returns only pools)."""
+        if name.startswith("prefill"):
+            return bool(np.all(np.isfinite(np.asarray(out[-1]))))
+        if name.startswith(("decode", "verify")):
+            return not bool(np.asarray(out[-1]).any())
+        return True
+
+    def _warm_one(self, name: str, jit_fn, args_fn):
+        """Resolve + execute one warmup program, preferring the store.
+
+        Hit → deserialize, run the donation-aliasing self-check, then
+        the numeric smoke probe (ONE scratch execution — the warmup
+        call itself); only then does the executable enter the pack and
+        `loaded[name]` count it. Any failure bumps
+        STAT_pack_selfcheck_failures, dumps a flight record, rebuilds
+        the (possibly donated-away) pools, and falls through to live
+        compile — a corrupt or stale entry costs a compile, never a
+        wrong answer. Miss with a store → AOT lower+compile (note()
+        fires at trace time, so the compile ledger counts it exactly
+        as before), execute, write back. No store → the jax.jit
+        wrapper traces on call: the pre-ISSUE-16 path, untouched."""
+        ex = self._execs.get(name)
+        if ex is not None:     # resurrection: the pack already resolved it
+            return ex(*args_fn())
+        if self._store is None:
+            return jit_fn(*args_fn())
+        hit = self._store.load(name)
+        if hit is not None:
+            import jax
+            compiled, recorded = hit
+            err = self._selfcheck_alias(compiled, recorded)
+            out = None
+            if err is None:
+                try:
+                    out = compiled(*args_fn())
+                    jax.block_until_ready(out)
+                    if not self._probe_ok(name, out):
+                        err = "numeric smoke probe failed"
+                except Exception as e:  # noqa: BLE001
+                    err = f"smoke probe raised: {e!r}"
+            if err is None:
+                self._execs[name] = compiled
+                self._loaded[name] = self._loaded.get(name, 0) + 1
+                return out
+            monitor.stat_add("STAT_pack_selfcheck_failures")
+            flight_recorder.dump(
+                "program_store_selfcheck",
+                extra={"engine": self.name, "program": name,
+                       "key": self._store.key, "error": err})
+            self._reset_pools()
+        compiled = jit_fn.lower(*args_fn()).compile()
+        self._execs[name] = compiled
+        self._store.store(name, compiled)
+        return compiled(*args_fn())
 
     def _warmup(self):
         """Compile every prefill bucket + the decode step (or, with
         speculation on, the ONE verify[k] program that replaces it) +
         the zeroing scatter up front: no live request pays a compile,
         and the ledger's exactly-once invariant is observable from step
-        one. Warmup writes land only in the reserved scratch page."""
+        one. Warmup writes land only in the reserved scratch page.
+
+        With a program store (ISSUE 16), every covered program resolves
+        through `_warm_one` instead: a key-matched store entry
+        deserializes (self-check + smoke probe gated) and the compile
+        ledger does not move — `loaded` counts it instead. A miss
+        AOT-compiles and writes back, so the NEXT process warm-starts."""
         M, PP = self._cfg.max_slots, self._cfg.pages_per_seq
         trash = np.zeros((PP,), np.int32)
         with RecordEvent("generation::warmup"):
@@ -978,8 +1178,10 @@ class GenerationEngine:
                 ids = np.zeros((1, b), np.int32)
                 with self._dev_ctx():
                     # lint: allow(use-after-donate): donate_argnums covers only the NP pool args riding in the *splat; trash sits AFTER them (position NP+1) and is never donated — reused read-only across warmup prefills
-                    out = self._prefill_jit(
-                        self._W, *self._pools(), trash, ids, np.int32(1))
+                    out = self._warm_one(
+                        f"prefill[b={b}]", self._prefill_jit,
+                        lambda: (self._W, *self._pools(), trash, ids,
+                                 np.int32(1)))
                 self._set_pools(out[:-1])
                 np.asarray(out[-1])
                 if self._use_tail:
@@ -990,19 +1192,28 @@ class GenerationEngine:
                     # from step one
                     with self._dev_ctx():
                         # lint: allow(use-after-donate): donate covers only the NP pool args in the *splat; trash/ids ride AFTER them (positions NP+1/NP+2), read-only across warmup prefills
-                        out = self._tail_jit(
-                            self._W, *self._pools(), trash, ids,  # lint: allow(use-after-donate): same — non-donated arg positions, reused read-only
-                            np.int32(1), np.int32(0))
+                        out = self._warm_one(
+                            f"prefill_tail[b={b}]", self._tail_jit,
+                            lambda: (self._W, *self._pools(), trash, ids,  # lint: allow(use-after-donate): same — non-donated arg positions, reused read-only
+                                     np.int32(1), np.int32(0)))
                     self._set_pools(out[:-1])
                     np.asarray(out[-1])
             if self._prefix is not None:
-                self._cow_copy(TRASH_PAGE, TRASH_PAGE)
+                with self._dev_ctx():
+                    out = self._warm_one(
+                        "cow_copy", self._cow_jit,
+                        lambda: (*self._pools(), np.int32(TRASH_PAGE),
+                                 np.int32(TRASH_PAGE)))
+                self._set_pools(out)
             if self._spec_k:
                 # speculation replaces the decode program outright: the
                 # engine's ledger shows ONE verify[k] trace and no
                 # decode entry at all (the acceptance-criteria shape)
-                args = self._spec_arrays()[0]
-                out = self._verify_call(self._W, *self._pools(), *args)
+                vargs = self._spec_arrays()[0]
+                with self._dev_ctx():
+                    out = self._warm_one(
+                        f"verify[k={self._spec_k}]", self._verify_jit,
+                        lambda: (self._W, *self._pools(), *vargs))
                 np.asarray(out[-2])
                 self._set_pools(out[:-3])
                 if self._poison_degrade_k or self._degraded_spec_off:
@@ -1011,14 +1222,19 @@ class GenerationEngine:
                     # pre-warm it so the DEGRADED_SPEC_OFF flip mints no
                     # runtime compile (the ledger then shows BOTH
                     # verify[k] and decode[m], each exactly once)
-                    args = self._step_arrays()
-                    out = self._decode_call(self._W, *self._pools(),
-                                            *args)
+                    dargs = self._step_arrays()
+                    with self._dev_ctx():
+                        out = self._warm_one(
+                            f"decode[m={M}]", self._decode_jit,
+                            lambda: (self._W, *self._pools(), *dargs))
                     np.asarray(out[-2])
                     self._set_pools(out[:-2])
             else:
-                args = self._step_arrays()
-                out = self._decode_call(self._W, *self._pools(), *args)
+                dargs = self._step_arrays()
+                with self._dev_ctx():
+                    out = self._warm_one(
+                        f"decode[m={M}]", self._decode_jit,
+                        lambda: (self._W, *self._pools(), *dargs))
                 np.asarray(out[-2])
                 self._set_pools(out[:-2])
             self._zero_pages([])
@@ -1705,7 +1921,8 @@ class GenerationEngine:
             ids[0, :tail] = req.prompt[pfx:]
             with RecordEvent(f"generation::prefill_tail[b={bucket}]"):
                 with self._dev_ctx():
-                    out = self._tail_jit(
+                    out = self._prog(f"prefill_tail[b={bucket}]",
+                                     self._tail_jit)(
                         self._W, *self._pools(), req.pt_row, ids,
                         np.int32(tail), np.int32(pfx))
                 self._set_pools(out[:-1])
@@ -1716,7 +1933,8 @@ class GenerationEngine:
             ids[0, :S] = req.prompt
             with RecordEvent(f"generation::prefill[b={bucket}]"):
                 with self._dev_ctx():
-                    out = self._prefill_jit(
+                    out = self._prog(f"prefill[b={bucket}]",
+                                     self._prefill_jit)(
                         self._W, *self._pools(), req.pt_row, ids,
                         np.int32(S))
                 self._set_pools(out[:-1])
@@ -1887,7 +2105,8 @@ class GenerationEngine:
         t0 = _now_ms()
         with RecordEvent(f"generation::prefill_chunk[b={bucket}]"):
             with self._dev_ctx():
-                out = self._tail_jit(
+                out = self._prog(f"prefill_tail[b={bucket}]",
+                                 self._tail_jit)(
                     self._W, *self._pools(), req.pt_row, ids,
                     np.int32(take), np.int32(req.prefill_pos))
             self._set_pools(out[:-1])
@@ -2286,15 +2505,33 @@ class GenerationEngine:
             slot_of = {r.rid: i for i, r in enumerate(self._slots)
                        if r is not None}
             ledger = dict(self._ledger)
+            loaded = dict(self._loaded)
             steps, prefills, tokens = (self._steps_total,
                                        self._prefills_total,
                                        self._tokens_total)
+        # warm start (ISSUE 16): per-program provenance — a store-
+        # covered program that deserialized reports "loaded" (its
+        # ledger entry never moved), a traced one reports "compiled";
+        # the acceptance criterion reads this mapping directly
+        programs = {name: ("loaded" if loaded.get(name)
+                           and not ledger.get(name) else "compiled")
+                    for name in set(ledger) | set(loaded)}
         return {
             "slots": slots,
             "queue_depth": depth,
             "pages": self._cache.stats(),
             "kv": self._kv_introspection(slot_of),
             "compiles": ledger,
+            "loaded": loaded,
+            "programs": programs,
+            "program_store": {
+                "configured": bool(self._cfg.program_store),
+                "active": self._store is not None,
+                "key": self._store.key if self._store is not None
+                else None,
+                "dir": self._store.key_dir if self._store is not None
+                else None,
+            },
             "steps": steps,
             "prefills": prefills,
             "tokens": tokens,

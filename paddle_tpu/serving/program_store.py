@@ -1,0 +1,182 @@
+"""Keyed on-disk AOT executable store for the generation engine
+(ISSUE 16): `_ProgramPack` survives a *process*.
+
+PR 14 made the engine's jitted program set (`_ProgramPack`) survive a
+supervised restart with zero new traces — but a new PROCESS still pays
+the full trace+lower+compile bill for every (bucket, program) at
+warmup, the autoscaling/fleet blocker ROADMAP names. This store
+persists every covered program — per-bucket `prefill[b=S]` /
+`prefill_tail[b=S]`, `decode[m=M]`, `verify[k=K]`, `cow_copy` — as a
+serialized XLA executable under a CONTENT KEY, so a cold process whose
+key matches warm-starts by deserializing instead of tracing.
+
+Layout (one directory per key under the configured root):
+
+    <root>/<key>/manifest.json       key material + per-program index
+    <root>/<key>/<program>.bin       pickled (payload, in_tree, out_tree)
+
+The key is `jit.key_material_digest` over everything that shapes the
+traced programs: model config, the decode-weight pytree spec (shapes/
+dtypes/paths — which IS the quant-manifest fingerprint: int8 leaves and
+scale rows land there), the engine knobs that shape traces (slots,
+page geometry, buckets, spec_k, top_k, tail/prefix wiring), jax/jaxlib
+versions, backend + device kind, and the kernel-selection FLAGS the
+programs bake in. Anything off by one bit → different key → clean miss,
+never a wrong executable.
+
+Trust model (the PR 1 lesson): a deserialized donated program is only
+usable if its input/output aliasing survived the round trip. On a
+backend where `device.serialization_unsafe_backend()` is True (XLA:CPU)
+the store REFUSES to engage — the same single gate the persistent
+compilation cache uses, so the two policies cannot drift — unless
+forced, which emits the one-time corruption-class warning. Forced or
+not, the ENGINE additionally runs a donation-aliasing self-check (the
+loaded executable's alias spec vs the manifest's recorded
+live-compiled spec) and a numeric smoke probe before any loaded
+program enters the pack; failures dump a flight record and fall back
+to live compile. Counters: STAT_pack_store_hits/_misses/_writes,
+STAT_pack_selfcheck_failures, and the `pack_load_ms` histogram.
+"""
+from __future__ import annotations
+
+import json
+import os
+import re
+import time
+from typing import Optional
+
+from ..framework import monitor
+
+__all__ = ["ProgramStore", "read_manifest"]
+
+_MANIFEST = "manifest.json"
+
+
+def _safe_name(program: str) -> str:
+    """`prefill[b=8]` → `prefill_b_8` — filesystem-safe, reversible
+    enough for humans (the manifest keeps the exact program name)."""
+    return re.sub(r"[^A-Za-z0-9_.-]+", "_", program).strip("_")
+
+
+def read_manifest(key_dir: str) -> Optional[dict]:
+    """The key directory's manifest dict, or None when absent or
+    unreadable (an unreadable manifest is a miss, never an error —
+    the store must not be able to fail an engine start)."""
+    path = os.path.join(key_dir, _MANIFEST)
+    try:
+        with open(path, "r", encoding="utf-8") as f:
+            return json.load(f)
+    except Exception:
+        return None
+
+
+class ProgramStore:
+    """One engine's view of the executable store: a resolved content
+    key + load/store over that key's directory.
+
+    All I/O is best-effort: a corrupt payload, a half-written file, or
+    a permissions error degrades to a MISS (the engine live-compiles,
+    exactly the store-off behavior) — the store can make a start
+    faster, never wrong and never failed."""
+
+    def __init__(self, root: str, key_material: dict, force: bool = False):
+        from .. import device as _device
+        from ..jit import key_material_digest
+        self.root = os.path.expanduser(str(root))
+        self.key = key_material_digest(key_material)
+        self.key_dir = os.path.join(self.root, self.key)
+        self._material = key_material
+        # THE gate (shared with enable_compilation_cache): deserialized
+        # executables on this backend drop donation aliasing — refuse
+        # entirely unless forced, and never silently when forced
+        self.refused = (_device.serialization_unsafe_backend()
+                        and not force)
+        if not self.refused and _device.serialization_unsafe_backend():
+            _device.warn_forced_serialization(
+                "ProgramStore(force=True)")
+        self._hist = monitor.histogram("pack_load_ms")
+
+    # -- read path ---------------------------------------------------------
+
+    def load(self, program: str):
+        """Deserialize `program` from this key's directory. Returns
+        (compiled, recorded_alias_spec) on a hit, None on miss/refusal.
+        The caller (engine warmup) owns the self-check + smoke probe —
+        a returned executable is NOT yet trusted."""
+        if self.refused:
+            return None
+        mf = read_manifest(self.key_dir)
+        entry = (mf or {}).get("programs", {}).get(program)
+        if entry is None:
+            monitor.stat_add("STAT_pack_store_misses")
+            return None
+        t0 = time.perf_counter()
+        try:
+            from ..jit import deserialize_compiled
+            with open(os.path.join(self.key_dir, entry["file"]),
+                      "rb") as f:
+                blob = f.read()
+            compiled = deserialize_compiled(blob)
+        except Exception:
+            # corrupt/truncated payload: a miss, not an error — the
+            # engine live-compiles and the next store() overwrites
+            monitor.stat_add("STAT_pack_store_misses")
+            return None
+        self._hist.observe((time.perf_counter() - t0) * 1000.0)
+        monitor.stat_add("STAT_pack_store_hits")
+        return compiled, str(entry.get("alias", ""))
+
+    # -- write path --------------------------------------------------------
+
+    def store(self, program: str, compiled) -> bool:
+        """Serialize a live-compiled executable under `program`,
+        recording its alias spec (the live compile's ground truth the
+        next process self-checks against). Atomic per file
+        (tmp+rename); the manifest is rewritten last so a reader never
+        sees an indexed-but-absent payload. Returns True on success."""
+        if self.refused:
+            return False
+        try:
+            from ..jit import compiled_alias_spec, serialize_compiled
+            blob = serialize_compiled(compiled)
+            alias = compiled_alias_spec(compiled)
+            os.makedirs(self.key_dir, exist_ok=True)
+            fname = _safe_name(program) + ".bin"
+            tmp = os.path.join(self.key_dir,
+                               f".{fname}.tmp.{os.getpid()}")
+            with open(tmp, "wb") as f:
+                f.write(blob)
+            os.replace(tmp, os.path.join(self.key_dir, fname))
+            mf = read_manifest(self.key_dir) or self._fresh_manifest()
+            mf.setdefault("programs", {})[program] = {
+                "file": fname, "bytes": len(blob), "alias": alias}
+            tmp = os.path.join(self.key_dir,
+                               f".{_MANIFEST}.tmp.{os.getpid()}")
+            with open(tmp, "w", encoding="utf-8") as f:
+                json.dump(mf, f, indent=1, sort_keys=True)
+            os.replace(tmp, os.path.join(self.key_dir, _MANIFEST))
+        except Exception:
+            return False
+        monitor.stat_add("STAT_pack_store_writes")
+        return True
+
+    def _fresh_manifest(self) -> dict:
+        import jax
+        import jaxlib
+        dev = jax.devices()[0]
+        return {
+            "key": self.key,
+            "key_material": self._material,
+            "jax": jax.__version__,
+            "jaxlib": jaxlib.__version__,
+            "backend": jax.default_backend(),
+            "device_kind": getattr(dev, "device_kind", "unknown"),
+            "programs": {},
+        }
+
+    # -- introspection (tools/pack_inspect.py) -----------------------------
+
+    def entries(self) -> dict:
+        """{program: {file, bytes, alias}} for this key (may be {})."""
+        mf = read_manifest(self.key_dir)
+        return dict((mf or {}).get("programs", {}))

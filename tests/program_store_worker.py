@@ -1,0 +1,69 @@
+"""Subprocess worker for tests/test_program_store.py: builds ONE
+GenerationEngine in a fresh process, serves two fixed greedy prompts,
+and writes a JSON report — the cold-process half of the warm-start
+acceptance test (a ledger asserted inside one process can't prove the
+store survives a process; this script can).
+
+    python tests/program_store_worker.py --out report.json \
+        [--store DIR] [--force] [--num-pages N]
+
+Model/prompt construction is fully deterministic (paddle.seed(11),
+RandomState(0)): two processes with the same argv produce the same
+weights, the same store key, and — warm or cold — must produce the
+same tokens.
+"""
+import argparse
+import json
+import os
+import sys
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np  # noqa: E402
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--out", required=True)
+    ap.add_argument("--store", default="")
+    ap.add_argument("--force", action="store_true")
+    ap.add_argument("--num-pages", type=int, default=64)
+    args = ap.parse_args()
+
+    import paddle_tpu as paddle
+    from paddle_tpu import serving
+    from paddle_tpu.models import GPTConfig, GPTForCausalLM
+
+    paddle.seed(11)
+    net = GPTForCausalLM(GPTConfig.tiny(dropout=0.0))
+    net.eval()
+    prompts = np.random.RandomState(0).randint(
+        0, 512, size=(2, 7)).astype("int64")
+
+    eng = serving.GenerationEngine(
+        net, max_slots=2, page_size=4, num_pages=args.num_pages,
+        prefill_buckets=(8,), max_new_tokens=5, request_timeout_ms=0,
+        program_store=args.store or None, program_store_force=args.force)
+    try:
+        outs = [f.result(timeout=300)
+                for f in [eng.submit(p, max_new_tokens=5)
+                          for p in prompts]]
+        stats = eng.stats()
+    finally:
+        eng.shutdown()
+
+    report = {
+        "outputs": [np.asarray(o).tolist() for o in outs],
+        "compiles": stats["compiles"],
+        "loaded": stats["loaded"],
+        "programs": stats["programs"],
+        "program_store": stats["program_store"],
+    }
+    with open(args.out, "w", encoding="utf-8") as f:
+        json.dump(report, f)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

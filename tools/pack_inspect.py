@@ -1,0 +1,132 @@
+#!/usr/bin/env python
+"""Inspect a serving program store (ISSUE 16, serving/program_store.py).
+
+    python tools/pack_inspect.py <store_root> [--verify] [--json]
+
+Lists every key directory under the store root: the content key, the
+jax/jaxlib versions and backend/device kind the artifacts were compiled
+on, and per program its payload file, size, and recorded donation-
+aliasing spec. `--verify` re-runs the structural half of the engine's
+load-time self-check OFFLINE: each payload is deserialized and its
+live alias spec compared against the manifest's recorded spec (and
+required non-empty — every covered program donates its pools, so an
+executable that aliases nothing is the PR 1 corruption class). Exit
+status: 0 = clean, 1 = any corrupt payload / alias mismatch / empty
+store, 2 = bad usage.
+
+Offline verification deserializes but never EXECUTES a program, so it
+is safe on any backend that can load the artifact — run it under the
+same JAX_PLATFORMS the store was built with.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, ROOT)
+
+
+def inspect_store(root: str, verify: bool = False) -> dict:
+    """{key: {manifest-ish summary + per-program rows}} for every key
+    directory that carries a readable manifest; `problems` collects
+    human-readable verification failures."""
+    from paddle_tpu.serving.program_store import read_manifest
+    report = {"root": root, "keys": {}, "problems": []}
+    if not os.path.isdir(root):
+        report["problems"].append(f"store root does not exist: {root}")
+        return report
+    for entry in sorted(os.listdir(root)):
+        key_dir = os.path.join(root, entry)
+        if not os.path.isdir(key_dir):
+            continue
+        mf = read_manifest(key_dir)
+        if mf is None:
+            report["problems"].append(
+                f"{entry}: key directory without a readable manifest")
+            continue
+        progs = {}
+        for name, rec in sorted(mf.get("programs", {}).items()):
+            path = os.path.join(key_dir, rec.get("file", ""))
+            row = {"file": rec.get("file"),
+                   "bytes": rec.get("bytes"),
+                   "alias": rec.get("alias", ""),
+                   "present": os.path.isfile(path)}
+            if not row["present"]:
+                report["problems"].append(
+                    f"{entry}/{name}: payload file missing")
+            elif verify:
+                err = _verify_one(path, row["alias"])
+                row["verified"] = err is None
+                if err is not None:
+                    report["problems"].append(f"{entry}/{name}: {err}")
+            progs[name] = row
+        if not progs:
+            report["problems"].append(f"{entry}: manifest lists no "
+                                      f"programs")
+        report["keys"][entry] = {
+            "jax": mf.get("jax"), "jaxlib": mf.get("jaxlib"),
+            "backend": mf.get("backend"),
+            "device_kind": mf.get("device_kind"),
+            "programs": progs,
+        }
+    if not report["keys"]:
+        report["problems"].append("store holds no key directories")
+    return report
+
+
+def _verify_one(path: str, recorded_alias: str):
+    """Offline self-check for one payload: deserializes and compares
+    alias specs. Returns an error string or None."""
+    from paddle_tpu.jit import compiled_alias_spec, deserialize_compiled
+    try:
+        with open(path, "rb") as f:
+            compiled = deserialize_compiled(f.read())
+    except Exception as e:  # noqa: BLE001
+        return f"payload does not deserialize: {e!r}"
+    live = compiled_alias_spec(compiled)
+    if live != recorded_alias:
+        return (f"alias spec mismatch: loaded={live!r} vs "
+                f"recorded={recorded_alias!r}")
+    if not live.strip():
+        return ("empty alias spec on a donating program — the PR 1 "
+                "aliasing-drop corruption class")
+    return None
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description="list/verify a serving program store")
+    ap.add_argument("root", help="store root directory "
+                                 "(FLAGS_gen_program_store_dir)")
+    ap.add_argument("--verify", action="store_true",
+                    help="deserialize every payload and re-run the "
+                         "donation-aliasing self-check offline")
+    ap.add_argument("--json", action="store_true",
+                    help="emit the full report as JSON on stdout")
+    args = ap.parse_args(argv)
+
+    report = inspect_store(args.root, verify=args.verify)
+    if args.json:
+        print(json.dumps(report, indent=1, sort_keys=True))
+    else:
+        for key, info in report["keys"].items():
+            print(f"key {key}  (jax {info['jax']} / jaxlib "
+                  f"{info['jaxlib']}, {info['backend']}/"
+                  f"{info['device_kind']})")
+            for name, row in info["programs"].items():
+                mark = ""
+                if args.verify:
+                    mark = (" [ok]" if row.get("verified")
+                            else " [FAIL]")
+                print(f"  {name:24s} {row['bytes']:>10} bytes  "
+                      f"alias={{{row['alias']}}}{mark}")
+        for p in report["problems"]:
+            print(f"PROBLEM: {p}", file=sys.stderr)
+    return 1 if report["problems"] else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
