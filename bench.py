@@ -1112,6 +1112,239 @@ def bench_recovery():
     return fault["goodput_tokens_per_sec"], extra
 
 
+def bench_router():
+    """The router tier (ISSUE 17): prefix-affinity placement over N
+    supervised replicas vs round-robin at equal aggregate pool bytes,
+    plus a one-replica-kill goodput arm.
+
+    Affinity arms: K sessions, each a distinct multi-page system prefix
+    + per-request tail, revisited over several shuffled cycles — the
+    agent-loop shape. Per-replica prefix budgets hold ~K/N chains, so
+    an affinity router that PARTITIONS sessions across replicas serves
+    every revisit from cache (aggregate cache capacity = the SUM of
+    replica budgets), while round-robin placement smears every session
+    over every replica and thrashes each replica's LRU (aggregate
+    capacity = ONE replica's budget, duplicated). Gates: affinity-on
+    TTFT p50 >= 2x affinity-off, token-identical outputs across arms,
+    zero post-warmup compiles in either arm (ledger-proven per
+    replica).
+
+    Kill arm: the same concurrent load through a 2-replica router with
+    one injected decode-step death mid-load vs a fault-free router run.
+    Gates: zero requests lost (every future resolves successfully),
+    outputs token-identical to the fault-free run (greedy decode is
+    placement-independent, so replica death + supervisor replay must
+    not show), exactly one restart, zero new compiles after the
+    restart, ledgers embedded."""
+    import paddle_tpu as paddle
+    from paddle_tpu import serving
+    from paddle_tpu.framework import monitor
+    from paddle_tpu.models import GPTConfig, GPTForCausalLM
+    from paddle_tpu.serving import failpoints
+
+    if _SMOKE:
+        HID, LAYERS, HEADS, VOCAB = 512, 4, 8, 2048
+        REPLICAS, SESSIONS, CYCLES = 4, 8, 4
+        PFX_PAGES, MAXN = 12, 8
+        K_REQ, K_MAXN, K_PROMPT, K_SLOTS = 24, 16, 16, 8
+    else:
+        HID, LAYERS, HEADS, VOCAB = 768, 8, 12, 32000
+        REPLICAS, SESSIONS, CYCLES = 4, 12, 4
+        PFX_PAGES, MAXN = 12, 16
+        K_REQ, K_MAXN, K_PROMPT, K_SLOTS = 48, 32, 64, 8
+    PAGE = 16
+    PFX, TAIL = PFX_PAGES * PAGE, PAGE
+    S_TOTAL = PFX + TAIL + MAXN
+    # each session's chain is every FULL page of (prefix+tail+generated)
+    CHAIN_PAGES = S_TOTAL // PAGE
+    # per-replica prefix budget: ceil(K/N) chains + one page of churn —
+    # an affinity partition fits exactly, a round-robin smear cannot
+    BUDGET = -(-SESSIONS // REPLICAS) * CHAIN_PAGES + 1
+    POOL = 2 * -(-S_TOTAL // PAGE) + BUDGET + 4
+
+    paddle.seed(0)
+    cfg = GPTConfig(vocab_size=VOCAB, hidden_size=HID, num_layers=LAYERS,
+                    num_heads=HEADS, intermediate_size=4 * HID,
+                    max_position_embeddings=S_TOTAL, dropout=0.0)
+    net = GPTForCausalLM(cfg)
+    net.eval()
+    monitor.reset_all_stats()
+    rng = np.random.RandomState(0)
+    session_prompts = [
+        np.concatenate([rng.randint(0, VOCAB, size=(PFX,)),
+                        rng.randint(0, VOCAB, size=(TAIL,))])
+        .astype("int64") for _ in range(SESSIONS)]
+    # identical visit order in both arms: cycle 0 in session order (the
+    # first-touch spread), later cycles shuffled so round-robin cannot
+    # accidentally re-derive the affinity partition from arrival parity
+    orders = [list(range(SESSIONS))]
+    for _ in range(CYCLES - 1):
+        orders.append(list(rng.permutation(SESSIONS)))
+
+    def affinity_arm(on):
+        r = serving.Router(
+            net, num_replicas=REPLICAS, affinity=on,
+            pressure_ttl_ms=0.0, max_slots=2, page_size=PAGE,
+            num_pages=POOL, prefill_buckets=(TAIL, PFX + TAIL),
+            max_new_tokens=MAXN, max_queue_depth=4 * SESSIONS,
+            request_timeout_ms=0, prefix_cache=True,
+            prefix_cache_max_pages=BUDGET,
+            name=f"bench_router_{'aff' if on else 'rr'}")
+        ledger0 = {rep.name: dict(rep.sup.engine._ledger)
+                   for rep in r._replicas}
+        ttfts, outs = [], {}
+        try:
+            for cycle, order in enumerate(orders):
+                for s in order:
+                    t0 = time.perf_counter()
+                    stream = r.submit_stream(session_prompts[s],
+                                             max_new_tokens=MAXN)
+                    next(iter(stream))       # TTFT: first streamed token
+                    ttfts.append((time.perf_counter() - t0) * 1e3)
+                    for _ in stream:
+                        pass
+                    outs[(cycle, s)] = stream.result(timeout=600)
+            live_compiles = {
+                rep.name: {k: v for k, v in rep.sup.engine._ledger.items()
+                           if ledger0[rep.name].get(k) != v}
+                for rep in r._replicas}
+            hits = sum(rep.sup.engine._prefix.hits for rep in r._replicas)
+            stats = {
+                "placements": {rep.name: rep.placements
+                               for rep in r._replicas},
+                "prefix_hits": hits,
+                "hit_rate": round(hits / len(ttfts), 3),
+                "post_warmup_compiles": {k: v for k, v
+                                         in live_compiles.items() if v},
+                "ledgers": {rep.name: dict(rep.sup.engine._ledger)
+                            for rep in r._replicas},
+            }
+        finally:
+            r.shutdown()
+        p50 = sorted(ttfts)[len(ttfts) // 2]
+        return p50, outs, stats
+
+    ttft_aff, outs_aff, stats_aff = affinity_arm(True)
+    ttft_rr, outs_rr, stats_rr = affinity_arm(False)
+    token_identical = (outs_aff.keys() == outs_rr.keys() and all(
+        np.array_equal(outs_aff[k], outs_rr[k]) for k in outs_aff))
+    ttft_speedup = round(ttft_rr / max(ttft_aff, 1e-9), 3)
+
+    # ---- one-replica-kill goodput arm -------------------------------------
+    kill_prompts = [rng.randint(0, VOCAB, size=(K_PROMPT,))
+                    .astype("int64") for _ in range(K_REQ)]
+    k_pool = K_SLOTS * -(-(K_PROMPT + K_MAXN) // PAGE) + 1
+    # one decode-step fault mid-load; the failpoint counter is process-
+    # wide, so the Nth step lands on whichever replica is mid-decode —
+    # exactly the nondeterminism a fleet sees
+    fault_step = max(2, (-(-K_REQ // (2 * K_SLOTS)) * K_MAXN) // 2)
+
+    def kill_arm(name, spec):
+        failpoints.reset()
+        prev = paddle.get_flags(["FLAGS_failpoints",
+                                 "FLAGS_gen_restart_backoff_ms"])
+        paddle.set_flags({"FLAGS_failpoints": spec,
+                          "FLAGS_gen_restart_backoff_ms": 20.0})
+        try:
+            r = serving.Router(
+                net, num_replicas=2, pressure_ttl_ms=0.0,
+                max_slots=K_SLOTS, page_size=PAGE, num_pages=k_pool,
+                prefill_buckets=(K_PROMPT,), max_new_tokens=K_MAXN,
+                max_queue_depth=2 * K_REQ, request_timeout_ms=0,
+                prefix_cache=False, name=name)
+            ledger0 = {rep.name: dict(rep.sup.engine._ledger)
+                       for rep in r._replicas}
+            start = threading.Barrier(K_REQ + 1)
+            futs = [None] * K_REQ
+            errors = []
+
+            def client(i):
+                try:
+                    start.wait()
+                    futs[i] = r.submit(kill_prompts[i],
+                                       max_new_tokens=K_MAXN)
+                except Exception as e:  # noqa: BLE001
+                    errors.append(e)
+
+            threads = [threading.Thread(target=client, args=(i,),
+                                        daemon=True)
+                       for i in range(K_REQ)]
+            for t in threads:
+                t.start()
+            start.wait()
+            t0 = time.perf_counter()
+            for t in threads:
+                t.join()
+            if errors:
+                raise RuntimeError(
+                    f"{len(errors)}/{K_REQ} router clients failed to "
+                    f"submit: {errors[0]!r}")
+            outs, resolve_errors = [], []
+            for f in futs:
+                try:
+                    outs.append(np.asarray(f.result(timeout=300)))
+                except Exception as e:  # noqa: BLE001
+                    outs.append(None)
+                    resolve_errors.append(repr(e))
+            wall = time.perf_counter() - t0
+            toks = sum(len(o) - K_PROMPT for o in outs if o is not None)
+            res = {
+                "goodput_tokens_per_sec": round(toks / wall, 2),
+                "resolved": sum(1 for o in outs if o is not None),
+                "resolve_errors": resolve_errors[:4],
+                "restarts": sum(rep.sup.restarts for rep in r._replicas),
+                "placements": {rep.name: rep.placements
+                               for rep in r._replicas},
+                "new_compiles_after_start": any(
+                    dict(rep.sup.engine._ledger) != ledger0[rep.name]
+                    for rep in r._replicas),
+                "ledgers": {rep.name: dict(rep.sup.engine._ledger)
+                            for rep in r._replicas},
+                "pages_in_use": sum(
+                    rep.sup.stats()["pages"]["pages_in_use"]
+                    for rep in r._replicas),
+                "outs": outs,
+            }
+            r.shutdown()
+            return res
+        finally:
+            paddle.set_flags(prev)
+            failpoints.reset()
+
+    clean = kill_arm("bench_router_clean", "")
+    fault = kill_arm("bench_router_kill",
+                     f"decode_step_raise@{fault_step}")
+    kill_identical = all(
+        a is not None and b is not None and np.array_equal(a, b)
+        for a, b in zip(clean.pop("outs"), fault.pop("outs")))
+    goodput_ratio = round(fault["goodput_tokens_per_sec"]
+                          / max(clean["goodput_tokens_per_sec"], 1e-9), 3)
+
+    extra = {
+        "replicas": REPLICAS,
+        "sessions": SESSIONS,
+        "cycles": CYCLES,
+        "prefix_pages": PFX_PAGES,
+        "prefix_budget_pages_per_replica": BUDGET,
+        "pool_pages_per_replica": POOL,
+        "ttft_p50_ms_affinity": round(ttft_aff, 2),
+        "ttft_p50_ms_round_robin": round(ttft_rr, 2),
+        "ttft_speedup": ttft_speedup,
+        "token_identical_affinity_vs_rr": token_identical,
+        "affinity_arm": stats_aff,
+        "round_robin_arm": stats_rr,
+        "kill_arm": {
+            "requests": K_REQ,
+            "fault_step": fault_step,
+            "clean": clean,
+            "fault": fault,
+            "goodput_ratio_fault_vs_clean": goodput_ratio,
+            "token_identical_fault_vs_clean": kill_identical,
+        },
+    }
+    return ttft_speedup, extra
+
+
 def bench_coldstart():
     """Warm start via the program store (ISSUE 16): time-to-first-
     served-token for a fresh engine PROCESS-equivalent, three arms —
@@ -2024,6 +2257,7 @@ def _run_mode(mode="train", backend=None):
                 "generation": "generation_engine_tokens_per_sec",
                 "quant": "quant_generation_engine_tokens_per_sec",
                 "recovery": "recovery_goodput_tokens_per_sec",
+                "router": "router_affinity_ttft_p50_speedup",
                 "coldstart": "coldstart_ttfst_speedup_warm_vs_cold"}\
         .get(mode, _HEADLINE)
     if mode == "input":
@@ -2250,6 +2484,68 @@ def _run_mode(mode="train", backend=None):
                   extra={"error": str(e)[:300]})
         return
 
+    if mode == "router":
+        try:
+            speedup, extra = _with_retries(bench_router)
+            _emit(headline, speedup, "x ttft p50 rr/affinity",
+                  extra=extra)
+            if extra["ttft_speedup"] < 2.0:
+                sys.stderr.write(
+                    f"REGRESSION: prefix-affinity routing improves "
+                    f"shared-prefix TTFT p50 only "
+                    f"{extra['ttft_speedup']}x over round-robin at "
+                    f"equal aggregate pool bytes — below the 2x "
+                    f"acceptance floor\n")
+            if not extra["token_identical_affinity_vs_rr"]:
+                sys.stderr.write(
+                    "REGRESSION: greedy output differs affinity vs "
+                    "round-robin — placement must never change the "
+                    "math, only the cache temperature\n")
+            if extra["affinity_arm"]["post_warmup_compiles"] \
+                    or extra["round_robin_arm"]["post_warmup_compiles"]:
+                sys.stderr.write(
+                    f"REGRESSION: an affinity-arm replica compiled "
+                    f"after warmup "
+                    f"(on={extra['affinity_arm']['post_warmup_compiles']}"
+                    f", off="
+                    f"{extra['round_robin_arm']['post_warmup_compiles']})"
+                    f" — routed traffic must ride the warmed buckets\n")
+            k = extra["kill_arm"]
+            if k["fault"]["resolved"] != k["requests"]:
+                sys.stderr.write(
+                    f"REGRESSION: only {k['fault']['resolved']}/"
+                    f"{k['requests']} requests resolved across the "
+                    f"injected replica death — a replica kill must "
+                    f"lose ZERO requests ({k['fault']['resolve_errors']})"
+                    f"\n")
+            if not k["token_identical_fault_vs_clean"]:
+                sys.stderr.write(
+                    "REGRESSION: greedy output differs between the "
+                    "replica-kill run and the fault-free run — "
+                    "survivors and replays must be token-identical\n")
+            if k["fault"]["restarts"] != 1:
+                sys.stderr.write(
+                    f"REGRESSION: {k['fault']['restarts']} restarts "
+                    f"for ONE injected replica death — expected "
+                    f"exactly 1\n")
+            if k["fault"]["new_compiles_after_start"] \
+                    or k["clean"]["new_compiles_after_start"]:
+                sys.stderr.write(
+                    "REGRESSION: a kill-arm compile ledger moved "
+                    "after warmup — resurrection must re-warm from "
+                    "the program pack with zero new traces\n")
+            if k["fault"]["pages_in_use"] != 0:
+                sys.stderr.write(
+                    f"REGRESSION: {k['fault']['pages_in_use']} KV "
+                    f"pages still allocated across the fleet after "
+                    f"the kill arm drained — the replay path is "
+                    f"leaking pages\n")
+        except Exception as e:  # noqa: BLE001
+            traceback.print_exc()
+            _emit(headline, 0.0, "x ttft p50 rr/affinity",
+                  extra={"error": str(e)[:300]})
+        return
+
     if mode == "coldstart":
         try:
             speedup, extra = _with_retries(bench_coldstart)
@@ -2419,7 +2715,7 @@ if __name__ == "__main__":
     ap = argparse.ArgumentParser(description=__doc__)
     ap.add_argument("--mode", choices=("train", "serving", "input",
                                        "packing", "generation", "quant",
-                                       "recovery", "coldstart"),
+                                       "recovery", "router", "coldstart"),
                     default="train",
                     help="train: the round training configs (default); "
                          "serving: multi-lane InferenceEngine qps/latency/"
@@ -2455,6 +2751,14 @@ if __name__ == "__main__":
                          "recovery wall, goodput >= 0.7x fault-free, "
                          "zero new compiles after restart "
                          "(ledger-proven), zero leaked pages; "
+                         "router: the router tier (ISSUE 17) — "
+                         "prefix-affinity placement over N supervised "
+                         "replicas vs round-robin at equal aggregate "
+                         "pool bytes (TTFT p50 >= 2x floor, "
+                         "token-identical, zero post-warmup compiles) "
+                         "plus a one-replica-kill arm (zero requests "
+                         "lost, token-identical to fault-free, one "
+                         "restart, ledgers embedded); "
                          "coldstart: warm start via the program store "
                          "(ISSUE 16) — time-to-first-served-token for "
                          "a fresh engine, cold (empty store) vs warm "

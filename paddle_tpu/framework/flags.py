@@ -258,6 +258,27 @@ register_flag("FLAGS_slo_max_burn_rate", 0.0,
               "value, so the router sheds load BEFORE the error budget "
               "is gone (0 never sheds; 1.0 = shedding exactly at "
               "budget-burn speed)")
+register_flag("FLAGS_router_replicas", 2,
+              "default replica count for serving.Router when neither "
+              "num_replicas nor prebuilt replicas are passed — each "
+              "replica is an EngineSupervisor-wrapped GenerationEngine "
+              "(serving/router.py)")
+register_flag("FLAGS_router_affinity", True,
+              "prefix-affinity placement (serving/router.py): steer a "
+              "request to the replica whose sketch holds the longest "
+              "blake2b chain over the prompt's leading full pages; "
+              "False = pure round-robin over undrained replicas (the "
+              "bench.py --mode router A/B arm)")
+register_flag("FLAGS_router_sketch_digests", 8192,
+              "per-replica LRU sketch capacity, in chain digests, the "
+              "router's affinity placement matches against — bounds "
+              "router memory at 16 bytes/digest per replica; oldest "
+              "digests age out first (serving/router.py)")
+register_flag("FLAGS_router_pressure_ttl_ms", 50.0,
+              "max age of the router's cached per-replica pressure + "
+              "health snapshot before a placement refreshes it — the "
+              "poll cadence bound on GenerationEngine.pressure(); 0 "
+              "refreshes every placement (serving/router.py)")
 register_flag("FLAGS_train_step_donate", True,
               "donate the (params, buffers, opt_state) carry into the jitted "
               "train step so XLA updates parameters in place instead of "

@@ -84,6 +84,19 @@ REASONS = frozenset({
     "DEGRADED_ADMIT_CLAMP",  # repeated allocator exhaustion clamped
                              # admission: uncoverable submits now fail
                              # fast (FLAGS_gen_exhaust_clamp_k)
+    "ROUTE_AFFINITY",      # router placed the request on the replica
+                           # whose sketch held its longest prompt
+                           # prefix chain (ISSUE 17; detail: replica,
+                           # matched_pages)
+    "ROUTE_LEAST_PRESSURE",  # no replica held the prefix (or affinity
+                             # off/tied): placed by best headroom /
+                             # shortest queue / youngest head
+    "ROUTE_DRAIN",         # replica left (or re-entered) the placement
+                           # set: SLO burn / breaker-open / not-ready
+                           # — live streams on it finish untouched
+    "ROUTE_REROUTE",       # placement failed typed on the chosen
+                           # replica (breaker/shutdown/overload); the
+                           # router retried the next-best replica
 })
 
 _CAP = 2048   # per-engine ring bound (≈ a few minutes of decisions)

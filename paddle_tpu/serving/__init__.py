@@ -33,6 +33,12 @@ pre-compiled bucketed shapes).
   lanes restart per-slot (`FLAGS_serving_lane_restarts`), and
   `failpoints` injects deterministic faults into every hardened seam
   (`FLAGS_failpoints`).
+- **router tier (ISSUE 17)** — `Router`: one front door over N
+  supervised `GenerationEngine` replicas; prefix-affinity placement
+  (blake2b chain digests vs per-replica LRU sketches — session
+  stickiness with zero router session state), least-pressure fallback
+  on cached `pressure()` snapshots, drain on SLO burn / breaker-open,
+  placement-time re-route under typed-failure semantics.
 - **warm start (ISSUE 16)** — `ProgramStore`: a keyed on-disk AOT
   executable store; `GenerationEngine` warmup loads serialized
   prefill/tail/decode/verify/cow programs under a content key instead
@@ -55,9 +61,10 @@ from .engine import EngineConfig, InferenceEngine  # noqa: E402
 from .generation import (CrashManifest, GenerationConfig,  # noqa: E402
                          GenerationEngine, ReplayEntry, TokenStream)
 from .kv_cache import PagedKVCache  # noqa: E402
-from .prefix_cache import PrefixCache  # noqa: E402
+from .prefix_cache import PrefixCache, chain_digests  # noqa: E402
 from .program_store import ProgramStore  # noqa: E402
 from .restart import CrashBreaker, RestartBackoff  # noqa: E402
+from .router import Router  # noqa: E402
 from .spec_decode import NGramProposer  # noqa: E402
 from .supervisor import EngineSupervisor  # noqa: E402
 
@@ -65,4 +72,5 @@ __all__ = ["InferenceEngine", "EngineConfig", "EngineOverloaded",
            "EngineSupervisor", "CrashBreaker", "CrashManifest",
            "GenerationEngine", "GenerationConfig", "NGramProposer",
            "PagedKVCache", "PrefixCache", "ProgramStore", "ReplayEntry",
-           "RestartBackoff", "TokenStream", "failpoints"]
+           "RestartBackoff", "Router", "TokenStream", "chain_digests",
+           "failpoints"]

@@ -605,6 +605,9 @@ class GenerationEngine:
                     "tokens": 0, "spec_drafted": 0, "spec_accepted": 0,
                     "prefill_chunks": 0,
                     "prefill_ms": 0.0, "decode_ms": 0.0}
+        # published BEFORE the step thread exists so a router polling a
+        # freshly built replica reads a truthful empty-engine snapshot
+        self._pressure = self._compute_pressure()
 
         self._build_programs(pack)
         flight_recorder.touch()
@@ -1497,6 +1500,11 @@ class GenerationEngine:
             "cow_splits": 0, "tokens": 0, "spec_drafted": 0,
             "spec_accepted": 0, "prefill_chunks": 0,
             "prefill_ms": 0.0, "decode_ms": 0.0}
+        # pressure snapshot (ISSUE 17): republished every iteration on
+        # the step thread — the only thread that mutates the allocator —
+        # so `pressure()` readers never need the engine lock. Runs even
+        # with the step ring off: the router polls regardless.
+        self._pressure = self._compute_pressure()
         if self._step_log is None:
             return
         self._iters += 1
@@ -2601,6 +2609,46 @@ class GenerationEngine:
             for tokens, n in sorted(
                 self._cache.headroom(sorted(shapes)).items())}
         return out
+
+    def _compute_pressure(self) -> dict:
+        """Step-thread half of `pressure()`: admission headroom per
+        representative request shape (prefill bucket + default max-new,
+        the same shapes as stats()["kv"]["admit_headroom"]), pool
+        occupancy, and slot availability. Called only from __init__
+        (before the step thread exists) and `_record_iteration` (on it),
+        and published as one plain-dict attribute store — the atomic
+        handoff `pressure()` reads."""
+        shapes = sorted({b + self._cfg.max_new_tokens
+                         for b in self._cfg.prefill_buckets})
+        return {
+            "headroom": {str(t): n for t, n in sorted(
+                self._cache.headroom(shapes).items())},
+            "free_pages": self._cache.free_pages,
+            "pages_in_use": self._cache.pages_in_use,
+            "slots_free": sum(1 for r in self._slots if r is None),
+            "live": self._num_active(),
+        }
+
+    def pressure(self) -> dict:
+        """Cheap per-replica pressure snapshot for the router tier
+        (ISSUE 17): page/slot fields come from the step thread's last
+        published `_compute_pressure()` dict (read as one GIL-atomic
+        attribute load — NO engine lock taken, so a polling router can
+        never contend the step loop), while queue depth and oldest-queue
+        age are overlaid live — the queue grows on the submitter side
+        between iterations and staleness there is exactly what a
+        balancer must see. `len(deque)` and `deque[0]` are GIL-atomic;
+        the head may race an admit's popleft, hence the IndexError arm."""
+        snap = dict(self._pressure)
+        q = self._queue
+        snap["queue_depth"] = len(q)
+        try:
+            snap["oldest_age_ms"] = round(
+                _now_ms() - q[0].t_enqueue_ms, 3)
+        except IndexError:
+            snap["oldest_age_ms"] = 0.0
+        snap["queue_limit"] = self._cfg.max_queue_depth
+        return snap
 
     def health(self) -> dict:
         """`/readyz` verdict, same shape as InferenceEngine.health() so

@@ -45,9 +45,30 @@ from ..framework import monitor
 from ..framework.flags import flag
 from .kv_cache import PagedKVCache
 
-__all__ = ["PrefixCache"]
+__all__ = ["PrefixCache", "chain_digests"]
 
 _ROOT = b"paged-prefix-root"
+
+
+def chain_digests(token_ids: np.ndarray, page_size: int) -> List[bytes]:
+    """The blake2b chain digests of every FULL page of `token_ids` —
+    digest i commits to tokens [0, (i+1)*page_size), so equal digests
+    mean equal token streams up to that page boundary.
+
+    This is THE digest implementation: `PrefixCache` (the engine's
+    cache index) and the router tier's affinity hashing both call it,
+    so a prompt hashes identically on every replica and the two sides
+    cannot drift. Content-only — no engine, device, or pool state is
+    mixed in."""
+    P = int(page_size)
+    toks = np.ascontiguousarray(np.asarray(token_ids, np.int32))
+    out, parent = [], _ROOT
+    for i in range(int(toks.size) // P):
+        h = hashlib.blake2b(parent, digest_size=16)
+        h.update(toks[i * P:(i + 1) * P].tobytes())
+        parent = h.digest()
+        out.append(parent)
+    return out
 
 
 class _Node:
@@ -90,18 +111,9 @@ class PrefixCache:
     # -- hashing -----------------------------------------------------------
 
     def digests(self, prompt: np.ndarray) -> List[bytes]:
-        """The chain digests of every FULL page of `prompt` — digest i
-        commits to tokens [0, (i+1)*page_size), so equal digests mean
-        equal token streams up to that page boundary."""
-        P = self._kv.page_size
-        toks = np.ascontiguousarray(np.asarray(prompt, np.int32))
-        out, parent = [], _ROOT
-        for i in range(int(toks.size) // P):
-            h = hashlib.blake2b(parent, digest_size=16)
-            h.update(toks[i * P:(i + 1) * P].tobytes())
-            parent = h.digest()
-            out.append(parent)
-        return out
+        """`chain_digests` at this cache's page size — `lookup` and
+        `register` key the index through this single implementation."""
+        return chain_digests(prompt, self._kv.page_size)
 
     # -- lookup / register -------------------------------------------------
 

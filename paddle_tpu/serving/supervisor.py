@@ -433,6 +433,14 @@ class EngineSupervisor:
         s["supervisor"] = self.supervisor_stats()
         return s
 
+    def pressure(self) -> dict:
+        """The live engine's `pressure()` snapshot (ISSUE 17) — gate
+        NOT taken, same rationale as stats(): a router poll must never
+        block behind a restart. Mid-restart the dead incarnation's last
+        snapshot is returned; health() separately reports not-ready, so
+        the router drains the replica rather than trusting the number."""
+        return self._engine.pressure()
+
     def health(self) -> dict:
         """`/readyz` verdict across engine generations: breaker open →
         503 with the breaker reason; restarting → 503 "restarting";

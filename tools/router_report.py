@@ -1,0 +1,188 @@
+#!/usr/bin/env python
+"""Render a Router's placement audit + per-replica pressure timeline.
+
+    curl -s localhost:9100/stats > stats.json
+    python tools/router_report.py stats.json
+    python tools/router_report.py stats.json --router front --last 40
+
+Input is either an exporter `/stats` payload (the router registers like
+any engine, so its snapshot rides `engines.<name>.router`) or a direct
+`Router.stats()` dump. The report shows, per router: the placement
+summary (per replica: placements, sketch size, drain verdict, live
+pressure — queue depth, slots free, page headroom — and the
+supervisor's restart/breaker counters), then the pressure timeline the
+router's refreshes recorded (one row per tick, queue-depth bars per
+replica — the drain/steer history at a glance), then the placement
+audit tail (ROUTE_AFFINITY with matched chain depth, ROUTE_LEAST_
+PRESSURE with the policy that won, ROUTE_DRAIN edges with the replica's
+own verdict, ROUTE_REROUTE with the typed failure that moved the
+request) — so "why did this request land THERE" reads straight off the
+artifact, same contract as tools/engine_report.py gives one engine.
+
+`--json` emits the parsed + summarized structure for scripting.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from typing import Dict
+
+from engine_report import _bar  # noqa: E402 — shared table machinery
+
+
+def load_routers(path: str) -> Dict[str, dict]:
+    """Normalize either input shape to {router_name: router_snapshot}."""
+    with open(path) as f:
+        raw = json.load(f)
+    if "router" in raw:  # a direct Router.stats() dump
+        return {"router": raw["router"]}
+    if "engines" in raw:  # exporter /stats payload
+        out = {name: e["router"] for name, e in raw["engines"].items()
+               if isinstance(e, dict) and "router" in e}
+        if out:
+            return out
+        raise SystemExit(
+            f"{path}: /stats payload has no router-tier engines "
+            f"(registered engines: {sorted(raw['engines'])})")
+    raise SystemExit(
+        f"{path}: neither a Router.stats() dump (no 'router' key) nor "
+        f"an exporter /stats payload (no 'engines' key)")
+
+
+def summarize(snap: dict) -> dict:
+    replicas = snap.get("replicas", {})
+    audit = snap.get("audit_tail", [])
+    reasons: Dict[str, int] = {}
+    for ev in audit:
+        reasons[ev.get("reason", "?")] = \
+            reasons.get(ev.get("reason", "?"), 0) + 1
+    return {
+        "replicas": len(replicas),
+        "placements_total": snap.get("placements_total", 0),
+        "affinity": snap.get("affinity"),
+        "drained_now": sorted(name for name, r in replicas.items()
+                              if r.get("drained")),
+        "restarts_total": sum(
+            (r.get("supervisor") or {}).get("restarts", 0)
+            for r in replicas.values()),
+        "timeline_ticks": len(snap.get("pressure_timeline", [])),
+        "audit_events": len(audit),
+        "audit_reasons": reasons,
+    }
+
+
+def render(name: str, snap: dict, last: int = 0, file=None) -> None:
+    out = file or sys.stdout
+    summ = summarize(snap)
+    replicas = snap.get("replicas", {})
+    print(f"== router {name} ==", file=out)
+    print(f"   {summ['replicas']} replicas, "
+          f"{summ['placements_total']} placements, affinity="
+          f"{'on' if summ['affinity'] else 'off'} "
+          f"(sketch cap {snap.get('sketch_capacity')} digests, "
+          f"page size {snap.get('page_size')}, pressure ttl "
+          f"{snap.get('pressure_ttl_ms')}ms)", file=out)
+    if summ["drained_now"]:
+        print(f"   DRAINED now: {', '.join(summ['drained_now'])}",
+              file=out)
+    if summ["restarts_total"]:
+        print(f"   {summ['restarts_total']} supervised restart(s) "
+              f"across the fleet", file=out)
+
+    # -- placement summary table -------------------------------------------
+    hdr = (f"   {'replica':<18} {'placed':>6} {'sketch':>6} {'drain':>5} "
+           f"{'queue':>5} {'age_ms':>8} {'slots':>5} {'free_pg':>7} "
+           f"{'restarts':>8} {'breaker':>7}")
+    print(hdr, file=out)
+    for rname in sorted(replicas):
+        r = replicas[rname]
+        p = r.get("pressure") or {}
+        sup = r.get("supervisor") or {}
+        breaker = (sup.get("breaker") or {})
+        print(f"   {rname:<18} {r.get('placements', 0):>6} "
+              f"{r.get('sketch_digests', 0):>6} "
+              f"{'YES' if r.get('drained') else '-':>5} "
+              f"{p.get('queue_depth', 0):>5} "
+              f"{p.get('oldest_age_ms', 0.0):>8.1f} "
+              f"{p.get('slots_free', 0):>5} "
+              f"{p.get('free_pages', 0):>7} "
+              f"{sup.get('restarts', 0):>8} "
+              f"{'OPEN' if breaker.get('open') else '-':>7}", file=out)
+
+    # -- pressure timeline ---------------------------------------------------
+    ticks = snap.get("pressure_timeline", [])
+    if last > 0:
+        ticks = ticks[-last:]
+    print(f"   -- pressure timeline ({len(ticks)} ticks) --", file=out)
+    if ticks:
+        names = sorted({n for t in ticks for n in t.get("replicas", {})})
+        peak_q = max((t["replicas"].get(n, {}).get("queue_depth", 0)
+                      for t in ticks for n in names), default=0)
+        print("   " + " ".join(f"{n[-14:]:>21}" for n in names),
+              file=out)
+        for t in ticks:
+            cells = []
+            for n in names:
+                r = t.get("replicas", {}).get(n, {})
+                mark = " " if r.get("ready", True) else "D"
+                cells.append(f"[{_bar(r.get('queue_depth', 0), peak_q)}]"
+                             f"q{r.get('queue_depth', 0):<3}{mark}")
+            print(f"   t={t.get('t_ms', 0):>12.1f} " + " ".join(cells),
+                  file=out)
+
+    # -- placement audit -----------------------------------------------------
+    audit = snap.get("audit_tail", [])
+    if last > 0:
+        audit = audit[-last:]
+    print(f"   -- placement audit ({len(audit)} events) --", file=out)
+    for ev in audit:
+        extra = {k: v for k, v in ev.items()
+                 if k not in ("t", "engine", "reason", "rid")}
+        detail = (" " + " ".join(f"{k}={v}" for k, v in
+                                 sorted(extra.items()))) if extra else ""
+        print(f"   t={ev.get('t', 0):.3f} "
+              f"{ev.get('reason', '?'):<20}{detail}", file=out)
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(
+        prog="router_report.py", description=__doc__,
+        formatter_class=argparse.RawDescriptionHelpFormatter)
+    p.add_argument("path", help="/stats payload or Router.stats() dump")
+    p.add_argument("--router", default=None,
+                   help="only this router (default: all in the payload)")
+    p.add_argument("--last", type=int, default=0,
+                   help="only the last N timeline ticks / audit events "
+                        "(default: all)")
+    p.add_argument("--json", action="store_true",
+                   help="emit parsed snapshot + summary as JSON")
+    args = p.parse_args(argv)
+
+    routers = load_routers(args.path)
+    if args.router is not None:
+        if args.router not in routers:
+            print(f"router {args.router!r} not in {sorted(routers)}",
+                  file=sys.stderr)
+            return 1
+        routers = {args.router: routers[args.router]}
+
+    if args.json:
+        out = {}
+        for name, snap in routers.items():
+            ticks = snap.get("pressure_timeline", [])
+            audit = snap.get("audit_tail", [])
+            if args.last > 0:
+                ticks, audit = ticks[-args.last:], audit[-args.last:]
+            out[name] = {"summary": summarize(snap),
+                         "pressure_timeline": ticks, "audit": audit}
+        print(json.dumps(out, indent=2))
+        return 0
+
+    for name, snap in sorted(routers.items()):
+        render(name, snap, last=args.last)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
