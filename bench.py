@@ -1448,6 +1448,178 @@ def bench_coldstart():
     return speedup, extra
 
 
+def bench_kvtier():
+    """Tiered KV cache (ISSUE 18): host-RAM demotion under the prefix
+    cache, measured where it pays — session revisits whose chains no
+    longer fit HBM.
+
+    Two arms at EQUAL HBM bytes (same pool pages, same HBM prefix
+    budget of ~2 chains): K sessions, each a distinct multi-page
+    prefix, revisited over shuffled cycles. Tier-off: an evicted
+    chain's revisit is a full cold prefill (the PR 12 behavior).
+    Tier-on: eviction demotes the chain's raw pages to host RAM and
+    the revisit promotes them back through the double-buffered
+    `device_put` upload overlapped with the tail prefill — TTFT is
+    ~one tail prefill instead of a full re-prefill. Gates: tier-on
+    revisit TTFT p50 >= 2x tier-off, promotions actually happened,
+    token-identical outputs across arms, zero post-warmup compiles in
+    either arm (ledger-proven), zero leaked pages on BOTH tiers.
+
+    Failpoint arms (tier-on config, flags saved/restored):
+    `kv_tier.promote_upload@every:1` abandons every promotion
+    mid-upload — the cold-prefill fallback must stay token-identical
+    with abandons audited and zero leaks on either tier;
+    `kv_tier.demote_gather@every:1` fails every off-device gather —
+    eviction degrades to the plain PR 12 path with an empty tier and
+    zero leaks."""
+    import paddle_tpu as paddle
+    from paddle_tpu import serving
+    from paddle_tpu.framework import monitor
+    from paddle_tpu.models import GPTConfig, GPTForCausalLM
+    from paddle_tpu.serving import failpoints
+
+    if _SMOKE:
+        HID, LAYERS, HEADS, VOCAB = 512, 4, 8, 2048
+        SESSIONS, CYCLES, PFX_PAGES, MAXN = 6, 3, 8, 8
+    else:
+        HID, LAYERS, HEADS, VOCAB = 768, 8, 12, 32000
+        SESSIONS, CYCLES, PFX_PAGES, MAXN = 12, 4, 12, 16
+    PAGE = 16
+    PFX, TAIL = PFX_PAGES * PAGE, PAGE
+    S_TOTAL = PFX + TAIL + MAXN
+    CHAIN_PAGES = (PFX + TAIL) // PAGE
+    # HBM holds ~2 chains; the working set is SESSIONS chains — every
+    # revisit outside the 2 most recent sessions is an HBM miss
+    BUDGET = 2 * CHAIN_PAGES + 1
+    POOL = 2 * -(-S_TOTAL // PAGE) + BUDGET + 4
+
+    paddle.seed(0)
+    cfg = GPTConfig(vocab_size=VOCAB, hidden_size=HID, num_layers=LAYERS,
+                    num_heads=HEADS, intermediate_size=4 * HID,
+                    max_position_embeddings=S_TOTAL, dropout=0.0)
+    net = GPTForCausalLM(cfg)
+    net.eval()
+    monitor.reset_all_stats()
+    rng = np.random.RandomState(0)
+    session_prompts = [
+        np.concatenate([rng.randint(0, VOCAB, size=(PFX,)),
+                        rng.randint(0, VOCAB, size=(TAIL,))])
+        .astype("int64") for _ in range(SESSIONS)]
+    orders = [list(range(SESSIONS))]          # cycle 0: registration
+    for _ in range(CYCLES - 1):
+        orders.append(list(rng.permutation(SESSIONS)))
+
+    def _engine(label, tier_on):
+        return serving.GenerationEngine(
+            net, max_slots=2, page_size=PAGE, num_pages=POOL,
+            prefill_buckets=(TAIL, PFX + TAIL), max_new_tokens=MAXN,
+            request_timeout_ms=0, prefix_cache=True,
+            prefix_cache_max_pages=BUDGET, kv_tier=tier_on,
+            kv_tier_host_bytes=1 << 30, kv_tier_chunk_pages=4,
+            name=f"bench_kvtier_{label}")
+
+    def _leak_free(eng):
+        """Zero leaked pages on BOTH tiers: every allocated HBM page is
+        cache-held, and the host tier's byte ledger reconciles exactly
+        with its stored entries."""
+        pages = eng.stats()["pages"]
+        ok = pages["pages_in_use"] == pages["cached_pages"]
+        if eng._tier is not None:
+            ok = ok and eng._tier.host_bytes == sum(
+                e.nbytes for e in eng._tier._entries.values())
+        return bool(ok)
+
+    def arm(label, tier_on):
+        eng = _engine(label, tier_on)
+        ledger0 = dict(eng._ledger)
+        ttfts, outs = [], {}
+        try:
+            for cycle, order in enumerate(orders):
+                for s in order:
+                    t0 = time.perf_counter()
+                    stream = eng.submit_stream(session_prompts[s],
+                                               max_new_tokens=MAXN)
+                    next(iter(stream))        # TTFT: first streamed token
+                    if cycle > 0:             # revisits only — the cold
+                        ttfts.append(         # first touch is identical
+                            (time.perf_counter() - t0) * 1e3)
+                    for _ in stream:
+                        pass
+                    outs[(cycle, s)] = np.asarray(
+                        stream.result(timeout=600))
+            live_compiles = {k: v for k, v in eng._ledger.items()
+                             if ledger0.get(k) != v}
+            pfx = eng.stats()["kv"]["prefix"]
+            stats = {
+                "prefix_hits": pfx["hits"],
+                "tier": (eng._tier.stats() if tier_on else None),
+                "tier_hit_rate": pfx["tier_hit_rate"],
+                "post_warmup_compiles": live_compiles,
+                "leak_free": _leak_free(eng),
+                "ledger": dict(eng._ledger),
+            }
+        finally:
+            eng.shutdown()
+        p50 = sorted(ttfts)[len(ttfts) // 2]
+        return p50, outs, stats
+
+    ttft_on, outs_on, stats_on = arm("on", True)
+    ttft_off, outs_off, stats_off = arm("off", False)
+    token_identical = (outs_on.keys() == outs_off.keys() and all(
+        np.array_equal(outs_on[k], outs_off[k]) for k in outs_on))
+    ttft_speedup = round(ttft_off / max(ttft_on, 1e-9), 3)
+    # greedy reference per session (any cycle of the off arm works —
+    # the fault arms below compare against these)
+    ref = {s: outs_off[(0, s)] for s in range(SESSIONS)}
+
+    def fault_arm(label, spec):
+        """One tier-on engine with `spec` armed for the whole run:
+        registration cycle + one revisit cycle, every output compared
+        to the fault-free reference, both tiers leak-checked."""
+        failpoints.reset()
+        prev = paddle.get_flags(["FLAGS_failpoints"])
+        paddle.set_flags({"FLAGS_failpoints": spec})
+        try:
+            eng = _engine(label, True)
+            identical = True
+            try:
+                for order in orders[:2]:
+                    for s in order:
+                        out = eng.generate(session_prompts[s],
+                                           max_new_tokens=MAXN)
+                        identical = identical and np.array_equal(
+                            out, ref[s])
+                tier = eng._tier.stats()
+                leak_free = _leak_free(eng)
+            finally:
+                eng.shutdown()
+            return {"token_identical": bool(identical),
+                    "tier": tier, "leak_free": leak_free}
+        finally:
+            paddle.set_flags(prev)
+            failpoints.reset()
+
+    promote_fault = fault_arm("pfault", "kv_tier.promote_upload@every:1")
+    gather_fault = fault_arm("gfault", "kv_tier.demote_gather@every:1")
+
+    extra = {
+        "sessions": SESSIONS,
+        "cycles": CYCLES,
+        "chain_pages": CHAIN_PAGES,
+        "prefix_budget_pages": BUDGET,
+        "pool_pages": POOL,
+        "ttft_p50_ms_tier_on": round(ttft_on, 2),
+        "ttft_p50_ms_tier_off": round(ttft_off, 2),
+        "ttft_speedup": ttft_speedup,
+        "token_identical_on_vs_off": token_identical,
+        "tier_on_arm": stats_on,
+        "tier_off_arm": stats_off,
+        "promote_fault_arm": promote_fault,
+        "gather_fault_arm": gather_fault,
+    }
+    return ttft_speedup, extra
+
+
 def bench_quant():
     """Quantized serving (ISSUE 9), three arms with regression gates:
 
@@ -2258,6 +2430,7 @@ def _run_mode(mode="train", backend=None):
                 "quant": "quant_generation_engine_tokens_per_sec",
                 "recovery": "recovery_goodput_tokens_per_sec",
                 "router": "router_affinity_ttft_p50_speedup",
+                "kvtier": "kvtier_promote_ttft_p50_speedup",
                 "coldstart": "coldstart_ttfst_speedup_warm_vs_cold"}\
         .get(mode, _HEADLINE)
     if mode == "input":
@@ -2546,6 +2719,69 @@ def _run_mode(mode="train", backend=None):
                   extra={"error": str(e)[:300]})
         return
 
+    if mode == "kvtier":
+        try:
+            speedup, extra = _with_retries(bench_kvtier)
+            _emit(headline, speedup, "x ttft p50 off/on", extra=extra)
+            if extra["ttft_speedup"] < 2.0:
+                sys.stderr.write(
+                    f"REGRESSION: host-tier promotion improves "
+                    f"evicted-chain revisit TTFT p50 only "
+                    f"{extra['ttft_speedup']}x over cold re-prefill at "
+                    f"equal HBM bytes — below the 2x acceptance "
+                    f"floor\n")
+            t = extra["tier_on_arm"]["tier"]
+            if not t or t["promotions"] < 1 or t["demotions"] < 1:
+                sys.stderr.write(
+                    f"REGRESSION: the tier-on arm recorded "
+                    f"demotions={t and t['demotions']}, promotions="
+                    f"{t and t['promotions']} — the bench never "
+                    f"exercised the cross-tier path it gates\n")
+            if not extra["token_identical_on_vs_off"]:
+                sys.stderr.write(
+                    "REGRESSION: greedy output differs tier-on vs "
+                    "tier-off — a promoted chain must decode exactly "
+                    "like a never-evicted one (raw bytes + scale rows "
+                    "round-trip)\n")
+            if extra["tier_on_arm"]["post_warmup_compiles"] \
+                    or extra["tier_off_arm"]["post_warmup_compiles"]:
+                sys.stderr.write(
+                    f"REGRESSION: a kvtier arm compiled after warmup "
+                    f"(on={extra['tier_on_arm']['post_warmup_compiles']}"
+                    f", off="
+                    f"{extra['tier_off_arm']['post_warmup_compiles']}) "
+                    f"— promotions must ride the warmed tier_gather/"
+                    f"tier_write programs\n")
+            if not extra["tier_on_arm"]["leak_free"] \
+                    or not extra["tier_off_arm"]["leak_free"]:
+                sys.stderr.write(
+                    "REGRESSION: leaked pages after the kvtier arms "
+                    "drained — HBM pages or host-tier bytes do not "
+                    "reconcile\n")
+            pf, gf = extra["promote_fault_arm"], extra["gather_fault_arm"]
+            if not pf["token_identical"] or pf["tier"]["abandons"] < 1 \
+                    or not pf["leak_free"]:
+                sys.stderr.write(
+                    f"REGRESSION: promote_upload failpoint arm — "
+                    f"identical={pf['token_identical']}, abandons="
+                    f"{pf['tier']['abandons']}, leak_free="
+                    f"{pf['leak_free']}; an abandoned promotion must "
+                    f"fall back to cold prefill with zero leaks\n")
+            if not gf["token_identical"] or gf["tier"]["demotions"] != 0 \
+                    or gf["tier"]["entries"] != 0 or not gf["leak_free"]:
+                sys.stderr.write(
+                    f"REGRESSION: demote_gather failpoint arm — "
+                    f"identical={gf['token_identical']}, demotions="
+                    f"{gf['tier']['demotions']}, entries="
+                    f"{gf['tier']['entries']}, leak_free="
+                    f"{gf['leak_free']}; a failed gather must degrade "
+                    f"to the plain eviction with an empty tier\n")
+        except Exception as e:  # noqa: BLE001
+            traceback.print_exc()
+            _emit(headline, 0.0, "x ttft p50 off/on",
+                  extra={"error": str(e)[:300]})
+        return
+
     if mode == "coldstart":
         try:
             speedup, extra = _with_retries(bench_coldstart)
@@ -2715,7 +2951,8 @@ if __name__ == "__main__":
     ap = argparse.ArgumentParser(description=__doc__)
     ap.add_argument("--mode", choices=("train", "serving", "input",
                                        "packing", "generation", "quant",
-                                       "recovery", "router", "coldstart"),
+                                       "recovery", "router", "kvtier",
+                                       "coldstart"),
                     default="train",
                     help="train: the round training configs (default); "
                          "serving: multi-lane InferenceEngine qps/latency/"
@@ -2759,6 +2996,14 @@ if __name__ == "__main__":
                          "plus a one-replica-kill arm (zero requests "
                          "lost, token-identical to fault-free, one "
                          "restart, ledgers embedded); "
+                         "kvtier: tiered KV cache (ISSUE 18) — "
+                         "host-RAM demotion under the prefix cache, "
+                         "tier-on vs tier-off revisit TTFT p50 at "
+                         "equal HBM bytes (2x floor, token-identical, "
+                         "zero post-warmup compiles, zero leaked "
+                         "pages on both tiers) plus both failpoint "
+                         "arms (abandoned promotion falls back cold; "
+                         "failed gather degrades to plain eviction); "
                          "coldstart: warm start via the program store "
                          "(ISSUE 16) — time-to-first-served-token for "
                          "a fresh engine, cold (empty store) vs warm "

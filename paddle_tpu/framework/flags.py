@@ -154,6 +154,30 @@ register_flag("FLAGS_gen_prefix_cache_max_pages", 0,
               "EVICT_PREFIX_BUDGET) instead of waiting for an "
               "admission to run short of free pages. 0 = unbounded "
               "(evict-on-demand only, the ISSUE 12 behavior)")
+register_flag("FLAGS_kv_tier", False,
+              "serving.GenerationEngine: host-RAM demotion tier under "
+              "the prefix cache (serving/kv_tier.py) — prefix-cache "
+              "eviction demotes a cold chain's pages off-device into a "
+              "bounded host store (raw int8 bytes + fp32 scale rows, so "
+              "the round-trip is exact) instead of discarding them, and "
+              "a later lookup that misses HBM but hits the host tier "
+              "re-uploads the pages through a double-buffered "
+              "device_put pipeline overlapped with the tail prefill. "
+              "Requires FLAGS_gen_prefix_cache; off keeps the PR 12 "
+              "two-state (HBM or gone) semantics exactly")
+register_flag("FLAGS_kv_tier_host_bytes", 256 << 20,
+              "serving/kv_tier.py host-store byte budget: demoted page "
+              "entries beyond it are LRU-evicted (demote-of-demoted = "
+              "final eviction, audit code KV_TIER_EVICT); an entry "
+              "that alone exceeds the budget is refused and the "
+              "eviction proceeds plain")
+register_flag("FLAGS_kv_tier_chunk_pages", 4,
+              "pages per upload chunk of the promotion pipeline "
+              "(serving/kv_tier.py): the engine device_put-stages chunk "
+              "i+1 while chunk i's jitted scatter is in flight — the "
+              "double-buffer depth knob, and the fixed width of the ONE "
+              "compiled tier_write program (trace-shaping: part of the "
+              "program-store content key)")
 register_flag("FLAGS_gen_program_store_dir", "",
               "serving.GenerationEngine: root directory of the on-disk "
               "AOT executable store (serving/program_store.py) — warmup "
@@ -194,7 +218,8 @@ register_flag("FLAGS_failpoints", "",
               "`N` (fire on the Nth hit only) or `every:K` (every Kth "
               "hit) and arg is a site-specific number (slow_step_ms "
               "sleep). Sites: decode_step_raise, prefill_raise, "
-              "decode_poison_nan, alloc_exhaust, slow_step_ms. '' "
+              "decode_poison_nan, alloc_exhaust, slow_step_ms, "
+              "kv_tier.promote_upload, kv_tier.demote_gather. '' "
               "disables injection entirely (the zero-cost no-op path)")
 register_flag("FLAGS_gen_retry_limit", 2,
               "serving.EngineSupervisor: per-request replay budget — a "

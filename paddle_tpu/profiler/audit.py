@@ -97,6 +97,20 @@ REASONS = frozenset({
     "ROUTE_REROUTE",       # placement failed typed on the chosen
                            # replica (breaker/shutdown/overload); the
                            # router retried the next-best replica
+    "KV_DEMOTE",           # prefix-cache eviction demoted a chain
+                           # page's content to the host tier instead of
+                           # discarding it (ISSUE 18; detail: pages)
+    "KV_PROMOTE",          # admission re-uploaded a host-tier chain
+                           # run to HBM, overlapped with the tail
+                           # prefill (detail: pages, tokens)
+    "KV_TIER_EVICT",       # host-tier entries finally dropped — LRU
+                           # byte-budget pressure or a cascade drop of
+                           # orphaned descendants (demote-of-demoted =
+                           # final eviction; detail: entries)
+    "KV_PROMOTE_ABANDON",  # promotion abandoned mid-upload (fault /
+                           # request expiry): written target pages
+                           # zeroed, admission fell back to cold
+                           # prefill — no leak on either tier
 })
 
 _CAP = 2048   # per-engine ring bound (≈ a few minutes of decisions)

@@ -42,6 +42,10 @@ oldest overwritten — the same bounding discipline as the trace rings):
                   wall spent in prefill jit calls vs the decode step
                   this iteration — the "is one long prompt spiking
                   everyone's TPOT" signal
+    tier_demotions / tier_promotions
+                  prefix-cache pages demoted to / promoted back from
+                  the host-RAM tier THIS iteration (ISSUE 18 — the
+                  cross-tier traffic signal)
 
 The ring is exported three ways: `/steps` JSON
 (`steps_payload()` — per-engine records + audit-log tail, the input of
@@ -78,7 +82,10 @@ _FIELDS = ("it", "step", "t", "live", "admitted", "completed", "expired",
            # ordinal) recorded this iteration — appended after the
            # older fields so ring consumers reading by name with
            # defaults parse records from every era unchanged
-           "incarnation")
+           "incarnation",
+           # ISSUE 18: prefix-cache pages demoted to / promoted from
+           # the host tier THIS iteration (same era-compat appending)
+           "tier_demotions", "tier_promotions")
 
 
 def enabled() -> bool:

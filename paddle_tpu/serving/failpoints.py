@@ -34,6 +34,13 @@ Sites (`SITES`):
   as exhausted (DEFER_PAGES without actually draining it).
 - `slow_step_ms`     — sleep `arg` ms at the top of the step (SLO /
   burn-rate exercises).
+- `kv_tier.promote_upload` — abandon a host-tier promotion before the
+  next upload chunk's dispatch (ISSUE 18): the admission zeroes the
+  partially-written target pages and falls back to cold prefill. Fired
+  BEFORE the donating scatter, so no pool is ever half-consumed.
+- `kv_tier.demote_gather` — fail the off-device page gather at
+  demotion time: the eviction proceeds plain (content discarded), the
+  PR 12 behavior exactly — no leak on either tier.
 
 Cost discipline: with `FLAGS_failpoints` unset (the default, and every
 production deployment), `fire()` is one flag read + one emptiness check
@@ -54,7 +61,8 @@ __all__ = ["SITES", "InjectedFault", "fire", "maybe_raise", "reset",
            "snapshot"]
 
 SITES = ("decode_step_raise", "prefill_raise", "decode_poison_nan",
-         "alloc_exhaust", "slow_step_ms")
+         "alloc_exhaust", "slow_step_ms", "kv_tier.promote_upload",
+         "kv_tier.demote_gather")
 
 
 class InjectedFault(RuntimeError):

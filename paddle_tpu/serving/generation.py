@@ -109,6 +109,7 @@ from ..profiler import (RecordEvent, audit, device_telemetry, exporter,
                         flight_recorder, slo, spans, step_log)
 from . import failpoints
 from .kv_cache import TRASH_PAGE, PagedKVCache
+from .kv_tier import HostTier
 from .prefix_cache import PrefixCache
 from .spec_decode import NGramProposer
 
@@ -145,6 +146,9 @@ class GenerationConfig:
                  spec_k: Optional[int] = None,
                  spec_ngram: Optional[int] = None,
                  prefill_chunk: Optional[int] = None,
+                 kv_tier: Optional[bool] = None,
+                 kv_tier_host_bytes: Optional[int] = None,
+                 kv_tier_chunk_pages: Optional[int] = None,
                  program_store: Optional[str] = None,
                  program_store_force: Optional[bool] = None,
                  top_k: int = 0, seed: int = 0, warmup: bool = True):
@@ -205,6 +209,28 @@ class GenerationConfig:
         if self.prefill_chunk < 0:
             raise InvalidArgumentError(
                 "prefill_chunk must be >= 0 (0 = whole-prompt prefill)")
+        # tiered KV cache (ISSUE 18): host-RAM demotion tier under the
+        # prefix cache — demoted chains re-upload instead of
+        # re-prefilling. The tier is a prefix-cache extension: without
+        # the chain index there is nothing to demote or promote.
+        self.kv_tier = bool(flag("FLAGS_kv_tier")
+                            if kv_tier is None else kv_tier)
+        if self.kv_tier and not self.prefix_cache:
+            raise InvalidArgumentError(
+                "kv_tier requires prefix_cache (the host tier demotes "
+                "prefix-cache chains; enable FLAGS_gen_prefix_cache)")
+        self.kv_tier_host_bytes = int(
+            flag("FLAGS_kv_tier_host_bytes")
+            if kv_tier_host_bytes is None else kv_tier_host_bytes)
+        if self.kv_tier and self.kv_tier_host_bytes < 1:
+            raise InvalidArgumentError(
+                "kv_tier_host_bytes must be >= 1 when kv_tier is on")
+        self.kv_tier_chunk_pages = int(
+            flag("FLAGS_kv_tier_chunk_pages")
+            if kv_tier_chunk_pages is None else kv_tier_chunk_pages)
+        if self.kv_tier and self.kv_tier_chunk_pages < 1:
+            raise InvalidArgumentError(
+                "kv_tier_chunk_pages must be >= 1 when kv_tier is on")
         # warm start (ISSUE 16): root of the on-disk AOT executable
         # store; None/"" = off (device.program_store_dir resolves the
         # flag default). force engages the store even where
@@ -412,10 +438,12 @@ class _ProgramPack:
     loads."""
 
     __slots__ = ("ledger", "loaded", "execs", "prefill", "tail",
-                 "decode", "verify", "zero", "cow", "npool", "W")
+                 "decode", "verify", "zero", "cow", "npool", "W",
+                 "tier_gather", "tier_write")
 
     def __init__(self, ledger, prefill, tail, decode, verify, zero, cow,
-                 npool, W, loaded=None, execs=None):
+                 npool, W, loaded=None, execs=None, tier_gather=None,
+                 tier_write=None):
         self.ledger = ledger
         self.loaded = {} if loaded is None else loaded
         self.execs = {} if execs is None else execs
@@ -427,6 +455,10 @@ class _ProgramPack:
         self.cow = cow
         self.npool = npool
         self.W = W
+        # tiered KV cache (ISSUE 18): ride the pack like every other
+        # wrapper, or a supervised restart would retrace them
+        self.tier_gather = tier_gather
+        self.tier_write = tier_write
 
 
 class GenerationEngine:
@@ -532,6 +564,13 @@ class GenerationEngine:
             self._cache, name,
             max_pages=self._cfg.prefix_cache_max_pages)
             if self._cfg.prefix_cache else None)
+        # tiered KV cache (ISSUE 18): bounded host-RAM store the prefix
+        # cache demotes cold chains into instead of discarding them —
+        # attach_tier (below, once the audit ring exists) wires the
+        # demote-gather and audit hooks
+        self._tier = (HostTier(self._cfg.kv_tier_host_bytes, name)
+                      if (self._cfg.kv_tier and self._prefix is not None)
+                      else None)
         # chunked prefill (ISSUE 14): chunks ride the per-bucket tail
         # programs, so a chunk can never be wider than the largest
         # bucket; 0 keeps whole-prompt prefill at admission
@@ -592,6 +631,11 @@ class GenerationEngine:
         # the previous incarnation's rings: the restart's own events
         # land in the SAME postmortem trail as the death that caused it
         self._audit = carry.get("audit") or audit.AuditLog(name)
+        if self._tier is not None:
+            # demote-on-evict (ISSUE 18): evictions now gather page
+            # content off-device into the host store before freeing HBM
+            self._prefix.attach_tier(self._tier, self._tier_gather_page,
+                                     audit=self._audit)
         self._step_log = carry.get("step_log") or (
             step_log.StepLog(name) if step_log.enabled() else None)
         if carry.get("step_log") is not None:
@@ -599,6 +643,10 @@ class GenerationEngine:
             # error path unregisters it, and the retry must restore it
             step_log.register(self._step_log)
         self._iters = 0
+        # last-seen cumulative tier counters — _record_iteration takes
+        # deltas so the step ring carries per-iteration demote/promote
+        # counts without a second bookkeeping path
+        self._tier_counts = (0, 0)
         self._it = {"admitted": 0, "completed": 0, "expired": 0,
                     "poisoned": 0, "aborted": 0, "freed": 0,
                     "prefix_tokens": 0, "cow_splits": 0,
@@ -668,6 +716,8 @@ class GenerationEngine:
             self._verify_jit = pack.verify
             self._zero_jit = pack.zero
             self._cow_jit = pack.cow
+            self._tier_gather_jit = pack.tier_gather
+            self._tier_write_jit = pack.tier_write
             # ISSUE 16: adopt the resolved AOT executables + the load
             # ledger too — a resurrection of a store-started engine
             # re-warms through `execs` directly: zero traces AND zero
@@ -952,6 +1002,46 @@ class GenerationEngine:
             return (kp.at[:, :, pages].set(0.0),
                     vp.at[:, :, pages].set(0.0))
 
+        def tier_gather_fn(*rest):
+            """Demotion gather (ISSUE 18): copy ONE page's raw blocks —
+            and, in the int8 mode, its per-(layer, head) scale rows —
+            out of the pools for the host tier. NON-donating by
+            contract: the pools are kept (the content is being copied
+            off-device, the page frees through the ordinary eviction
+            path right after), which is also why this program can never
+            ride the program store — `_selfcheck_alias` requires every
+            covered program to donate its pools."""
+            pools, page = rest[:NP], rest[NP]
+            note("tier_gather")
+            if quant:
+                kp, vp, ksc, vsc = pools
+                return (kp[:, :, page], vp[:, :, page],
+                        ksc[:, :, page], vsc[:, :, page])
+            kp, vp = pools
+            return (kp[:, :, page], vp[:, :, page])
+
+        def tier_write_fn(*rest):
+            """Promotion scatter (ISSUE 18): write one fixed-width
+            chunk of host-tier pages — raw content, raw int8 scale rows
+            — into the admission's fresh target pages. Pad rows route
+            to the reserved scratch page with zero content, the
+            standard pad contract, so the ONE compiled width
+            (kv_tier_chunk_pages) covers every promotion length with
+            zero retraces."""
+            pools = rest[:NP]
+            note(f"tier_write[w={rest[NP].shape[0]}]")
+            if quant:
+                pages, kb, vb, ksb, vsb = rest[NP:]
+                kp, vp, ksc, vsc = pools
+                return (kp.at[:, :, pages].set(jnp.moveaxis(kb, 0, 2)),
+                        vp.at[:, :, pages].set(jnp.moveaxis(vb, 0, 2)),
+                        ksc.at[:, :, pages].set(jnp.moveaxis(ksb, 0, 2)),
+                        vsc.at[:, :, pages].set(jnp.moveaxis(vsb, 0, 2)))
+            pages, kb, vb = rest[NP:]
+            kp, vp = pools
+            return (kp.at[:, :, pages].set(jnp.moveaxis(kb, 0, 2)),
+                    vp.at[:, :, pages].set(jnp.moveaxis(vb, 0, 2)))
+
         donate = tuple(range(1, 1 + NP))
         self._prefill_jit = jax.jit(prefill_fn, donate_argnums=donate)
         self._tail_jit = jax.jit(tail_prefill_fn, donate_argnums=donate)
@@ -961,6 +1051,11 @@ class GenerationEngine:
         self._zero_jit = jax.jit(zero_fn,
                                  donate_argnums=tuple(range(NP)))
         self._cow_jit = jax.jit(cow_fn, donate_argnums=tuple(range(NP)))
+        self._tier_gather_jit = (jax.jit(tier_gather_fn)
+                                 if self._tier is not None else None)
+        self._tier_write_jit = (
+            jax.jit(tier_write_fn, donate_argnums=tuple(range(NP)))
+            if self._tier is not None else None)
         # warm start (ISSUE 16): resolved AOT executables by program
         # name (ledger keys) + the store-load ledger; warmup fills them
         self._execs = {}
@@ -978,7 +1073,9 @@ class GenerationEngine:
             tail=self._tail_jit, decode=self._decode_jit,
             verify=self._verify_jit, zero=self._zero_jit,
             cow=self._cow_jit, npool=self._npool, W=self._W,
-            loaded=self._loaded, execs=self._execs)
+            loaded=self._loaded, execs=self._execs,
+            tier_gather=self._tier_gather_jit,
+            tier_write=self._tier_write_jit)
 
     def _store_key_material(self) -> dict:
         """Everything that shapes the traced programs, JSON-able — the
@@ -1005,6 +1102,8 @@ class GenerationEngine:
                 "quant_kv": bool(self._quant_kv),
                 "use_tail": bool(self._use_tail),
                 "prefix_cache": self._prefix is not None,
+                "kv_tier": self._tier is not None,
+                "kv_tier_chunk_pages": self._cfg.kv_tier_chunk_pages,
                 "spec_k": self._spec_k,
                 "top_k": self._cfg.top_k,
             },
@@ -1067,6 +1166,101 @@ class GenerationEngine:
             fn = self._prog("cow_copy", self._cow_jit)
             self._set_pools(fn(*self._pools(), np.int32(src),
                                np.int32(dst)))
+
+    # -- host tier (ISSUE 18) ----------------------------------------------
+
+    def _tier_gather_page(self, page: int):
+        """Demotion gather callback (`PrefixCache.attach_tier`): one
+        page's raw blocks off-device as host numpy — (k, v, ks, vs),
+        scale rows None outside the int8 mode. None = gather failed
+        (the `kv_tier.demote_gather` failpoint): the eviction proceeds
+        plain, content discarded — the PR 12 behavior exactly."""
+        if failpoints.fire("kv_tier.demote_gather") is not None:
+            return None
+        with self._dev_ctx():
+            out = self._tier_gather_jit(*self._pools(), np.int32(page))
+        if self._quant_kv:
+            return tuple(np.asarray(o) for o in out)
+        return (np.asarray(out[0]), np.asarray(out[1]), None, None)
+
+    def _promote_upload(self, req: _GenRequest, host_digests,
+                        matched_hbm: int) -> bool:
+        """Re-upload an admission's matched host-tier run into its own
+        fresh target pages (`pt_row[matched_hbm:]`), double-buffered:
+        chunk i+1's `jax.device_put` staging overlaps chunk i's (async)
+        tier_write dispatch, and nothing here syncs the host — the tail
+        prefill queues behind the uploads on the device stream, which
+        is how the promotion hides behind prefill instead of adding to
+        TTFT. Returns True on success, False on abandon.
+
+        Abandon (the `kv_tier.promote_upload` failpoint, checked BEFORE
+        each chunk's donating dispatch so no pool is ever
+        half-consumed): the target pages written so far are zeroed —
+        content AND int8 scale grids, essential because the tail
+        prefill's requant write would otherwise merge junk scales into
+        a grid that only ever widens — the never-written tail is
+        already zero (fresh pages arrive zeroed), and the caller falls
+        back to cold-prefilling the whole suffix. The popped host
+        entries are gone either way: move semantics, one copy ever."""
+        import jax
+        C = self._cfg.kv_tier_chunk_pages
+        n = len(host_digests)
+        targets = [int(p) for p in
+                   req.pt_row[matched_hbm:matched_hbm + n]]
+        entries, cascaded = self._prefix.consume_promoted(host_digests)
+        if cascaded:
+            self._audit.audit("KV_TIER_EVICT", rid=req.rid,
+                              entries=cascaded)
+        if any(e is None for e in entries):
+            # defensive: protect() held these across the eviction pass,
+            # so a missing entry is a logic fault — abandon cleanly
+            # (nothing written yet) rather than upload garbage
+            self._tier.note_abandon()
+            self._audit.audit("KV_PROMOTE_ABANDON", rid=req.rid,
+                              pages=n, written=0)
+            return False
+
+        def stage(lo: int):
+            hi = min(lo + C, n)
+            row = np.full((C,), TRASH_PAGE, np.int32)
+            row[:hi - lo] = targets[lo:hi]
+            e0 = entries[0]
+            blocks = [np.zeros((C,) + e0.k.shape, e0.k.dtype),
+                      np.zeros((C,) + e0.v.shape, e0.v.dtype)]
+            if self._quant_kv:
+                blocks += [np.zeros((C,) + e0.ks.shape, e0.ks.dtype),
+                           np.zeros((C,) + e0.vs.shape, e0.vs.dtype)]
+            for j in range(lo, hi):
+                blocks[0][j - lo] = entries[j].k
+                blocks[1][j - lo] = entries[j].v
+                if self._quant_kv:
+                    blocks[2][j - lo] = entries[j].ks
+                    blocks[3][j - lo] = entries[j].vs
+            with self._dev_ctx():
+                return [jax.device_put(a) for a in [row] + blocks]
+
+        t0 = _now_ms()
+        written = 0
+        staged = stage(0)
+        while written < n:
+            if failpoints.fire("kv_tier.promote_upload") is not None:
+                self._zero_pages(targets[:written])
+                self._tier.note_abandon()
+                self._audit.audit("KV_PROMOTE_ABANDON", rid=req.rid,
+                                  pages=n, written=written)
+                return False
+            nxt = stage(written + C) if written + C < n else None
+            with RecordEvent(f"generation::tier_write[w={C}]"):
+                with self._dev_ctx():
+                    self._set_pools(self._tier_write_jit(
+                        *self._pools(), *staged))
+            written = min(written + C, n)
+            staged = nxt
+        self._tier.note_promotion(n)
+        self._audit.audit("KV_PROMOTE", rid=req.rid, pages=n,
+                          tokens=n * self._cfg.page_size,
+                          ms=round(_now_ms() - t0, 3))
+        return True
 
     # -- program-store warmup seam (ISSUE 16) ------------------------------
 
@@ -1208,6 +1402,27 @@ class GenerationEngine:
                         lambda: (*self._pools(), np.int32(TRASH_PAGE),
                                  np.int32(TRASH_PAGE)))
                 self._set_pools(out)
+            if self._tier is not None:
+                # tier programs (ISSUE 18) warm OUTSIDE the program
+                # store: tier_gather keeps its pools (non-donating —
+                # it copies a page out), so it can never satisfy the
+                # store's every-covered-program-donates aliasing
+                # self-check; both compile live against the jit
+                # wrappers instead (the wrappers ride the pack, so a
+                # supervised restart still re-warms from cache with
+                # zero new traces)
+                with self._dev_ctx():
+                    g = self._tier_gather_jit(*self._pools(),
+                                              np.int32(TRASH_PAGE))
+                blocks = [np.asarray(b) for b in g]
+                C = self._cfg.kv_tier_chunk_pages
+                row = np.full((C,), TRASH_PAGE, np.int32)
+                args = [row] + [np.zeros((C,) + b.shape, b.dtype)
+                                for b in blocks]
+                with self._dev_ctx():
+                    # lint: allow(use-after-donate): donate covers only the NP pool args in the *splat; row/blocks ride AFTER them, read-only
+                    self._set_pools(self._tier_write_jit(*self._pools(),
+                                                         *args))
             if self._spec_k:
                 # speculation replaces the decode program outright: the
                 # engine's ledger shows ONE verify[k] trace and no
@@ -1513,6 +1728,15 @@ class GenerationEngine:
             oldest = (self._queue[0].t_enqueue_ms if self._queue
                       else None)
             live = self._num_active()
+        # host-tier activity this iteration (ISSUE 18): deltas of the
+        # tier's cumulative counters — one bookkeeping path, no second
+        # per-iteration dict to zero
+        tier_dem = tier_pro = 0
+        if self._tier is not None:
+            d, p = self._tier.demotions, self._tier.promotions
+            ld, lp = self._tier_counts
+            tier_dem, tier_pro = d - ld, p - lp
+            self._tier_counts = (d, p)
         rec = step_log.StepRecord(
             it=self._iters, step=self._steps_total,
             t=time.perf_counter(), live=live,
@@ -1532,7 +1756,8 @@ class GenerationEngine:
             prefill_chunks=it["prefill_chunks"],
             prefill_ms=round(it["prefill_ms"], 3),
             decode_ms=round(it["decode_ms"], 3),
-            incarnation=self.incarnation)
+            incarnation=self.incarnation,
+            tier_demotions=tier_dem, tier_promotions=tier_pro)
         self._step_log.record(rec)
 
     def _resolve_later(self, req: Optional[_GenRequest], fut,
@@ -1717,20 +1942,42 @@ class GenerationEngine:
                 # logits, so the page holding position S-1 is CoW-split
                 # (the one divergent write) — tail length stays >= 1
                 # either way, there is always a token to prefill
-                digests, hit_pages = ([], [])
+                digests, hit_pages, host_digests = [], [], []
                 if self._prefix is not None:
-                    digests, hit_pages = self._prefix.lookup(req.prompt)
-                matched = len(hit_pages)
+                    if self._tier is not None:
+                        digests, hit_pages, host_digests = \
+                            self._prefix.lookup_tiered(req.prompt)
+                    else:
+                        digests, hit_pages = self._prefix.lookup(
+                            req.prompt)
+                matched_hbm = len(hit_pages)
+                promote_n = len(host_digests)
+                matched = matched_hbm + promote_n
                 full_match = (matched > 0
                               and matched * self._cfg.page_size == S)
-                fresh_needed = need - matched + (1 if full_match else 0)
-                pinned = bool(matched)
+                # a full match whose tail comes up from the host tier
+                # needs NO CoW: position S-1's recompute writes into
+                # the LAST promoted page, which is this request's own
+                # fresh target — private until register() re-indexes it
+                cow_needed = full_match and promote_n == 0
+                # promotion targets are fresh pages too, so the
+                # admission arithmetic counts in-flight promotions
+                # naturally: (need - matched) suffix pages + promote_n
+                # targets = need - matched_hbm
+                fresh_needed = (need - matched_hbm
+                                + (1 if cow_needed else 0))
+                pinned = bool(matched_hbm)
                 if pinned:
                     # hold the matched chain across the eviction pass:
                     # refcount >= 2 takes its pages out of the
                     # evictable set, so the eviction below can never
                     # reclaim the very pages this admission maps
                     self._cache.pin(hit_pages)
+                if promote_n:
+                    # the SAME eviction pass may demote victims INTO
+                    # the tier — shield the matched host run from its
+                    # LRU until the promotion consumes it
+                    self._prefix.protect(host_digests)
                 try:
                     # alloc_exhaust failpoint: force the exhaustion
                     # verdict without draining the pool — the DEFER /
@@ -1810,8 +2057,10 @@ class GenerationEngine:
                 finally:
                     if pinned:
                         self._cache.unpin(hit_pages)
+                    if promote_n:
+                        self._prefix.unprotect()
                 cow_src = cow_dst = None
-                if full_match:
+                if cow_needed:
                     cow_src = hit_pages[-1]
                     cow_dst = self._cache.cow_split(req.rid, cow_src)
                     req.pt_row[matched - 1] = cow_dst
@@ -1819,11 +2068,6 @@ class GenerationEngine:
                     self._it["cow_splits"] += 1
                     self._audit.audit("COW_SPLIT", rid=req.rid,
                                       src_page=cow_src, dst_page=cow_dst)
-                req.prefix_tokens = ((S - 1) if full_match
-                                     else matched * self._cfg.page_size)
-                if self._prefix is not None:
-                    self._prefix.note_admitted(req.prefix_tokens)
-                self._it["prefix_tokens"] += req.prefix_tokens
                 self._slots[slot] = req
                 self._it["admitted"] += 1
                 if self._admit_clamped:
@@ -1831,26 +2075,46 @@ class GenerationEngine:
                     # exhaustion episode is over, lift the clamp
                     self._admit_clamped = False
                     self._exhaust_times.clear()
-                if matched:
-                    self._audit.audit(
-                        "ADMIT_PREFIX_HIT", rid=req.rid, slot=slot,
-                        pages=need, shared_pages=matched,
-                        prefix_tokens=req.prefix_tokens,
-                        queued_ms=round(_now_ms() - req.t_enqueue_ms, 3))
-                else:
-                    self._audit.audit(
-                        "ADMIT", rid=req.rid, slot=slot, pages=need,
-                        queued_ms=round(_now_ms() - req.t_enqueue_ms, 3))
-                if req.span is not None:
-                    req.span.slot = slot
-                    req.span.prefix_tokens = req.prefix_tokens
-                    req.span.stamp("admitted")
             if cow_dst is not None:
                 # clone the shared page (content + int8 scale row)
                 # before the tail prefill writes position S-1 through
                 # the private copy; the shared original is never
                 # written under its other readers
                 self._cow_copy(cow_src, cow_dst)
+            if promote_n:
+                # host-tier promotion (ISSUE 18) — outside the lock
+                # like the CoW clone: device traffic must not stall
+                # submitters. On abandon the match shrinks back to the
+                # HBM run and the tail prefill covers the rest cold.
+                if not self._promote_upload(req, host_digests,
+                                            matched_hbm):
+                    matched, full_match = matched_hbm, False
+            # the admission accounting lands AFTER the promotion
+            # resolved (step-thread-local state — safe off the lock):
+            # an abandon must not count host pages it never served
+            req.prefix_tokens = ((S - 1) if full_match
+                                 else matched * self._cfg.page_size)
+            if self._prefix is not None:
+                self._prefix.note_admitted(
+                    req.prefix_tokens,
+                    host_tokens=((matched - matched_hbm)
+                                 * self._cfg.page_size))
+            self._it["prefix_tokens"] += req.prefix_tokens
+            if matched:
+                self._audit.audit(
+                    "ADMIT_PREFIX_HIT", rid=req.rid, slot=slot,
+                    pages=need, shared_pages=matched_hbm,
+                    promoted_pages=matched - matched_hbm,
+                    prefix_tokens=req.prefix_tokens,
+                    queued_ms=round(_now_ms() - req.t_enqueue_ms, 3))
+            else:
+                self._audit.audit(
+                    "ADMIT", rid=req.rid, slot=slot, pages=need,
+                    queued_ms=round(_now_ms() - req.t_enqueue_ms, 3))
+            if req.span is not None:
+                req.span.slot = slot
+                req.span.prefix_tokens = req.prefix_tokens
+                req.span.stamp("admitted")
             chunk = self._cfg.prefill_chunk
             if chunk and S - req.prefix_tokens > chunk:
                 # chunked prefill (ISSUE 14): the slot is admitted NOW
@@ -2620,7 +2884,7 @@ class GenerationEngine:
         handoff `pressure()` reads."""
         shapes = sorted({b + self._cfg.max_new_tokens
                          for b in self._cfg.prefill_buckets})
-        return {
+        snap = {
             "headroom": {str(t): n for t, n in sorted(
                 self._cache.headroom(shapes).items())},
             "free_pages": self._cache.free_pages,
@@ -2628,6 +2892,20 @@ class GenerationEngine:
             "slots_free": sum(1 for r in self._slots if r is None),
             "live": self._num_active(),
         }
+        if self._tier is not None:
+            # host-tier surface (ISSUE 18): the router folds the tier
+            # hit-rate into placement the same way the headroom fields
+            # feed least-pressure — a replica resurrecting prefixes
+            # from host RAM is cheaper than one prefilling them cold
+            snap["tier"] = {
+                "host_bytes": self._tier.host_bytes,
+                "entries": len(self._tier),
+                "hit_rate": round(
+                    self._tier.hits
+                    / max(1, self._prefix.hits + self._prefix.misses),
+                    4),
+            }
+        return snap
 
     def pressure(self) -> dict:
         """Cheap per-replica pressure snapshot for the router tier

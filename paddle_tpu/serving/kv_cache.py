@@ -144,6 +144,17 @@ class PagedKVCache:
             b += 2 * num_layers * num_heads * 4  # fp32 scale per (L,H)
         return b
 
+    def page_host_bytes(self) -> int:
+        """Host-RAM bytes ONE page costs demoted into the kv_tier
+        store: the raw K/V page blocks in the pool dtype plus (int8
+        mode) the fp32 scale rows — identical arithmetic to
+        `page_hbm_bytes`, because the tier stores the bytes RAW (no
+        transcoding; that is the cross-tier exactness guarantee). The
+        tier byte-budget / working-set sizing unit (ISSUE 18)."""
+        return self.page_hbm_bytes(self.num_layers, self.num_heads,
+                                   self.head_dim, self.page_size,
+                                   self.dtype)
+
     @classmethod
     def pages_for_budget(cls, budget_bytes: int, *, num_layers: int,
                          num_heads: int, head_dim: int, page_size: int,

@@ -1,0 +1,210 @@
+"""Host-RAM demotion tier under the prefix cache (ISSUE 18).
+
+HBM is the scarcest resource in the system, and before this tier a
+prefix-cache page was binary: resident in HBM or zeroed and gone —
+every eviction converted a future TTFT win back into a full prefill.
+This module is the second tier the paper's own memory design calls for
+(the L1 allocator tiers / CUDAPinnedPlace staging path whose
+device-bound half PR 1's DeviceFeeder double-buffer reproduced): when
+`PrefixCache` eviction would free a cold chain's pages, the engine
+*demotes* them instead — a jitted gather pulls the raw page blocks
+(and, in int8 mode, the per-(layer, head) fp32 scale rows) off-device
+into this bounded host store, keyed by the chain's blake2b digests,
+and the HBM pages are zeroed-and-freed exactly as before. A later
+lookup that misses HBM but hits here *promotes*: the pages re-upload
+through a double-buffered `jax.device_put` pipeline overlapped with
+the tail prefill of the uncovered suffix (the DeviceFeeder pattern
+pointed the other way), so a revisit costs ~one tail prefill instead
+of a full re-prefill.
+
+Contents are stored RAW — int8 pages keep their integer bytes and
+their fp32 scale rows side by side — so a promote re-uploads
+bit-identical content with no requantization step. That is the whole
+token-identity guarantee: a promoted chain decodes exactly like a
+never-evicted one (the PR 9 scale-grid poisoning class, now across
+tiers; see tests/test_kv_tier.py).
+
+Budget: the tier owns its own byte budget (`FLAGS_kv_tier_host_bytes`)
+with LRU eviction — demote-of-demoted is the final eviction, the
+entry's content is gone for good (audit code KV_TIER_EVICT). `put`
+returns the evicted digests so the `PrefixCache` can drop the
+corresponding host-state chain nodes in the same step; an entry that
+alone exceeds the budget is refused outright (stored nowhere, plain
+eviction semantics apply upstream).
+
+Threading: single-writer like the allocator and the prefix index — the
+engine's STEP thread owns every mutation (demote at eviction, pop at
+promotion, LRU eviction inside put). Scraper/submit threads read the
+plain-int counters and `host_bytes` GIL-atomically via `stats()`; the
+`_TRACECHECK_THREADS` declaration below states that contract so the
+lock-discipline pass (tools/tracecheck) machine-checks it: every
+mutating method is declared step-thread-only, and a mutation reachable
+from the caller surface would be flagged.
+"""
+from __future__ import annotations
+
+from collections import OrderedDict
+from typing import Dict, Iterable, List, Optional, Tuple
+
+import numpy as np
+
+from ..framework import monitor
+
+__all__ = ["HostEntry", "HostTier"]
+
+
+class HostEntry:
+    """One demoted page's host copy: raw K/V page blocks
+    `[L, H, page_size, D]` plus (int8 mode) the per-(layer, head) fp32
+    scale rows `[L, H]` — raw bytes in, raw bytes out, so the
+    round-trip is exact."""
+
+    __slots__ = ("k", "v", "ks", "vs", "nbytes")
+
+    def __init__(self, k, v, ks=None, vs=None):
+        self.k = np.asarray(k)
+        self.v = np.asarray(v)
+        self.ks = None if ks is None else np.asarray(ks)
+        self.vs = None if vs is None else np.asarray(vs)
+        self.nbytes = int(
+            self.k.nbytes + self.v.nbytes
+            + (0 if self.ks is None else self.ks.nbytes)
+            + (0 if self.vs is None else self.vs.nbytes))
+
+
+class HostTier:
+    """Bounded, LRU-evicting host-RAM store of demoted prefix-cache
+    pages for ONE engine, keyed by chain digest.
+
+    The engine's step thread is the only writer (see module docstring);
+    the declaration below is read by the tracecheck lock-discipline
+    pass: these methods run ONLY on the declared foreign thread, so
+    their lock-free mutations are single-entry by contract."""
+
+    _TRACECHECK_THREADS = {
+        "step": ("put", "get", "pop", "note_promotion", "note_hit",
+                 "note_abandon"),
+    }
+
+    def __init__(self, max_bytes: int, engine: str = "generation"):
+        self.engine = engine
+        self.max_bytes = int(max_bytes)
+        self._entries: "OrderedDict[bytes, HostEntry]" = OrderedDict()
+        self._bytes = 0
+        # plain-int counters: step thread writes, scrapers read
+        # GIL-atomically (stats() below)
+        self.demotions = 0    # entries ever stored
+        self.promotions = 0   # pages re-uploaded to HBM
+        self.evictions = 0    # entries finally dropped (LRU / cascade)
+        self.hits = 0         # admissions that matched >= 1 host page
+        self.abandons = 0     # promotions abandoned mid-upload
+        self.rejects = 0      # puts refused (entry alone over budget)
+
+    # -- store mutation (step thread only) ---------------------------------
+
+    def put(self, digest: bytes, entry: HostEntry,
+            protect: Iterable[bytes] = ()) -> Tuple[bool, List[bytes]]:
+        """Store one demoted page under `digest` (MRU), LRU-evicting
+        other entries until the byte budget holds. Returns
+        `(stored, evicted_digests)` — the caller drops the chain nodes
+        of every evicted digest (demote-of-demoted = final eviction).
+        `protect` digests (an in-flight admission's matched host run)
+        are never evicted, even if the budget temporarily overshoots.
+        An entry that alone exceeds the budget is refused."""
+        if entry.nbytes > self.max_bytes:
+            self.rejects += 1
+            return False, []
+        old = self._entries.pop(digest, None)
+        if old is not None:
+            self._bytes -= old.nbytes
+        self._entries[digest] = entry
+        self._bytes += entry.nbytes
+        self.demotions += 1
+        monitor.stat_add("STAT_kv_tier_demotions")
+        evicted: List[bytes] = []
+        if self._bytes > self.max_bytes:
+            keep = set(protect)
+            keep.add(digest)
+            for d in list(self._entries):
+                if self._bytes <= self.max_bytes:
+                    break
+                if d in keep:
+                    continue
+                ev = self._entries.pop(d)
+                self._bytes -= ev.nbytes
+                self.evictions += 1
+                monitor.stat_add("STAT_kv_tier_evictions")
+                evicted.append(d)
+        monitor.stat_set("STAT_kv_tier_host_bytes", self._bytes)
+        return True, evicted
+
+    def get(self, digest: bytes) -> Optional[HostEntry]:
+        """Entry for `digest` (touches LRU recency) or None."""
+        entry = self._entries.get(digest)
+        if entry is not None:
+            self._entries.move_to_end(digest)
+        return entry
+
+    def pop(self, digest: bytes,
+            final: bool = False) -> Optional[HostEntry]:
+        """Remove and return the entry for `digest` (None if absent).
+        Promotion uses move semantics — the host copy leaves the store
+        as its content heads back to HBM, holding the one-copy
+        invariant. `final=True` counts the pop as a tier eviction (a
+        cascade drop of an orphaned descendant, or an abandon discard)
+        rather than a promotion-side move."""
+        entry = self._entries.pop(digest, None)
+        if entry is not None:
+            self._bytes -= entry.nbytes
+            if final:
+                self.evictions += 1
+                monitor.stat_add("STAT_kv_tier_evictions")
+            monitor.stat_set("STAT_kv_tier_host_bytes", self._bytes)
+        return entry
+
+    def note_promotion(self, pages: int) -> None:
+        """Count `pages` pages re-uploaded to HBM (one admission)."""
+        self.promotions += int(pages)
+        monitor.stat_add("STAT_kv_tier_promotions", int(pages))
+
+    def note_hit(self) -> None:
+        """Count one admission that matched >= 1 host-tier page."""
+        self.hits += 1
+        monitor.stat_add("STAT_kv_tier_hits")
+
+    def note_abandon(self) -> None:
+        """Count one promotion abandoned mid-upload (fault / failpoint
+        — the admission fell back to cold prefill)."""
+        self.abandons += 1
+        monitor.stat_add("STAT_kv_tier_abandons")
+
+    # -- read surface (any thread; GIL-atomic reads) -----------------------
+
+    @property
+    def host_bytes(self) -> int:
+        return self._bytes
+
+    def __len__(self) -> int:
+        return len(self._entries)
+
+    def __contains__(self, digest: bytes) -> bool:
+        return digest in self._entries
+
+    def digests(self) -> List[bytes]:
+        """Snapshot of stored digests, LRU-first (tests/bench leak
+        accounting)."""
+        return list(self._entries)
+
+    def stats(self) -> Dict[str, int]:
+        """Scraper-safe snapshot (each field one GIL-atomic read)."""
+        return {
+            "max_bytes": self.max_bytes,
+            "host_bytes": self._bytes,
+            "entries": len(self._entries),
+            "demotions": self.demotions,
+            "promotions": self.promotions,
+            "evictions": self.evictions,
+            "hits": self.hits,
+            "abandons": self.abandons,
+            "rejects": self.rejects,
+        }

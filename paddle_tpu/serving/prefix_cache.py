@@ -29,9 +29,27 @@ Ownership model (the refcount substrate lives in `kv_cache.py`):
   are returned for the engine's zero-on-free scatter, pages a live
   sequence still shares zero later through that sequence's free.
 
+Tiered demotion (ISSUE 18): with a `HostTier` attached
+(`attach_tier`), a chain node has THREE states — HBM (`page` is a
+physical page id, cache-held), host (`page is None`, raw content in
+the host store under the node's digest), gone (absent from the index).
+Eviction *demotes* instead of discarding: the engine's gather callback
+pulls the page's raw bytes (+ int8 scale rows) off-device into the
+host store, the HBM page is released/zeroed exactly as before, and the
+node survives host-state. `lookup_tiered` walks THROUGH host nodes —
+the admission promotes the matched host run back into fresh HBM pages
+(upload overlapped with its tail prefill) via `consume_promoted`,
+after which the post-prefill `register` re-creates the nodes bound to
+the request's pages. Along any root path states run HBM* then host*
+(demotion takes the deepest HBM nodes first; `register` re-binds any
+host node it walks), so victim selection treats "no HBM children" as
+leaf-ness and host nodes are never victims themselves. Dropping a host
+node cascades over its (necessarily host) descendants —
+demote-of-demoted is the final eviction.
+
 Single-writer like the allocator: the engine's step thread owns every
-mutation (lookup/register/evict); `stats()` takes GIL-consistent
-snapshots for scraper threads.
+mutation (lookup/register/evict/demote/promote); `stats()` takes
+GIL-consistent snapshots for scraper threads.
 """
 from __future__ import annotations
 
@@ -80,7 +98,9 @@ class _Node:
                  tick: int):
         self.key = key
         self.parent = parent        # parent digest (None at depth 0)
-        self.page = page            # physical page id in the pools
+        self.page = page            # physical page id in the pools, or
+                                    # None = demoted to the host tier
+                                    # (content under `key` in HostTier)
         self.children: set = set()  # child digests
         self.tick = tick            # LRU clock (max of hits on the path)
 
@@ -106,7 +126,73 @@ class PrefixCache:
         self.hits = 0           # admissions that matched >= 1 cached page
         self.misses = 0         # admissions that matched nothing
         self.hit_tokens = 0     # prompt tokens served from cached pages
-        self.evictions = 0      # chain nodes evicted (LRU)
+        self.evictions = 0      # chain nodes evicted from HBM (LRU;
+                                # includes demotions — the page left
+                                # HBM either way)
+        # host demotion tier (ISSUE 18; attach_tier wires all three —
+        # None keeps the two-state PR 12 semantics exactly)
+        self._tier = None       # serving.kv_tier.HostTier
+        self._gather = None     # page -> (k, v, ks, vs) | None
+        self._audit = None      # engine AuditLog (KV_DEMOTE/TIER_EVICT)
+        self._protect: set = set()  # digests an in-flight admission
+                                    # matched host-side: never tier-evict
+
+    # -- host tier (ISSUE 18) ----------------------------------------------
+
+    def attach_tier(self, tier, gather, audit=None) -> None:
+        """Enable the host demotion tier: `tier` is the bounded
+        `HostTier` store, `gather` the engine's off-device page gather
+        (`page -> (k, v, ks, vs)` raw numpy, or None when the gather
+        failed / the `kv_tier.demote_gather` failpoint fired — the
+        eviction then proceeds plain), `audit` the engine's AuditLog
+        for KV_DEMOTE / KV_TIER_EVICT events."""
+        self._tier = tier
+        self._gather = gather
+        self._audit = audit
+
+    def protect(self, digests) -> None:
+        """Shield an admission's matched host run from tier eviction
+        until `unprotect` — between `lookup_tiered` and
+        `consume_promoted` the SAME admission may demote eviction
+        victims into the tier, and the LRU must not cannibalize the
+        entries it is about to promote."""
+        self._protect = set(digests)
+
+    def unprotect(self) -> None:
+        self._protect = set()
+
+    def consume_promoted(self, digests: List[bytes]):
+        """Move an admission's matched host run out of the tier
+        (promotion): pops each digest's `HostEntry` (the admission now
+        owns the content — it re-uploads into its own fresh pages) and
+        drops the nodes from the index; the chain re-registers bound to
+        the request's pages after its prefill, exactly like a fresh
+        one. Orphaned host descendants beyond the run cascade out
+        (final eviction). Returns `(entries, cascade_dropped)`."""
+        entries = [self._tier.pop(d) for d in digests]
+        dropped = self._drop_host_node(digests[0], pop_entry=False)
+        return entries, dropped
+
+    def _drop_host_node(self, digest: bytes, pop_entry: bool) -> int:
+        """Remove one host-state node and every descendant (all host by
+        the HBM*-then-host* path invariant), popping their tier entries
+        as final evictions; returns how many entries were dropped.
+        `pop_entry=False` for a root whose entry the caller already
+        consumed (tier LRU eviction / promotion)."""
+        dropped = 0
+        node = self._nodes.pop(digest, None)
+        if pop_entry and self._tier is not None:
+            if self._tier.pop(digest, final=True) is not None:
+                dropped += 1
+        if node is None:
+            return dropped
+        if node.parent is not None and node.parent in self._nodes:
+            self._nodes[node.parent].children.discard(digest)
+        for c in list(node.children):
+            child = self._nodes.get(c)
+            if child is not None and child.page is None:
+                dropped += self._drop_host_node(c, pop_entry=True)
+        return dropped
 
     # -- hashing -----------------------------------------------------------
 
@@ -131,15 +217,44 @@ class PrefixCache:
         tick = next(self._tick)
         for d in digests:
             node = self._nodes.get(d)
-            if node is None:
-                break
+            if node is None or node.page is None:
+                break   # gone, or demoted to host: not an HBM hit
             node.tick = tick
             pages.append(node.page)
         return digests, pages
 
-    def note_admitted(self, hit_tokens: int) -> None:
+    def lookup_tiered(self, prompt: np.ndarray):
+        """Promote-aware lookup (ISSUE 18): like `lookup`, but the walk
+        continues THROUGH host-state nodes. Returns
+        `(digests, hbm_pages, host_digests)` — the leading HBM run
+        (map read-only, as ever) followed by the contiguous host run
+        (the admission promotes these via `consume_promoted`), stopping
+        at the first gone digest. Same counting contract as `lookup`:
+        touches LRU clocks, counts nothing."""
+        digests = self.digests(prompt)
+        pages: List[int] = []
+        host: List[bytes] = []
+        tick = next(self._tick)
+        for d in digests:
+            node = self._nodes.get(d)
+            if node is None:
+                break
+            node.tick = tick
+            if node.page is not None:
+                if host:
+                    break   # HBM after host: impossible by the path
+                            # invariant — stop defensively
+                pages.append(node.page)
+            else:
+                if self._tier is None or d not in self._tier:
+                    break   # host node without an entry: defensive
+                host.append(d)
+        return digests, pages, host
+
+    def note_admitted(self, hit_tokens: int, host_tokens: int = 0) -> None:
         """Count one admission's cache outcome: `hit_tokens` prompt
-        tokens served from cached pages (0 = a miss)."""
+        tokens served from cached pages (0 = a miss), of which
+        `host_tokens` came up from the host tier (promotion)."""
         if hit_tokens > 0:
             self.hits += 1
             self.hit_tokens += int(hit_tokens)
@@ -147,6 +262,8 @@ class PrefixCache:
             monitor.stat_add("STAT_prefix_hit_tokens", int(hit_tokens))
         else:
             self.misses += 1
+        if host_tokens > 0 and self._tier is not None:
+            self._tier.note_hit()
 
     def register(self, digests: List[bytes], pt_row) -> List[int]:
         """Index a freshly prefilled (or freshly decoded — generated
@@ -179,6 +296,18 @@ class PrefixCache:
                     self._nodes[parent].children.add(d)
                 added += 1
             else:
+                if node.page is None:
+                    # host-state node walked by a fresh prefill (the
+                    # admission cold-prefilled past it — e.g. after a
+                    # promotion abandon): re-bind to the producer's
+                    # page (identical content) and drop the host copy
+                    # — at most ONE copy per digest, ever
+                    page = int(pt_row[i])
+                    self._kv.cache_hold([page])
+                    node.page = page
+                    if self._tier is not None:
+                        self._tier.pop(d)   # content is back in HBM
+                    added += 1
                 node.tick = tick
             own.append(node.page)
             parent = d
@@ -239,9 +368,16 @@ class PrefixCache:
         bytes); fall back to the LRU shared leaf, which frees nothing
         itself but exposes the freeable pages behind it (children must
         leave the index before their parent). None when every leaf is
-        excluded."""
+        excluded. Host-state nodes are never victims (nothing in HBM
+        to free) and never BLOCK one either — leaf-ness means "no HBM
+        children", so a chain whose tail already demoted keeps
+        draining parent-ward."""
         leaves = [n for n in self._nodes.values()
-                  if not n.children and n.page not in exclude]
+                  if n.page is not None and n.page not in exclude
+                  and not any(
+                      c is not None and c.page is not None
+                      for c in (self._nodes.get(ck)
+                                for ck in n.children))]
         if not leaves:
             return None
         victim = min((n for n in leaves if refs.get(n.page) == 1),
@@ -252,13 +388,49 @@ class PrefixCache:
 
     def _evict_node(self, victim: _Node,
                     refs: Dict[int, int]) -> List[int]:
-        """Drop one node from the index and release its cache
-        reference; returns the pages freed NOW (refcount 0)."""
-        del self._nodes[victim.key]
-        if victim.parent is not None and victim.parent in self._nodes:
-            self._nodes[victim.parent].children.discard(victim.key)
-        out = self._kv.cache_release([victim.page])
-        refs.pop(victim.page, None)
+        """Release one node's HBM page; returns the pages freed NOW
+        (refcount 0). With a host tier attached the node DEMOTES —
+        content gathered off-device into the store, node survives
+        host-state — unless the gather fails (failpoint / reject), in
+        which case the node drops exactly as before (and any host
+        descendants it stranded cascade out)."""
+        page = victim.page
+        demoted = False
+        if self._tier is not None and self._gather is not None:
+            data = self._gather(page)
+            if data is not None:
+                from .kv_tier import HostEntry
+                stored, evicted = self._tier.put(
+                    victim.key, HostEntry(*data), protect=self._protect)
+                if evicted:
+                    dropped = 0
+                    for d in evicted:
+                        dropped += 1
+                        dropped += self._drop_host_node(
+                            d, pop_entry=False)
+                    if self._audit is not None:
+                        self._audit.audit("KV_TIER_EVICT", None,
+                                          entries=dropped)
+                demoted = stored
+        if demoted:
+            victim.page = None   # node survives, host-state
+            if self._audit is not None:
+                self._audit.audit("KV_DEMOTE", None, page=page)
+        else:
+            del self._nodes[victim.key]
+            if victim.parent is not None and victim.parent in self._nodes:
+                self._nodes[victim.parent].children.discard(victim.key)
+            # a failed demotion strands this node's host descendants
+            # (unreachable from any future walk): cascade them out
+            dropped = 0
+            for c in list(victim.children):
+                child = self._nodes.get(c)
+                if child is not None and child.page is None:
+                    dropped += self._drop_host_node(c, pop_entry=True)
+            if dropped and self._audit is not None:
+                self._audit.audit("KV_TIER_EVICT", None, entries=dropped)
+        out = self._kv.cache_release([page])
+        refs.pop(page, None)
         self.evictions += 1
         monitor.stat_add("STAT_prefix_evictions")
         return out
@@ -270,7 +442,7 @@ class PrefixCache:
 
     def stats(self) -> dict:
         """Scraper-safe snapshot (counters are GIL-atomic ints)."""
-        return {
+        out = {
             "enabled": True,
             "engine": self.engine,
             "max_pages": self.max_pages,
@@ -281,4 +453,30 @@ class PrefixCache:
             "misses": self.misses,
             "hit_tokens": self.hit_tokens,
             "evictions": self.evictions,
+            # host tier (ISSUE 18) — zeros when no tier is attached so
+            # report tooling reads one shape either way
+            "tier_enabled": self._tier is not None,
+            "host_bytes": 0,
+            "host_entries": 0,
+            "host_nodes": 0,
+            "demotions": 0,
+            "promotions": 0,
+            "tier_hits": 0,
+            "tier_evictions": 0,
+            "tier_abandons": 0,
+            "tier_hit_rate": 0.0,
         }
+        if self._tier is not None:
+            t = self._tier.stats()
+            out["host_bytes"] = t["host_bytes"]
+            out["host_entries"] = t["entries"]
+            out["host_nodes"] = sum(
+                1 for n in list(self._nodes.values()) if n.page is None)
+            out["demotions"] = t["demotions"]
+            out["promotions"] = t["promotions"]
+            out["tier_hits"] = t["hits"]
+            out["tier_evictions"] = t["evictions"]
+            out["tier_abandons"] = t["abandons"]
+            out["tier_hit_rate"] = round(
+                t["hits"] / max(1, self.hits + self.misses), 4)
+        return out

@@ -209,6 +209,10 @@ class Router:
                 "free_pages": p.get("free_pages", 0),
                 "slots_free": p.get("slots_free", 0),
                 "live": p.get("live", 0),
+                # ISSUE 18: how much of the replica's prefix traffic the
+                # host tier is absorbing — replicas without a tier read 0
+                "tier_hit_rate": (p.get("tier") or {}).get("hit_rate",
+                                                           0.0),
             }
         self._timeline.append({"t_ms": round(now_ms, 1),
                                "replicas": tick})

@@ -104,9 +104,15 @@ def test_gauge_discipline_corpus():
 def test_lock_discipline_corpus():
     fs = run_fixture("lock_discipline", ["lock-discipline"])
     _bad_only(fs, "lock-discipline")
-    # both unlocked sites of the contended attribute (loop + caller)
-    assert len(fs) == 2
-    assert all("_count" in f.message for f in fs)
+    # Engine: both unlocked sites of the contended attribute (loop +
+    # caller); HostStore: both sites of the attribute shared between a
+    # declared step-thread method and an undeclared caller method
+    # (ISSUE 18 — the _TRACECHECK_THREADS extension)
+    assert len(fs) == 4
+    count = [f for f in fs if "_count" in f.message]
+    tier = [f for f in fs if "_bytes" in f.message]
+    assert len(count) == 2 and len(tier) == 2
+    assert all("HostStore" in f.message for f in tier)
 
 
 def test_flags_inventory_corpus():

@@ -14,3 +14,22 @@ class Engine:
 
     def submit(self):
         self._count += 1  # BAD: loop thread also writes this
+
+
+class HostStore:
+    """Seeded violation for the declared-thread extension (ISSUE 18):
+    `put` is declared step-thread-only, but an UNDECLARED public method
+    mutates the same attribute from the caller's thread — no Thread of
+    its own, the declaration alone puts the class in scope."""
+
+    _TRACECHECK_THREADS = {"step": ("put",)}
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._bytes = 0
+
+    def put(self, n):
+        self._bytes += n  # BAD: caller thread also writes this
+
+    def drop(self, n):
+        self._bytes -= n  # BAD: declared step thread also writes this

@@ -18,3 +18,23 @@ class Engine:
     def submit(self):
         with self._lock:
             self._count += 1
+
+
+class HostStore:
+    """Good twin for the declared-thread extension: every mutation
+    lives in a declared step-thread-only method — single entry by
+    contract, no lock needed — and the caller surface only reads."""
+
+    _TRACECHECK_THREADS = {"step": ("put", "pop")}
+
+    def __init__(self):
+        self._bytes = 0
+
+    def put(self, n):
+        self._bytes += n   # single declared entry: one writer
+
+    def pop(self, n):
+        self._bytes -= n   # same declared entry — still one writer
+
+    def host_bytes(self):
+        return self._bytes

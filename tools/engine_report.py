@@ -12,7 +12,9 @@ dump whose `extra` carries `step_log_tail`/`audit_tail` (engine death,
 poison, allocator exhaustion). The report shows, per iteration: decode
 slots in use (as a bar), scheduler decisions (admit/complete/expire/
 poison/abort), queue depth + oldest-request age, page-pool occupancy,
-prefix-cache hit tokens + copy-on-write splits (pfx/cow), tokens
+prefix-cache hit tokens + copy-on-write splits (pfx/cow), host-tier
+page traffic (dem/pro — ISSUE 18: pages demoted to host RAM vs pages
+promoted back to HBM this iteration), tokens
 delivered + speculative drafts accepted + prefill chunks run
 (tok/acc/chk — ISSUE 14: tok > slots on a decode iteration is
 speculation paying off, chk interleaved with decode wall is chunked
@@ -66,7 +68,8 @@ def summarize(records: List[dict]) -> dict:
            for k in ("admitted", "completed", "expired", "poisoned",
                      "aborted", "freed", "prefix_tokens", "cow_splits",
                      "tokens", "spec_drafted", "spec_accepted",
-                     "prefill_chunks")}
+                     "prefill_chunks", "tier_demotions",
+                     "tier_promotions")}
     decode_steps = sum(1 for r in records if r.get("decode_ms", 0) > 0)
     # engine generations in the window (ISSUE 15): a supervised restart
     # bumps `incarnation`, so >1 distinct value means the ring spans an
@@ -146,6 +149,12 @@ def render(name: str, eng: dict, last: int = 0,
             print(f"   prefix cache: {summ['prefix_tokens']} prompt "
                   f"tokens served from cached pages, "
                   f"{summ['cow_splits']} copy-on-write splits", file=out)
+        # cross-tier traffic (ISSUE 18): pages the prefix cache demoted
+        # to host RAM vs pages promoted back to HBM in the window
+        if summ.get("tier_demotions") or summ.get("tier_promotions"):
+            print(f"   kv tier: {summ['tier_demotions']} pages demoted "
+                  f"to host, {summ['tier_promotions']} promoted back",
+                  file=out)
         # the speculative economics in one line: tokens delivered per
         # decode step (incl. prefill first tokens), the exact accepted-
         # drafts-per-step signal, the draft acceptance split, and any
@@ -160,7 +169,8 @@ def render(name: str, eng: dict, last: int = 0,
                f"{'adm':>3} "
                f"{'done':>4} {'exp':>3} {'psn':>3} {'abt':>3} "
                f"{'queue':>5} {'age_ms':>8} {'pages':>5} {'free':>5} "
-               f"{'pfx':>4} {'cow':>3} {'tok':>4} {'acc':>4} "
+               f"{'pfx':>4} {'cow':>3} {'dem':>3} {'pro':>3} "
+               f"{'tok':>4} {'acc':>4} "
                f"{'chk':>3} {'prefill':>8} {'decode':>8}")
         print(hdr, file=out)
         for r in records:
@@ -178,6 +188,8 @@ def render(name: str, eng: dict, last: int = 0,
                   f"{r.get('free_pages', 0):>5} "
                   f"{r.get('prefix_tokens', 0):>4} "
                   f"{r.get('cow_splits', 0):>3} "
+                  f"{r.get('tier_demotions', 0):>3} "
+                  f"{r.get('tier_promotions', 0):>3} "
                   f"{r.get('tokens', 0):>4} "
                   f"{r.get('spec_accepted', 0):>4} "
                   f"{r.get('prefill_chunks', 0):>3} "

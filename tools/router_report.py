@@ -9,7 +9,8 @@ Input is either an exporter `/stats` payload (the router registers like
 any engine, so its snapshot rides `engines.<name>.router`) or a direct
 `Router.stats()` dump. The report shows, per router: the placement
 summary (per replica: placements, sketch size, drain verdict, live
-pressure — queue depth, slots free, page headroom — and the
+pressure — queue depth, slots free, page headroom, host-tier hit rate
+(ISSUE 18) — and the
 supervisor's restart/breaker counters), then the pressure timeline the
 router's refreshes recorded (one row per tick, queue-depth bars per
 replica — the drain/steer history at a glance), then the placement
@@ -93,13 +94,18 @@ def render(name: str, snap: dict, last: int = 0, file=None) -> None:
     # -- placement summary table -------------------------------------------
     hdr = (f"   {'replica':<18} {'placed':>6} {'sketch':>6} {'drain':>5} "
            f"{'queue':>5} {'age_ms':>8} {'slots':>5} {'free_pg':>7} "
-           f"{'restarts':>8} {'breaker':>7}")
+           f"{'tier%':>6} {'restarts':>8} {'breaker':>7}")
     print(hdr, file=out)
     for rname in sorted(replicas):
         r = replicas[rname]
         p = r.get("pressure") or {}
         sup = r.get("supervisor") or {}
         breaker = (sup.get("breaker") or {})
+        # ISSUE 18: share of the replica's prefix lookups the host tier
+        # served — replicas running without a tier show '-'
+        tier = p.get("tier") or {}
+        tier_cell = (f"{100.0 * tier.get('hit_rate', 0.0):>5.1f}%"
+                     if tier else f"{'-':>6}")
         print(f"   {rname:<18} {r.get('placements', 0):>6} "
               f"{r.get('sketch_digests', 0):>6} "
               f"{'YES' if r.get('drained') else '-':>5} "
@@ -107,6 +113,7 @@ def render(name: str, snap: dict, last: int = 0, file=None) -> None:
               f"{p.get('oldest_age_ms', 0.0):>8.1f} "
               f"{p.get('slots_free', 0):>5} "
               f"{p.get('free_pages', 0):>7} "
+              f"{tier_cell} "
               f"{sup.get('restarts', 0):>8} "
               f"{'OPEN' if breaker.get('open') else '-':>7}", file=out)
 

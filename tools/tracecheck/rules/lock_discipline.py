@@ -9,10 +9,26 @@ completer / step loop / sampler threads + the caller's own thread),
 so this pass is scoped to `serving/` and `profiler/`.
 
 Heuristic, per class: **entry points** are (a) every method handed to
-`threading.Thread(target=...)` — one entry per thread — and (b) the
-caller's thread, covering every public method. Construction
-(`__init__` and anything reachable only from it) happens-before the
-threads start and is exempt. Contention is tracked per ATTRIBUTE (the
+`threading.Thread(target=...)` — one entry per thread — (b) every
+method named in a class-body `_TRACECHECK_THREADS` declaration (below),
+and (c) the caller's thread, covering every public method NOT declared
+in (b). Construction (`__init__` and anything reachable only from it)
+happens-before the threads start and is exempt.
+
+Classes that never spawn their own thread but whose methods run on
+SOMEONE ELSE'S (the host-tier store: every mutation happens on the
+engine's step thread, ISSUE 18) state that contract as a class-body
+dict literal the pass parses:
+
+    class HostTier:
+        _TRACECHECK_THREADS = {"step": ("put", "get", "pop")}
+
+Each key is a foreign thread; its methods become that thread's entry
+seeds and leave the caller-surface entry — so a mutation reachable
+ONLY from declared methods is single-entry by contract, while adding
+an undeclared public method that touches the same attribute trips the
+rule. A class carrying the declaration is analyzed even without a
+`Thread(target=...)` of its own. Contention is tracked per ATTRIBUTE (the
 PR 3 bug mutated the same counter from the dispatcher loop and the
 completer loop — two methods each reachable from only one entry, so a
 method-level rule would miss its own origin incident): every
@@ -81,6 +97,25 @@ class _ClassInfo:
             n.name: n for n in node.body
             if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))}
         self.thread_targets: Set[str] = set()
+        # {thread name: declared entry methods} from a class-body
+        # `_TRACECHECK_THREADS` dict literal (foreign-thread contract)
+        self.declared: Dict[str, Set[str]] = {}
+        for stmt in node.body:
+            if isinstance(stmt, ast.Assign) and any(
+                    isinstance(t, ast.Name)
+                    and t.id == "_TRACECHECK_THREADS"
+                    for t in stmt.targets) and \
+                    isinstance(stmt.value, ast.Dict):
+                for k, v in zip(stmt.value.keys, stmt.value.values):
+                    if not (isinstance(k, ast.Constant)
+                            and isinstance(k.value, str)):
+                        continue
+                    names = {el.value for el in getattr(v, "elts", ())
+                             if isinstance(el, ast.Constant)
+                             and isinstance(el.value, str)
+                             and el.value in self.methods}
+                    if names:
+                        self.declared[k.value] = names
         self.calls: Dict[str, Set[str]] = {}
         for name, mnode in self.methods.items():
             calls: Set[str] = set()
@@ -121,13 +156,22 @@ def check(ctx: Context):
             if not isinstance(cnode, ast.ClassDef):
                 continue
             ci = _ClassInfo(cnode)
-            if not ci.thread_targets:
+            if not ci.thread_targets and not ci.declared:
                 continue  # single-threaded class: out of scope
             entries: Dict[str, Set[str]] = {
                 f"thread:{t}": {t} for t in ci.thread_targets}
+            for tname, meths in ci.declared.items():
+                entries.setdefault(f"thread:{tname}", set()) \
+                    .update(meths)
+            # declared foreign-thread methods leave the caller surface:
+            # they run on the named thread, not the caller's
+            declared_all: Set[str] = set()
+            for meths in ci.declared.values():
+                declared_all |= meths
             public = {m for m in ci.methods
-                      if not m.startswith("_") or m in ("__enter__",
-                                                        "__exit__")}
+                      if (not m.startswith("_")
+                          or m in ("__enter__", "__exit__"))
+                      and m not in declared_all}
             if public:
                 entries["caller"] = public
             reach: Dict[str, Set[str]] = {}
