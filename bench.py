@@ -1620,6 +1620,99 @@ def bench_kvtier():
     return ttft_speedup, extra
 
 
+def bench_tp():
+    """Mesh-slice lanes (ISSUE 19): one GenerationEngine lane widened
+    from a single chip to a tp-wide mesh slice — every program a
+    shard_map program with head-sharded projections and KV pools, one
+    psum per block.
+
+    Two arms at EQUAL TOTAL pool bytes (same num_pages; under tp each
+    chip holds heads/tp of every page, so per-shard HBM is total/tp):
+    the same greedy workload through tp=1 and tp=TP. On the CPU
+    virtual-device mesh (8 forced host devices) the gates are
+    correctness, not speed — psum over in-process shards buys nothing
+    on one CPU: (a) token-identical output across arms, (b) zero
+    post-warmup compiles on the SHARDED pack (ledger-proven — the
+    shard_map programs warm exactly like single-chip ones), (c) the
+    per-shard HBM gauge reports exactly total/tp
+    (STAT_tp_kv_shard_bytes and stats()["pages"]["shard_hbm_bytes"]
+    agree)."""
+    import paddle_tpu as paddle
+    from paddle_tpu import serving
+    from paddle_tpu.framework import monitor
+    from paddle_tpu.models import GPTConfig, GPTForCausalLM
+
+    if _SMOKE:
+        HID, LAYERS, HEADS, VOCAB = 256, 2, 4, 2048
+        N_REQ, MAXN, TP = 8, 8, 2
+    else:
+        HID, LAYERS, HEADS, VOCAB = 512, 4, 8, 8192
+        N_REQ, MAXN, TP = 16, 16, 4
+    PAGE, S = 16, 32
+    POOL = 4 * -(-(S + MAXN) // PAGE) + 8
+
+    paddle.seed(0)
+    cfg = GPTConfig(vocab_size=VOCAB, hidden_size=HID, num_layers=LAYERS,
+                    num_heads=HEADS, intermediate_size=4 * HID,
+                    max_position_embeddings=S + MAXN, dropout=0.0)
+    net = GPTForCausalLM(cfg)
+    net.eval()
+    monitor.reset_all_stats()
+    rng = np.random.RandomState(0)
+    prompts = [rng.randint(0, VOCAB, size=(S,)).astype("int64")
+               for _ in range(N_REQ)]
+
+    def arm(tp):
+        gauge0 = monitor.stat_get("STAT_tp_kv_shard_bytes") or 0
+        eng = serving.GenerationEngine(
+            net, max_slots=4, page_size=PAGE, num_pages=POOL,
+            prefill_buckets=(S,), max_new_tokens=MAXN,
+            request_timeout_ms=0, tp=tp, name=f"bench_tp{tp}")
+        ledger0 = dict(eng._ledger)
+        try:
+            t0 = time.perf_counter()
+            outs = [eng.generate(p, max_new_tokens=MAXN)
+                    for p in prompts]
+            wall = time.perf_counter() - t0
+            toks = sum(o.size - p.size for o, p in zip(outs, prompts))
+            pages = eng.stats()["pages"]
+            stats = {
+                "tp": tp,
+                "tokens_per_sec": round(toks / max(wall, 1e-9), 2),
+                "hbm_bytes": pages["hbm_bytes"],
+                "shard_hbm_bytes": pages["shard_hbm_bytes"],
+                "shard_gauge_delta":
+                    (monitor.stat_get("STAT_tp_kv_shard_bytes") or 0)
+                    - gauge0,
+                "post_warmup_compiles":
+                    {k: v for k, v in eng._ledger.items()
+                     if ledger0.get(k) != v},
+                "ledger": dict(eng._ledger),
+            }
+        finally:
+            eng.shutdown()
+        return outs, stats
+
+    outs1, arm1 = arm(1)
+    outsN, armN = arm(TP)
+    token_identical = all(np.array_equal(a, b)
+                          for a, b in zip(outs1, outsN))
+    gauge_exact = (
+        armN["shard_hbm_bytes"] * TP == armN["hbm_bytes"]
+        and armN["shard_gauge_delta"] == armN["shard_hbm_bytes"]
+        and arm1["hbm_bytes"] == armN["hbm_bytes"])
+    extra = {
+        "tp": TP,
+        "requests": N_REQ,
+        "pool_pages": POOL,
+        "token_identical_tp1_vs_tpN": token_identical,
+        "shard_gauge_exact_total_over_tp": gauge_exact,
+        "tp1_arm": arm1,
+        "tpN_arm": armN,
+    }
+    return armN["tokens_per_sec"], extra
+
+
 def bench_quant():
     """Quantized serving (ISSUE 9), three arms with regression gates:
 
@@ -2431,12 +2524,13 @@ def _run_mode(mode="train", backend=None):
                 "recovery": "recovery_goodput_tokens_per_sec",
                 "router": "router_affinity_ttft_p50_speedup",
                 "kvtier": "kvtier_promote_ttft_p50_speedup",
-                "coldstart": "coldstart_ttfst_speedup_warm_vs_cold"}\
+                "coldstart": "coldstart_ttfst_speedup_warm_vs_cold",
+                "tp": "tp_generation_engine_tokens_per_sec"}\
         .get(mode, _HEADLINE)
-    if mode == "input":
-        # the input bench exercises the sharded fit path; on a CPU host
-        # give XLA 8 virtual devices (same mesh the test suite uses) —
-        # must land in XLA_FLAGS before the backend initializes
+    if mode in ("input", "tp"):
+        # these benches need a device mesh; on a CPU host give XLA 8
+        # virtual devices (same mesh the test suite uses) — must land
+        # in XLA_FLAGS before the backend initializes
         plat = backend or os.environ.get("JAX_PLATFORMS", "")
         xf = os.environ.get("XLA_FLAGS", "")
         if (_SMOKE or plat == "cpu") and \
@@ -2782,6 +2876,38 @@ def _run_mode(mode="train", backend=None):
                   extra={"error": str(e)[:300]})
         return
 
+    if mode == "tp":
+        try:
+            tps, extra = _with_retries(bench_tp)
+            _emit(headline, tps, "tokens/sec", extra=extra)
+            if not extra["token_identical_tp1_vs_tpN"]:
+                sys.stderr.write(
+                    f"REGRESSION: greedy output differs tp=1 vs "
+                    f"tp={extra['tp']} — a mesh-slice lane must be "
+                    f"output-identical to the single-chip lane\n")
+            if extra["tpN_arm"]["post_warmup_compiles"] \
+                    or extra["tp1_arm"]["post_warmup_compiles"]:
+                sys.stderr.write(
+                    f"REGRESSION: a tp arm compiled after warmup "
+                    f"(tp1={extra['tp1_arm']['post_warmup_compiles']}, "
+                    f"tpN={extra['tpN_arm']['post_warmup_compiles']}) "
+                    f"— the sharded pack must warm exactly like the "
+                    f"single-chip one\n")
+            if not extra["shard_gauge_exact_total_over_tp"]:
+                sys.stderr.write(
+                    f"REGRESSION: per-shard KV HBM gauge != total/tp "
+                    f"(shard={extra['tpN_arm']['shard_hbm_bytes']}, "
+                    f"total={extra['tpN_arm']['hbm_bytes']}, "
+                    f"gauge_delta="
+                    f"{extra['tpN_arm']['shard_gauge_delta']}) — "
+                    f"admission headroom would misreport per-chip "
+                    f"reality\n")
+        except Exception as e:  # noqa: BLE001
+            traceback.print_exc()
+            _emit(headline, 0.0, "tokens/sec",
+                  extra={"error": str(e)[:300]})
+        return
+
     if mode == "coldstart":
         try:
             speedup, extra = _with_retries(bench_coldstart)
@@ -2952,7 +3078,7 @@ if __name__ == "__main__":
     ap.add_argument("--mode", choices=("train", "serving", "input",
                                        "packing", "generation", "quant",
                                        "recovery", "router", "kvtier",
-                                       "coldstart"),
+                                       "coldstart", "tp"),
                     default="train",
                     help="train: the round training configs (default); "
                          "serving: multi-lane InferenceEngine qps/latency/"
@@ -3010,7 +3136,14 @@ if __name__ == "__main__":
                          "(populated store) vs store-off; gates: warm "
                          ">= 2x faster TTFST, warm compile ledger empty "
                          "(every covered program `loaded`), greedy "
-                         "output token-identical across the arms")
+                         "output token-identical across the arms; "
+                         "tp: mesh-slice lanes (ISSUE 19) — one engine "
+                         "lane widened to a tp-wide shard_map slice vs "
+                         "tp=1 at equal total pool bytes on the forced "
+                         "8-virtual-device CPU mesh; gates: "
+                         "token-identical, zero post-warmup compiles "
+                         "on the sharded pack, per-shard KV HBM gauge "
+                         "= total/tp")
     ap.add_argument("--backend", default=None,
                     help="pin the jax platform (cpu/tpu/gpu) — same effect "
                          "as JAX_PLATFORMS but works under launchers that "

@@ -221,7 +221,8 @@ def send(tensor, dst=0, group=None, sync_op=True):
     n = get_world_size()
 
     def impl(v):
-        sz = jax.lax.axis_size(axis)
+        sz = (jax.lax.axis_size(axis) if hasattr(jax.lax, 'axis_size')
+              else jax.lax.psum(1, axis))
         perm = [(i, (i + 1) % sz) for i in range(sz)]
         return lax.ppermute(v, axis, perm)
     out = apply_op("send_v2", impl, (tensor,), {})

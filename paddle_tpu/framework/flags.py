@@ -139,6 +139,19 @@ register_flag("FLAGS_gen_spec_ngram", 3,
               "lookup draft proposer matches against the sequence's "
               "own token history (tried n..1, rightmost match wins); "
               "only read when FLAGS_gen_spec_k > 0")
+register_flag("FLAGS_gen_tp", 1,
+              "serving.GenerationEngine: tensor-parallel degree of the "
+              "lane's mesh slice (ISSUE 19) — every jitted program in "
+              "the pack (prefill/tail/decode/verify/cow/zero/tier) is "
+              "built as ONE shard_map program over a 'tp' mesh axis "
+              "with attention/MLP projection weights and the paged K/V "
+              "pools (+ int8 scale grids) head-sharded via "
+              "NamedSharding, page tables/lengths/sampling state "
+              "replicated, and the row-parallel partial sums psum-"
+              "reduced once per block. num_heads and the MLP hidden "
+              "width must divide it; 1 = the single-chip lane "
+              "(bit-identical to the pre-mesh engine). An explicit "
+              "GenerationEngine(mesh=...) overrides the flag")
 register_flag("FLAGS_gen_prefill_chunk", 0,
               "serving.GenerationEngine: split prompts longer than "
               "this into fixed-size prefill chunks driven through the "

@@ -15,7 +15,8 @@ from ..ops import creation, manipulation
 __all__ = ["GPTConfig", "GPTModel", "GPTForCausalLM", "MoEFeedForward",
            "gpt_prefill", "gpt_prefill_extend", "gpt_decode_step",
            "gpt_spec_verify", "gpt_logits", "dense_cache_write",
-           "dense_cache_attend"]
+           "dense_cache_attend", "decode_weight_specs",
+           "shard_decode_weights"]
 
 
 # -- shared decode math (generate() AND serving.GenerationEngine) -----------
@@ -56,7 +57,7 @@ def gpt_logits(W, h):
     return _gen_ln(h, lnfw, lnfb) @ W["wte"].T
 
 
-def _gen_block_pass(W, h, attend, *, num_heads):
+def _gen_block_pass(W, h, attend, *, num_heads, reduce=None):
     """The ONE batched transformer-block loop both prefill flavors run:
     LN → QKV heads → `attend(layer, q, k, v)` → output proj + MLP
     residuals, collecting per-layer K/V. The attention expression is
@@ -64,35 +65,48 @@ def _gen_block_pass(W, h, attend, *, num_heads):
     the batch) and a tail prefill (cached context + within-tail) — it
     lives in the caller's hook, so the `_gen_w` quant hooks, gelu
     flavor and head-reshape discipline can never diverge between the
-    two paths. Returns `(h, ks, vs)`."""
+    two paths. Returns `(h, ks, vs)`.
+
+    Tensor parallel (ISSUE 19): under a shard_map body the projection
+    leaves are head-sharded SLICES — wq/wk/wv/w1 column-parallel
+    (num_heads is then the LOCAL head count), wo/w2 row-parallel — and
+    `reduce` is the per-block partial-sum reduction (lax.psum over the
+    'tp' axis), applied to the row-parallel matmul outputs BEFORE the
+    replicated bias + residual add, the Megatron discipline that keeps
+    bo/b2 counted exactly once. Head/hidden reshapes derive the local
+    width from the tensors (-1), never from the replicated E."""
     import jax
 
     B, S = h.shape[:2]
     H = num_heads
-    E = h.shape[-1]
-    D = E // H
     ks, vs = [], []
     for i, (l1w, l1b, wq, bq, wk, bk, wv, bv, wo, bo, l2w, l2b,
             w1, b1, w2, b2) in enumerate(W["blocks"]):
         x = _gen_ln(h, l1w, l1b)
 
         def heads(t):
-            return t.reshape(B, S, H, D).transpose(0, 2, 1, 3)
+            return t.reshape(B, S, H, -1).transpose(0, 2, 1, 3)
         q = heads(x @ _gen_w(wq, x.dtype) + bq)
         k = heads(x @ _gen_w(wk, x.dtype) + bk)
         v = heads(x @ _gen_w(wv, x.dtype) + bv)
         ks.append(k)
         vs.append(v)
         o = attend(i, q, k, v)
-        o = o.transpose(0, 2, 1, 3).reshape(B, S, E)
-        h = h + (o @ _gen_w(wo, h.dtype) + bo)
+        o = o.transpose(0, 2, 1, 3).reshape(B, S, -1)
+        ow = o @ _gen_w(wo, h.dtype)
+        if reduce is not None:
+            ow = reduce(ow)
+        h = h + (ow + bo)
         x2 = _gen_ln(h, l2w, l2b)
-        h = h + (jax.nn.gelu(x2 @ _gen_w(w1, h.dtype) + b1,
-                             approximate=False) @ _gen_w(w2, h.dtype) + b2)
+        mw = jax.nn.gelu(x2 @ _gen_w(w1, h.dtype) + b1,
+                         approximate=False) @ _gen_w(w2, h.dtype)
+        if reduce is not None:
+            mw = reduce(mw)
+        h = h + (mw + b2)
     return h, jnp.stack(ks), jnp.stack(vs)
 
 
-def gpt_prefill(W, ids, *, num_heads, scale):
+def gpt_prefill(W, ids, *, num_heads, scale, reduce=None):
     """One batched causal pass over the whole prompt — the MXU sees
     [B,S,E] matmuls, not S tiny ones. Returns `(h, ks, vs)`: `h` [B,S,E]
     post-blocks pre-ln_f hidden states (project the position you need
@@ -113,11 +127,12 @@ def gpt_prefill(W, ids, *, num_heads, scale):
         p = jax.nn.softmax(s, axis=-1)
         return jnp.einsum("bhqk,bhkd->bhqd", p, v)
 
-    return _gen_block_pass(W, h, attend, num_heads=num_heads)
+    return _gen_block_pass(W, h, attend, num_heads=num_heads,
+                           reduce=reduce)
 
 
 def gpt_prefill_extend(W, ids, positions, ctx_attend, *, num_heads,
-                       scale):
+                       scale, reduce=None):
     """Batched causal pass over a prompt TAIL whose prefix K/V already
     lives in an external cache (the prefix-cache hit path, ISSUE 12).
 
@@ -137,10 +152,12 @@ def gpt_prefill_extend(W, ids, positions, ctx_attend, *, num_heads,
     cannot diverge from the full-prefill oracle."""
     del scale  # the ctx_attend hook owns the scale (kept for symmetry)
     h = W["wte"][ids] + W["wpe"][positions][None]
-    return _gen_block_pass(W, h, ctx_attend, num_heads=num_heads)
+    return _gen_block_pass(W, h, ctx_attend, num_heads=num_heads,
+                           reduce=reduce)
 
 
-def gpt_spec_verify(W, toks, positions, ctx_attend, *, num_heads):
+def gpt_spec_verify(W, toks, positions, ctx_attend, *, num_heads,
+                    reduce=None):
     """Batched multi-position decode block for speculative verification
     (ISSUE 14): score a [B, K+1] block of tokens — each row's current
     token followed by K draft tokens — at PER-ROW absolute positions
@@ -160,11 +177,12 @@ def gpt_spec_verify(W, toks, positions, ctx_attend, *, num_heads):
     `_gen_block_pass` is what anchors verification to the decode-step
     oracle: the block math literally cannot diverge."""
     h = W["wte"][toks] + W["wpe"][positions]
-    return _gen_block_pass(W, h, ctx_attend, num_heads=num_heads)
+    return _gen_block_pass(W, h, ctx_attend, num_heads=num_heads,
+                           reduce=reduce)
 
 
 def gpt_decode_step(W, tok, pos, cache, write_kv, attend, *, num_heads,
-                    scale):
+                    scale, reduce=None):
     """Single-position forward against an abstract KV cache.
 
     tok [B] int32; pos scalar or [B] int32 (THIS token's position —
@@ -174,27 +192,88 @@ def gpt_decode_step(W, tok, pos, cache, write_kv, attend, *, num_heads,
         write_kv(cache, layer, k, v, pos) -> cache     (k/v [B, H, D])
         attend(cache, layer, q, pos)      -> [B, H, D]
 
-    Returns (logits [B, V], cache)."""
+    Returns (logits [B, V], cache). Under tensor parallelism
+    `num_heads` is the LOCAL head count and `reduce` the per-block
+    psum — the `_gen_block_pass` contract, same placement."""
     import jax
 
     B = tok.shape[0]
     H = num_heads
     h = W["wte"][tok] + W["wpe"][pos]
-    E = h.shape[-1]
-    D = E // H
     for i, (l1w, l1b, wq, bq, wk, bk, wv, bv, wo, bo, l2w, l2b,
             w1, b1, w2, b2) in enumerate(W["blocks"]):
         x = _gen_ln(h, l1w, l1b)
-        q = (x @ _gen_w(wq, x.dtype) + bq).reshape(B, H, D)
-        k = (x @ _gen_w(wk, x.dtype) + bk).reshape(B, H, D)
-        v = (x @ _gen_w(wv, x.dtype) + bv).reshape(B, H, D)
+        q = (x @ _gen_w(wq, x.dtype) + bq).reshape(B, H, -1)
+        k = (x @ _gen_w(wk, x.dtype) + bk).reshape(B, H, -1)
+        v = (x @ _gen_w(wv, x.dtype) + bv).reshape(B, H, -1)
         cache = write_kv(cache, i, k, v, pos)
-        o = attend(cache, i, q, pos).reshape(B, E)
-        h = h + (o @ _gen_w(wo, h.dtype) + bo)
+        o = attend(cache, i, q, pos).reshape(B, -1)
+        ow = o @ _gen_w(wo, h.dtype)
+        if reduce is not None:
+            ow = reduce(ow)
+        h = h + (ow + bo)
         x2 = _gen_ln(h, l2w, l2b)
-        h = h + (jax.nn.gelu(x2 @ _gen_w(w1, h.dtype) + b1,
-                             approximate=False) @ _gen_w(w2, h.dtype) + b2)
+        mw = jax.nn.gelu(x2 @ _gen_w(w1, h.dtype) + b1,
+                         approximate=False) @ _gen_w(w2, h.dtype)
+        if reduce is not None:
+            mw = reduce(mw)
+        h = h + (mw + b2)
     return gpt_logits(W, h), cache
+
+
+def decode_weight_specs(W, axis="tp"):
+    """PartitionSpec pytree matching a `decode_weights()` pytree, for
+    head-sharded tensor parallelism over mesh axis `axis` (ISSUE 19,
+    Megatron layout): wq/wk/wv/w1 column-parallel (output dim — the
+    heads axis, since E = H*D — sharded, so their biases shard too),
+    wo/w2 row-parallel (input dim sharded, biases replicated: they are
+    added once AFTER the psum), embeddings/LNs replicated. A
+    weight-only-quantized `(q_int8 [in,out], scale [out])` leaf shards
+    its scale with the output dim it scales: split for column-parallel,
+    replicated for row-parallel. The same tree serves as shard_map
+    in_specs and as NamedSharding specs for the one-time device_put."""
+    from jax.sharding import PartitionSpec as P
+    rep = P()
+
+    def col(w):
+        return ((P(None, axis), P(axis)) if isinstance(w, tuple)
+                else P(None, axis))
+
+    def row(w):
+        return ((P(axis, None), rep) if isinstance(w, tuple)
+                else P(axis, None))
+
+    blocks = [
+        (rep, rep, col(wq), P(axis), col(wk), P(axis), col(wv), P(axis),
+         row(wo), rep, rep, rep, col(w1), P(axis), row(w2), rep)
+        for (l1w, l1b, wq, bq, wk, bk, wv, bv, wo, bo, l2w, l2b,
+             w1, b1, w2, b2) in W["blocks"]]
+    return {"wte": rep, "wpe": rep, "lnf": (rep, rep), "blocks": blocks}
+
+
+def shard_decode_weights(W, mesh, axis="tp"):
+    """One-time `device_put` of a `decode_weights()` pytree onto `mesh`
+    under the `decode_weight_specs` layout. Explicit recursion instead
+    of tree_map: a quantized `(q_int8, scale)` leaf is a tuple — the
+    same container `lnf` uses — so structure-blind mapping can't tell
+    a two-leaf container from a paired leaf."""
+    import jax
+    from jax.sharding import NamedSharding
+    specs = decode_weight_specs(W, axis=axis)
+
+    def put(w, s):
+        if isinstance(w, tuple):
+            return tuple(jax.device_put(x, NamedSharding(mesh, ss))
+                         for x, ss in zip(w, s))
+        return jax.device_put(w, NamedSharding(mesh, s))
+
+    return {
+        "wte": put(W["wte"], specs["wte"]),
+        "wpe": put(W["wpe"], specs["wpe"]),
+        "lnf": tuple(put(w, s) for w, s in zip(W["lnf"], specs["lnf"])),
+        "blocks": [tuple(put(w, s) for w, s in zip(blk, sblk))
+                   for blk, sblk in zip(W["blocks"], specs["blocks"])],
+    }
 
 
 def dense_cache_write(cache, layer, k, v, pos):

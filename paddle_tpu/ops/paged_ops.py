@@ -40,7 +40,8 @@ from ..framework.flags import flag
 __all__ = ["cached_attention", "paged_attention", "paged_gather",
            "paged_gather_layers", "paged_gather_quantized",
            "paged_prefix_attention", "paged_write",
-           "paged_write_quantized", "page_rows_for_positions"]
+           "paged_write_quantized", "page_rows_for_positions",
+           "sharded_paged_attention"]
 
 
 def cached_attention(q, kb, vb, pos, scale):
@@ -252,6 +253,42 @@ def paged_attention(q, k_pages, v_pages, page_table, pos, scale,
     kb = paged_gather(k_pages, page_table)
     vb = paged_gather(v_pages, page_table)
     return cached_attention(q, kb, vb, pos, scale)
+
+
+def sharded_paged_attention(mesh, scale, tp_axis="tp", quantized=False):
+    """KV-head-sharded `paged_attention` over a tp mesh (ISSUE 19; the
+    SNIPPETS [3] layout): one layer's pools enter
+    `P(tp, None, None, None)` — sharded along the heads axis — with
+    page table and positions replicated and q sharded on ITS head axis,
+    and each shard dispatches `paged_attention` on its local head slice
+    (Pallas kernel on TPU, dequantizing gather + dense reference
+    elsewhere). GSPMD cannot partition a pallas_call, so the shard_map
+    wrapper IS the multi-chip dispatch — without it pjit would gather
+    the full pool onto every device.
+
+    Returns a jitted
+    `f(q, k_pages, v_pages, page_table, pos)` — or, with
+    `quantized=True`,
+    `f(q, k_pages, v_pages, k_scales, v_scales, page_table, pos)` —
+    yielding [B, H, D] head-sharded like q."""
+    from jax.sharding import PartitionSpec as P
+
+    from ..parallel.spmd import compat_shard_map
+    hs = P(None, tp_axis, None)           # q / out [B, H, D]
+    pool = P(tp_axis, None, None, None)   # one layer [H, N, Pg, D]
+    spool = P(tp_axis, None)              # scale grid [H, N]
+    rep = P()
+    if quantized:
+        def call(q, kp, vp, ks, vs, pt, pos):
+            return paged_attention(q, kp, vp, pt, pos, scale,
+                                   k_scales=ks, v_scales=vs)
+        in_specs = (hs, pool, pool, spool, spool, rep, rep)
+    else:
+        def call(q, kp, vp, pt, pos):
+            return paged_attention(q, kp, vp, pt, pos, scale)
+        in_specs = (hs, pool, pool, rep, rep)
+    return jax.jit(compat_shard_map(call, mesh=mesh, in_specs=in_specs,
+                                    out_specs=hs, check=False))
 
 
 def paged_gather_layers(pages, page_table, scales=None,

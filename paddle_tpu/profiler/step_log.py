@@ -85,7 +85,11 @@ _FIELDS = ("it", "step", "t", "live", "admitted", "completed", "expired",
            "incarnation",
            # ISSUE 18: prefix-cache pages demoted to / promoted from
            # the host tier THIS iteration (same era-compat appending)
-           "tier_demotions", "tier_promotions")
+           "tier_demotions", "tier_promotions",
+           # ISSUE 19: the engine's tensor-parallel degree (mesh-slice
+           # width; 1 = single-chip lane) — constant per incarnation,
+           # recorded so mixed-fleet step rings are self-describing
+           "tp")
 
 
 def enabled() -> bool:

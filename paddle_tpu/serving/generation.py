@@ -151,6 +151,7 @@ class GenerationConfig:
                  kv_tier_chunk_pages: Optional[int] = None,
                  program_store: Optional[str] = None,
                  program_store_force: Optional[bool] = None,
+                 tp: Optional[int] = None,
                  top_k: int = 0, seed: int = 0, warmup: bool = True):
         self.max_slots = int(flag("FLAGS_gen_max_slots")
                              if max_slots is None else max_slots)
@@ -244,6 +245,13 @@ class GenerationConfig:
         self.program_store_force = bool(
             flag("FLAGS_gen_program_store_force")
             if program_store_force is None else program_store_force)
+        # mesh-slice lane (ISSUE 19): tensor-parallel degree — the
+        # engine builds its whole program pack sharded over a 'tp'
+        # mesh axis when > 1 (or when an explicit mesh is handed to
+        # GenerationEngine, which then wins over the flag/knob)
+        self.tp = int(flag("FLAGS_gen_tp") if tp is None else tp)
+        if self.tp < 1:
+            raise InvalidArgumentError("tp must be >= 1")
         self.top_k = int(top_k)
         self.seed = int(seed)
         self.warmup = bool(warmup)
@@ -490,7 +498,7 @@ class GenerationEngine:
     """
 
     def __init__(self, model, config: Optional[GenerationConfig] = None,
-                 name: str = "generation", device=None,
+                 name: str = "generation", device=None, mesh=None,
                  metrics_port: Optional[int] = None,
                  incarnation: int = 0, on_death=None, _carryover=None,
                  **overrides):
@@ -529,6 +537,38 @@ class GenerationEngine:
         self._D = mcfg.hidden_size // mcfg.num_heads
         self._scale = 1.0 / self._D ** 0.5
         self._max_position = mcfg.max_position_embeddings
+        # mesh-slice lane (ISSUE 19): tp > 1 generalizes the lane from
+        # one chip to a mesh slice — every program rebuilds as a
+        # shard_map program over the 'tp' axis with projections and KV
+        # pools head-sharded, partial sums psum-reduced once per block.
+        # An explicit `mesh` wins over FLAGS_gen_tp/config.tp and must
+        # carry a 'tp' axis; without one the engine builds its own
+        # slice from the first `tp` visible devices.
+        if mesh is not None:
+            if "tp" not in mesh.shape:
+                raise InvalidArgumentError(
+                    f"GenerationEngine mesh needs a 'tp' axis (got "
+                    f"{tuple(mesh.axis_names)})")
+            self._mesh = mesh
+            self._tp = int(mesh.shape["tp"])
+        else:
+            self._tp = int(self._cfg.tp)
+            if self._tp > 1:
+                from ..parallel.spmd import tp_mesh
+                self._mesh = tp_mesh(self._tp)
+            else:
+                self._mesh = None
+        self._cfg.tp = self._tp
+        if self._H % self._tp != 0:
+            raise InvalidArgumentError(
+                f"num_heads={self._H} not divisible by tp={self._tp} — "
+                f"head-sharded lanes need equal slices")
+        if self._tp > 1 and pack is None:
+            # one-time placement: head-sharded projection leaves,
+            # replicated embeddings/LNs (a resurrection's pack.W is
+            # already placed — reuse keeps leaves identical)
+            from ..models.gpt import shard_decode_weights
+            self._W = shard_decode_weights(self._W, self._mesh)
         if self._cfg.pages_per_seq <= 0:
             self._cfg.pages_per_seq = -(-self._max_position
                                         // self._cfg.page_size)
@@ -547,7 +587,8 @@ class GenerationEngine:
                     else self._cfg.kv_cache_dtype)
         self._cache = PagedKVCache(
             mcfg.num_layers, self._H, self._D, self._cfg.page_size,
-            self._cfg.num_pages, self._cfg.pages_per_seq, dtype=kv_dtype)
+            self._cfg.num_pages, self._cfg.pages_per_seq, dtype=kv_dtype,
+            mesh=self._mesh)
         # int8 page mode: quantize-on-append decode/prefill programs
         # thread the parallel scale pools (donated alongside the pages);
         # everything above this line — admission arithmetic, page
@@ -741,7 +782,15 @@ class GenerationEngine:
                                      paged_prefix_attention, paged_write,
                                      paged_write_quantized)
 
-        H, P, scale = self._H, self._cfg.page_size, self._scale
+        tp, mesh = self._tp, self._mesh
+        # mesh-slice lane (ISSUE 19): under shard_map every closure sees
+        # PER-SHARD tensors, so H is the LOCAL head count (head_dim —
+        # and with it `scale` — is untouched by head sharding) and
+        # `psum` is the once-per-block partial-sum reduction the
+        # row-parallel projections apply before their replicated bias
+        H = self._H // tp
+        P, scale = self._cfg.page_size, self._scale
+        psum = (lambda x: jax.lax.psum(x, "tp")) if tp > 1 else None
         top_k = self._cfg.top_k
         quant = self._quant_kv
         # pools per program signature: (kp, vp) or (kp, vp, ks, vs) —
@@ -785,7 +834,8 @@ class GenerationEngine:
         def prefill_fn(W, *rest):
             pools, (pt_row, ids, length) = rest[:NP], rest[NP:]
             note(f"prefill[b={ids.shape[1]}]")
-            h, ks, vs = gpt_prefill(W, ids, num_heads=H, scale=scale)
+            h, ks, vs = gpt_prefill(W, ids, num_heads=H, scale=scale,
+                                    reduce=psum)
             S_b = ids.shape[1]
             pos = jnp.arange(S_b)
             page_ids, offs = page_rows_for_positions(pt_row, pos, P)
@@ -840,7 +890,8 @@ class GenerationEngine:
                     k, v, offset, scale)
 
             h, ks, vs = gpt_prefill_extend(W, ids, positions, ctx_attend,
-                                           num_heads=H, scale=scale)
+                                           num_heads=H, scale=scale,
+                                           reduce=psum)
             page_ids, offs = page_rows_for_positions(pt_row, positions, P)
             page_ids = jnp.where(valid, page_ids, TRASH_PAGE)
             offs = jnp.where(valid, offs, 0)
@@ -888,7 +939,7 @@ class GenerationEngine:
             note(f"decode[m={tok.shape[0]}]")
             logits, (pools, _) = gpt_decode_step(
                 W, tok, pos, (pools, pt), write_kv, attend,
-                num_heads=H, scale=scale)
+                num_heads=H, scale=scale, reduce=psum)
             greedy = jnp.argmax(logits, -1).astype(jnp.int32)
             lg = logits / jnp.maximum(temps[:, None], 1e-6)
             if top_k:
@@ -944,7 +995,8 @@ class GenerationEngine:
                                               scale)
 
             h, ks, vs = gpt_spec_verify(W, toks_blk, positions,
-                                        ctx_attend, num_heads=H)
+                                        ctx_attend, num_heads=H,
+                                        reduce=psum)
             logits = gpt_logits(W, h)                       # [M, K1, V]
             greedy = jnp.argmax(logits, -1).astype(jnp.int32)
             # n_acc = longest prefix of drafts the model agrees with
@@ -1042,6 +1094,56 @@ class GenerationEngine:
             return (kp.at[:, :, pages].set(jnp.moveaxis(kb, 0, 2)),
                     vp.at[:, :, pages].set(jnp.moveaxis(vb, 0, 2)))
 
+        if tp > 1:
+            # partition every program over the 'tp' mesh axis: W enters
+            # under the Megatron specs, the pools (and int8 scale
+            # grids) head-sharded, page tables / token ids / scalars /
+            # PRNG keys replicated, and the logits (psum-reduced inside
+            # the blocks) leave replicated — each donated sharded pool
+            # aliases straight into its identically-sharded output
+            from jax.sharding import PartitionSpec as PS
+
+            from ..models.gpt import decode_weight_specs
+            from ..parallel.spmd import compat_shard_map
+            rep = PS()
+            wspec = decode_weight_specs(self._W)
+            pool5 = PS(None, "tp", None, None, None)   # [L,H,N,Pg,D]
+            grid3 = PS(None, "tp", None)               # [L,H,N]
+            pspecs = ((pool5, pool5, grid3, grid3) if quant
+                      else (pool5, pool5))
+            page4 = PS(None, "tp", None, None)         # one page [L,H,Pg,D]
+            page2 = PS(None, "tp")                     # scale row [L,H]
+            chunk5 = PS(None, None, "tp", None, None)  # [W,L,H,Pg,D]
+            chunk3 = PS(None, None, "tp")              # [W,L,H]
+
+            def shard(fn, extras, outs, with_w=True):
+                ins = ((wspec,) if with_w else ()) + pspecs + extras
+                return compat_shard_map(fn, mesh=mesh, in_specs=ins,
+                                        out_specs=outs, check=False)
+
+            prefill_fn = shard(prefill_fn, (rep,) * 3, (*pspecs, rep))
+            tail_prefill_fn = shard(tail_prefill_fn, (rep,) * 4,
+                                    (*pspecs, rep))
+            decode_fn = shard(decode_fn, (rep,) * 7,
+                              (*pspecs, rep, rep))
+            verify_fn = shard(verify_fn, (rep,) * 8,
+                              (*pspecs, rep, rep, rep))
+            cow_fn = shard(cow_fn, (rep,) * 2, pspecs, with_w=False)
+            zero_fn = shard(zero_fn, (rep,), pspecs, with_w=False)
+            # tier seam (ISSUE 18): the host store keeps FULL pages —
+            # the gather's sharded out_specs reassemble every head
+            # shard into one host block, and the write's chunk specs
+            # split the staged full blocks back across the slice
+            tier_gather_fn = shard(
+                tier_gather_fn, (rep,),
+                (page4, page4, page2, page2) if quant
+                else (page4, page4), with_w=False)
+            tier_write_fn = shard(
+                tier_write_fn,
+                (rep, chunk5, chunk5, chunk3, chunk3) if quant
+                else (rep, chunk5, chunk5),
+                pspecs, with_w=False)
+
         donate = tuple(range(1, 1 + NP))
         self._prefill_jit = jax.jit(prefill_fn, donate_argnums=donate)
         self._tail_jit = jax.jit(tail_prefill_fn, donate_argnums=donate)
@@ -1106,6 +1208,12 @@ class GenerationEngine:
                 "kv_tier_chunk_pages": self._cfg.kv_tier_chunk_pages,
                 "spec_k": self._spec_k,
                 "top_k": self._cfg.top_k,
+                # mesh-slice lane (ISSUE 19): tp degree + mesh shape
+                # join the content key — a shard_map program compiled
+                # for one slice layout must never resolve on another
+                "tp": self._tp,
+                "mesh_shape": (dict(self._mesh.shape)
+                               if self._mesh is not None else None),
             },
             "jax": jax.__version__,
             "jaxlib": jaxlib.__version__,
@@ -1237,7 +1345,20 @@ class GenerationEngine:
                     blocks[2][j - lo] = entries[j].ks
                     blocks[3][j - lo] = entries[j].vs
             with self._dev_ctx():
-                return [jax.device_put(a) for a in [row] + blocks]
+                if self._tp == 1:
+                    return [jax.device_put(a) for a in [row] + blocks]
+                # stage straight onto the slice: each block is a FULL
+                # host page [C, L, H, ...] — split its head axis across
+                # the mesh here so the donating tier_write dispatch
+                # pays no reshard (the overlap this path exists for)
+                from jax.sharding import NamedSharding, PartitionSpec
+
+                def ns(a):
+                    spec = [None] * a.ndim
+                    spec[2] = "tp"
+                    return NamedSharding(self._mesh, PartitionSpec(*spec))
+                return [jax.device_put(row)] + [
+                    jax.device_put(a, ns(a)) for a in blocks]
 
         t0 = _now_ms()
         written = 0
@@ -1271,11 +1392,12 @@ class GenerationEngine:
         writes, so zeros are the correct state (shape/dtype metadata
         survives buffer deletion)."""
         import jax.numpy as jnp
-        self._kp = jnp.zeros(self._kp.shape, self._kp.dtype)
-        self._vp = jnp.zeros(self._vp.shape, self._vp.dtype)
+        place = self._cache._place  # keeps the tp mesh placement
+        self._kp = place(jnp.zeros(self._kp.shape, self._kp.dtype))
+        self._vp = place(jnp.zeros(self._vp.shape, self._vp.dtype))
         if self._quant_kv:
-            self._ks = jnp.zeros(self._ks.shape, self._ks.dtype)
-            self._vs = jnp.zeros(self._vs.shape, self._vs.dtype)
+            self._ks = place(jnp.zeros(self._ks.shape, self._ks.dtype))
+            self._vs = place(jnp.zeros(self._vs.shape, self._vs.dtype))
 
     def _selfcheck_alias(self, compiled, recorded: str):
         """The PR 1 structural gate on a LOADED executable: its
@@ -1757,7 +1879,8 @@ class GenerationEngine:
             prefill_ms=round(it["prefill_ms"], 3),
             decode_ms=round(it["decode_ms"], 3),
             incarnation=self.incarnation,
-            tier_demotions=tier_dem, tier_promotions=tier_pro)
+            tier_demotions=tier_dem, tier_promotions=tier_pro,
+            tp=self._tp)
         self._step_log.record(rec)
 
     def _resolve_later(self, req: Optional[_GenRequest], fut,
@@ -2807,6 +2930,9 @@ class GenerationEngine:
             "steps": steps,
             "prefills": prefills,
             "tokens": tokens,
+            # mesh-slice lane (ISSUE 19): slice degree + what one chip
+            # of it holds (pages stats carry the per-shard bytes too)
+            "tp": self._tp,
             # speculative decoding + chunked prefill (ISSUE 14): the
             # acceptance economics (tokens_per_step > 1 is the win) and
             # the chunk count the bench + reports read
@@ -2891,6 +3017,13 @@ class GenerationEngine:
             "pages_in_use": self._cache.pages_in_use,
             "slots_free": sum(1 for r in self._slots if r is None),
             "live": self._num_active(),
+            # mesh-slice lane (ISSUE 19): page counts above are
+            # tp-invariant (the page axis is FULL on every shard);
+            # kv_shard_bytes is what ONE chip of the slice pays — the
+            # per-device HBM reality the router compares (== the whole
+            # pool for a single-chip lane)
+            "tp": self._tp,
+            "kv_shard_bytes": self._cache.shard_hbm_bytes(),
         }
         if self._tier is not None:
             # host-tier surface (ISSUE 18): the router folds the tier

@@ -74,6 +74,15 @@ def _note_pool_bytes(delta: int) -> None:
     monitor.stat_gauge_add("STAT_kv_cache_hbm_bytes", delta)
 
 
+# Per-shard companion gauge (ISSUE 19): on a tp mesh each device holds
+# heads/tp of every pool, so the PER-DEVICE HBM cost is total/tp — the
+# number admission headroom and capacity planning must use. Only
+# tp>1 caches contribute; shard gauges times tp reconcile with the
+# aggregate STAT_kv_cache_hbm_bytes for those caches.
+def _note_shard_bytes(delta: int) -> None:
+    monitor.stat_gauge_add("STAT_tp_kv_shard_bytes", delta)
+
+
 class PagedKVCache:
     """Block allocator over per-layer paged K/V pools.
 
@@ -83,7 +92,7 @@ class PagedKVCache:
 
     def __init__(self, num_layers: int, num_heads: int, head_dim: int,
                  page_size: int, num_pages: int, pages_per_seq: int,
-                 dtype="float32"):
+                 dtype="float32", mesh=None, tp_axis: str = "tp"):
         if page_size < 1 or num_pages < 2 or pages_per_seq < 1:
             raise InvalidArgumentError(
                 f"PagedKVCache needs page_size>=1, num_pages>=2 (page 0 "
@@ -97,19 +106,31 @@ class PagedKVCache:
         self.pages_per_seq = int(pages_per_seq)
         self.dtype = str(dtype)
         self.quantized = self.dtype == "int8"
+        # mesh-sliced pools (ISSUE 19): on a tp mesh the K/V pools (and
+        # the int8 scale grids) are laid out head-sharded with
+        # NamedSharding — each device holds [L, H/tp, N, P, D], so one
+        # chip's HBM pays total/tp and the page axis stays FULL on every
+        # shard (page ids, tables and the allocator are tp-invariant)
+        self.mesh = mesh
+        self.tp_axis = str(tp_axis)
+        self.tp = int(mesh.shape[tp_axis]) if mesh is not None else 1
+        if self.num_heads % self.tp != 0:
+            raise InvalidArgumentError(
+                f"num_heads={self.num_heads} not divisible by "
+                f"tp={self.tp} — head-sharded pools need equal slices")
         import jax.numpy as jnp
         shape = (self.num_layers, self.num_heads, self.num_pages,
                  self.page_size, self.head_dim)
-        self.k_pages = jnp.zeros(shape, self.dtype)
-        self.v_pages = jnp.zeros(shape, self.dtype)
+        self.k_pages = self._place(jnp.zeros(shape, self.dtype))
+        self.v_pages = self._place(jnp.zeros(shape, self.dtype))
         # int8 page mode: per-(layer, head, page) symmetric abs-max
         # scales in a parallel pool (dequant = q * scale; scale 0 means
         # "page empty" — zero-on-free resets both pools, so a freed
         # page's next owner starts from a clean quantization grid)
         if self.quantized:
             sshape = (self.num_layers, self.num_heads, self.num_pages)
-            self.k_scales = jnp.zeros(sshape, "float32")
-            self.v_scales = jnp.zeros(sshape, "float32")
+            self.k_scales = self._place(jnp.zeros(sshape, "float32"))
+            self.v_scales = self._place(jnp.zeros(sshape, "float32"))
         else:
             self.k_scales = self.v_scales = None
         # LIFO free list: the page freed last is reallocated first, so a
@@ -129,19 +150,44 @@ class PagedKVCache:
         b = self.hbm_bytes()
         _note_pool_bytes(b)
         weakref.finalize(self, _note_pool_bytes, -b)
+        if self.tp > 1:
+            s = self.shard_hbm_bytes()
+            _note_shard_bytes(s)
+            weakref.finalize(self, _note_shard_bytes, -s)
+
+    def _place(self, arr):
+        """Lay one pool onto the tp mesh head-sharded (axis 1); a
+        mesh-less cache keeps the single-device default placement."""
+        if self.mesh is None:
+            return arr
+        import jax
+        from jax.sharding import NamedSharding, PartitionSpec
+        spec = [None] * arr.ndim
+        spec[1] = self.tp_axis
+        return jax.device_put(
+            arr, NamedSharding(self.mesh, PartitionSpec(*spec)))
 
     # -- capacity arithmetic ----------------------------------------------
 
     @staticmethod
     def page_hbm_bytes(num_layers: int, num_heads: int, head_dim: int,
-                       page_size: int, dtype="float32") -> int:
+                       page_size: int, dtype="float32", tp: int = 1) -> int:
         """Device bytes ONE page costs across both pools (K and V, every
         layer), including its slice of the int8 scale pools — the unit
-        of the capacity arithmetic below."""
+        of the capacity arithmetic below. With `tp > 1` this is the
+        PER-SHARD cost (each device stores heads/tp of the page), the
+        number a per-chip HBM budget actually pays — router pressure
+        and `pages_for_budget` must size against the shard, not the
+        unsharded fiction."""
+        tp = int(tp)
+        if tp < 1 or num_heads % tp != 0:
+            raise InvalidArgumentError(
+                f"num_heads={num_heads} not divisible by tp={tp}")
         item = np.dtype(dtype).itemsize
-        b = 2 * num_layers * num_heads * page_size * head_dim * item
+        hl = num_heads // tp
+        b = 2 * num_layers * hl * page_size * head_dim * item
         if str(dtype) == "int8":
-            b += 2 * num_layers * num_heads * 4  # fp32 scale per (L,H)
+            b += 2 * num_layers * hl * 4  # fp32 scale per (L, H/tp)
         return b
 
     def page_host_bytes(self) -> int:
@@ -150,7 +196,10 @@ class PagedKVCache:
         mode) the fp32 scale rows — identical arithmetic to
         `page_hbm_bytes`, because the tier stores the bytes RAW (no
         transcoding; that is the cross-tier exactness guarantee). The
-        tier byte-budget / working-set sizing unit (ISSUE 18)."""
+        tier byte-budget / working-set sizing unit (ISSUE 18). Always
+        the FULL (unsharded) page: the tier gather reassembles every
+        head shard into one host block, so host RAM pays tp-invariant
+        bytes per page."""
         return self.page_hbm_bytes(self.num_layers, self.num_heads,
                                    self.head_dim, self.page_size,
                                    self.dtype)
@@ -158,21 +207,31 @@ class PagedKVCache:
     @classmethod
     def pages_for_budget(cls, budget_bytes: int, *, num_layers: int,
                          num_heads: int, head_dim: int, page_size: int,
-                         dtype="float32") -> int:
+                         dtype="float32", tp: int = 1) -> int:
         """Most pages (incl. the reserved scratch page) an HBM budget
         admits: int8 pages are ~4x denser than fp32 — the serving-
         capacity multiplier the quantized KV mode exists for, and how
-        bench.py builds equal-byte fp32/int8 pools."""
+        bench.py builds equal-byte fp32/int8 pools. `budget_bytes` is
+        PER-CHIP HBM; with tp > 1 each chip stores only heads/tp of
+        every page, so the same per-chip budget admits tp× the pages —
+        the mesh-slice capacity unlock (ISSUE 19)."""
         per = cls.page_hbm_bytes(num_layers, num_heads, head_dim,
-                                 page_size, dtype)
+                                 page_size, dtype, tp=tp)
         return max(2, int(budget_bytes) // per)
 
     def hbm_bytes(self) -> int:
-        """Live device bytes of the K/V pools + scale pools."""
+        """Live device bytes of the K/V pools + scale pools (summed
+        across every shard on a tp mesh)."""
         b = int(self.k_pages.nbytes) + int(self.v_pages.nbytes)
         if self.quantized:
             b += int(self.k_scales.nbytes) + int(self.v_scales.nbytes)
         return b
+
+    def shard_hbm_bytes(self) -> int:
+        """Per-device pool bytes: heads shard evenly over tp, so ONE
+        chip's HBM holds exactly total/tp — the gauge admission headroom
+        reasons about (shards × tp reconcile to `hbm_bytes`)."""
+        return self.hbm_bytes() // self.tp
 
     @property
     def usable_pages(self) -> int:
@@ -439,6 +498,10 @@ class PagedKVCache:
             "dtype": self.dtype,
             "quantized": self.quantized,
             "hbm_bytes": self.hbm_bytes(),
+            # mesh-slice lanes (ISSUE 19): per-device pool bytes — what
+            # ONE chip's HBM actually pays (== hbm_bytes when tp == 1)
+            "tp": self.tp,
+            "shard_hbm_bytes": self.shard_hbm_bytes(),
             "page_size": self.page_size,
             "usable_pages": self.usable_pages,
             "pages_in_use": self.pages_in_use,

@@ -84,7 +84,11 @@ def test_10b_tp_dp_train_step_lowers_with_collectives():
     # the partitioner accepted the 10B layout (8-way SPMD over dp×mp)
     assert "num_partitions = 8" in text or "num_partitions=8" in text, \
         text[:400]
-    assert '"mp"' in text and '"dp"' in text
+    # mesh axis names only appear in the pre-partitioning text on jax
+    # versions that lower through the shardy dialect; GSPMD-era jax
+    # records the layout as mhlo.sharding device assignments instead —
+    # accept either spelling of "the mesh layout reached the compiler"
+    assert ('"mp"' in text and '"dp"' in text) or "mhlo.sharding" in text
 
     # collectives appear after SPMD partitioning — compile (no weights
     # materialize; XLA only codegens) and inspect the partitioned module

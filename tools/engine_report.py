@@ -21,7 +21,10 @@ speculation paying off, chk interleaved with decode wall is chunked
 prefill protecting TPOT), the engine generation (`inc` — a supervised
 restart bumps the incarnation counter, ISSUE 15, so a ring spanning a
 death + resurrection reads as two generations with the
-ENGINE_RESTART/REPLAY_ADMIT audit events between them), and
+ENGINE_RESTART/REPLAY_ADMIT audit events between them), the engine's
+mesh-slice width (`tp` — ISSUE 19: a tensor-parallel lane records its
+degree every iteration so mixed-fleet rings are self-describing;
+records predating the field read as single-chip), and
 prefill-vs-decode wall — then the audit tail with reason codes (per
 request: ADMIT_PREFIX_HIT carries prefix_tokens, COW_SPLIT the split
 pages), so "why did this request wait/die" reads straight off the
@@ -75,11 +78,15 @@ def summarize(records: List[dict]) -> dict:
     # bumps `incarnation`, so >1 distinct value means the ring spans an
     # engine death + resurrection (records predating the field read 0)
     incarnations = sorted({r.get("incarnation", 0) for r in records})
+    # mesh-slice width (ISSUE 19): constant per incarnation; records
+    # predating the field (or seed-era zeros) read as single-chip
+    tp = max((r.get("tp", 0) for r in records), default=0) or 1
     return {
         "iterations": len(records),
         "decode_steps": decode_steps,
         "incarnations": incarnations,
         "restarts_in_window": max(0, len(incarnations) - 1),
+        "tp": tp,
         **tot,
         # tokens delivered per decode step over the window. NOTE: the
         # numerator includes prefill FIRST tokens (the ring does not
@@ -129,8 +136,10 @@ def render(name: str, eng: dict, last: int = 0,
               "engine never iterated)", file=out)
     else:
         peak_live = summ["peak_live"]
+        lane = (f", tp={summ['tp']} mesh-slice lane"
+                if summ.get("tp", 1) > 1 else "")
         print(f"   {summ['iterations']} iterations retained "
-              f"({summ['decode_steps']} decode steps): "
+              f"({summ['decode_steps']} decode steps{lane}): "
               f"admitted {summ['admitted']}, completed "
               f"{summ['completed']}, expired {summ['expired']}, "
               f"poisoned {summ['poisoned']}, aborted "
@@ -165,7 +174,8 @@ def render(name: str, eng: dict, last: int = 0,
               f"{summ['spec_accepted']}/{summ['spec_drafted']} drafts "
               f"accepted, {summ['prefill_chunks']} prefill chunks)",
               file=out)
-        hdr = (f"   {'inc':>3} {'it':>6} {'step':>6} {'slots':<10} "
+        hdr = (f"   {'inc':>3} {'tp':>2} {'it':>6} {'step':>6} "
+               f"{'slots':<10} "
                f"{'adm':>3} "
                f"{'done':>4} {'exp':>3} {'psn':>3} {'abt':>3} "
                f"{'queue':>5} {'age_ms':>8} {'pages':>5} {'free':>5} "
@@ -175,6 +185,7 @@ def render(name: str, eng: dict, last: int = 0,
         print(hdr, file=out)
         for r in records:
             print(f"   {r.get('incarnation', 0):>3} "
+                  f"{r.get('tp', 0) or 1:>2} "
                   f"{r.get('it', 0):>6} {r.get('step', 0):>6} "
                   f"[{_bar(r.get('live', 0), peak_live)}] "
                   f"{r.get('admitted', 0):>3} "
