@@ -701,6 +701,20 @@ def bench_generation():
     finally:
         paddle.set_flags(prev_ring)
 
+    # fleet-observability A/B (ISSUE 20): trace-id propagation and the
+    # metrics-history sampler are both flag-gated; their combined cost
+    # is the tokens/sec delta against an identical engine with both
+    # OFF (acceptance: <2% on real chips; CPU smoke is scheduler-noisy,
+    # same policy as the step-ring A/B above)
+    prev_obs = paddle.get_flags(["FLAGS_trace_propagation",
+                                 "FLAGS_metrics_history_interval_s"])
+    paddle.set_flags({"FLAGS_trace_propagation": False,
+                      "FLAGS_metrics_history_interval_s": 0.0})
+    try:
+        tps_noobs, _ = run_engine("bench_generation_noobs")
+    finally:
+        paddle.set_flags(prev_obs)
+
     # ---- prefix-cache arm (ISSUE 12): N requests sharing one long
     # system prompt, TTFT measured per request via submit_stream (time
     # to the first streamed token). Gates: TTFT p50 >= 2x better with
@@ -962,6 +976,10 @@ def bench_generation():
         "step_log_off_tps": round(tps_noring, 2),
         "step_log_overhead_pct": round(
             100.0 * (1.0 - eng_tps / tps_noring), 2) if tps_noring
+        else None,
+        "observability_off_tps": round(tps_noobs, 2),
+        "observability_overhead_pct": round(
+            100.0 * (1.0 - eng_tps / tps_noobs), 2) if tps_noobs
         else None,
         "step_log_records": s["step_log"]["recorded"],
         "audit_events": s["step_log"]["audit_events"],
@@ -1231,6 +1249,12 @@ def bench_router():
     ttft_speedup = round(ttft_rr / max(ttft_aff, 1e-9), 3)
 
     # ---- one-replica-kill goodput arm -------------------------------------
+    # the tracer ring is cleared here so the fleet-trace merge smoke
+    # below sees ONLY the kill arms' flow chains (the affinity arms'
+    # older events may be partially ring-evicted, which would read as
+    # cut chains)
+    from paddle_tpu.profiler import tracer
+    tracer.clear()
     kill_prompts = [rng.randint(0, VOCAB, size=(K_PROMPT,))
                     .astype("int64") for _ in range(K_REQ)]
     k_pool = K_SLOTS * -(-(K_PROMPT + K_MAXN) // PAGE) + 1
@@ -1312,13 +1336,33 @@ def bench_router():
             failpoints.reset()
 
     clean = kill_arm("bench_router_clean", "")
+    scrape_mid = tracer.chrome_trace()["traceEvents"]
     fault = kill_arm("bench_router_kill",
                      f"decode_step_raise@{fault_step}")
+    scrape_final = tracer.chrome_trace()["traceEvents"]
     kill_identical = all(
         a is not None and b is not None and np.array_equal(a, b)
         for a, b in zip(clean.pop("outs"), fault.pop("outs")))
     goodput_ratio = round(fault["goodput_tokens_per_sec"]
                           / max(clean["goodput_tokens_per_sec"], 1e-9), 3)
+
+    # ---- fleet-trace merge smoke (ISSUE 20) -------------------------------
+    # two overlapping scrapes of the kill-arm fleet (one between the
+    # arms, one after the injected death) merged by
+    # tools/fleet_trace.py: exact duplicates must dedup, every
+    # fleet_request flow chain must resolve start-to-finish under its
+    # trace id, and the supervised restart must show as at least one
+    # >1-incarnation chain — the single-timeline artifact the flight
+    # deck promises
+    import importlib.util
+    ft_spec = importlib.util.spec_from_file_location(
+        "fleet_trace", os.path.join(
+            os.path.dirname(os.path.abspath(__file__)),
+            "tools", "fleet_trace.py"))
+    fleet_trace = importlib.util.module_from_spec(ft_spec)
+    ft_spec.loader.exec_module(fleet_trace)
+    _, merge_report = fleet_trace.merge([("scrape_mid", scrape_mid),
+                                         ("scrape_final", scrape_final)])
 
     extra = {
         "replicas": REPLICAS,
@@ -1341,6 +1385,7 @@ def bench_router():
             "goodput_ratio_fault_vs_clean": goodput_ratio,
             "token_identical_fault_vs_clean": kill_identical,
         },
+        "fleet_trace_merge": merge_report,
     }
     return ttft_speedup, extra
 
@@ -2642,6 +2687,15 @@ def _run_mode(mode="train", backend=None):
                     f"REGRESSION: step-ring accounting costs "
                     f"{extra['step_log_overhead_pct']}% tokens/sec — "
                     f"above the 2% ceiling (FLAGS_gen_step_log A/B)\n")
+            if (extra.get("observability_overhead_pct") is not None
+                    and extra["observability_overhead_pct"] > 2.0
+                    and not _SMOKE):
+                sys.stderr.write(
+                    f"REGRESSION: trace propagation + history sampling "
+                    f"cost {extra['observability_overhead_pct']}% "
+                    f"tokens/sec — above the 2% ceiling "
+                    f"(FLAGS_trace_propagation + "
+                    f"FLAGS_metrics_history_interval_s A/B)\n")
             parm = extra["prefix_arm"]
             if parm["ttft_speedup"] < 2.0:
                 sys.stderr.write(
@@ -2807,6 +2861,21 @@ def _run_mode(mode="train", backend=None):
                     f"pages still allocated across the fleet after "
                     f"the kill arm drained — the replay path is "
                     f"leaking pages\n")
+            m = extra["fleet_trace_merge"]
+            if m["unresolved"]:
+                sys.stderr.write(
+                    f"REGRESSION: {len(m['unresolved'])} fleet_request "
+                    f"flow chain(s) failed to resolve in the merged "
+                    f"kill-arm trace ({m['unresolved'][:4]}) — a "
+                    f"request's trace id must survive replica death "
+                    f"and supervised replay\n")
+            if m["replayed"] < 1:
+                sys.stderr.write(
+                    f"REGRESSION: the merged kill-arm trace shows "
+                    f"{m['replayed']} chains spanning >1 incarnation — "
+                    f"the injected restart's replays must ride their "
+                    f"original trace ids (flow steps across "
+                    f"incarnations)\n")
         except Exception as e:  # noqa: BLE001
             traceback.print_exc()
             _emit(headline, 0.0, "x ttft p50 rr/affinity",

@@ -434,6 +434,29 @@ register_flag("FLAGS_metrics_port", 0,
               "(Prometheus text), /stats (JSON incl. engine lanes) and "
               "/trace (chrome trace) on 127.0.0.1; 0 = off; engines "
               "also accept InferenceEngine(metrics_port=)")
+register_flag("FLAGS_trace_propagation", True,
+              "fleet-wide trace-context propagation "
+              "(profiler/trace_context.py): the Router (or the engine, "
+              "for direct submits) mints one 16-hex trace id per "
+              "request; it rides placement audits (trace=), supervisor "
+              "delegation and replay, per-incarnation GenSpans "
+              "(',tid=' reqspan field) and streams, and is emitted as "
+              "cross-process-stable 'fleet_request' chrome flow events "
+              "that tools/fleet_trace.py links across N replicas' "
+              "/trace exports; off = no ids minted, zero per-request "
+              "cost")
+register_flag("FLAGS_metrics_history_interval_s", 5.0,
+              "period of the lazy time-series sampler "
+              "(profiler/timeseries.py): every registered monitor "
+              "counter (as a rate/s) and gauge (as a level) plus "
+              "per-engine pressure() ticks recorded into bounded "
+              "per-name rings, served as /history JSON and chrome 'C' "
+              "counter tracks; 0 disables sampling (the thread idles; "
+              "runtime set_flags toggling works in both directions)")
+register_flag("FLAGS_metrics_history_samples", 512,
+              "max samples kept per series by the time-series sampler; "
+              "bounds /history memory no matter how long the process "
+              "runs (ring semantics: oldest samples drop first)")
 
 
 def set_flags(flags: Dict[str, Any]) -> None:

@@ -24,8 +24,12 @@ Endpoints (`MetricsServer`, 127.0.0.1, daemon threads, zero deps):
 - `/slo` — SLO objectives, per-engine multi-window burn rates and
   violated flags (`profiler/slo.py`).
 - `/trace` — the current chrome trace (same payload
-  `export_chrome_tracing` writes, scheduler counter tracks included),
-  so a live timeline is one curl away.
+  `export_chrome_tracing` writes, scheduler + history counter tracks
+  included), so a live timeline is one curl away.
+- `/history` — the time-series metrics rings (`profiler/timeseries.py`:
+  counters-as-rates, gauges-as-levels, per-replica pressure ticks),
+  bounded by FLAGS_metrics_history_samples — the trend view `/stats`
+  cannot give, and the input of `tools/router_report.py --history`.
 - `/healthz` — liveness: 200 whenever the process can answer.
 - `/readyz` — readiness: 200 iff ≥1 registered engine is warmed up,
   has a live lane, is not draining, and its queue is below the
@@ -49,11 +53,12 @@ from typing import Optional
 
 from ..framework import monitor
 from ..framework.flags import flag
-from . import device_telemetry, flight_recorder, slo, step_log, tracer
+from . import (device_telemetry, flight_recorder, slo, step_log,
+               timeseries, tracer)
 
 __all__ = ["render_prometheus", "MetricsServer", "start_metrics_server",
-           "register_engine", "unregister_engine", "stats_payload",
-           "readiness_payload"]
+           "register_engine", "unregister_engine", "live_engines",
+           "stats_payload", "readiness_payload"]
 
 _PREFIX = "paddle_tpu_"
 
@@ -149,6 +154,21 @@ def unregister_engine(engine) -> None:
             del _engines[engine.name]
 
 
+def live_engines() -> dict:
+    """`{name: engine}` of the still-alive registered engines — the
+    registry surface the time-series sampler takes `pressure()` ticks
+    from (weakrefs resolved, dead entries skipped but not reaped: the
+    reaping stays with `_engines_snapshot`, the only mutating reader)."""
+    with _engines_lock:
+        items = list(_engines.items())
+    out = {}
+    for name, ref in items:
+        eng = ref()
+        if eng is not None:
+            out[name] = eng
+    return out
+
+
 def _engines_snapshot() -> dict:
     with _engines_lock:
         items = list(_engines.items())
@@ -231,10 +251,17 @@ class _Handler(BaseHTTPRequestHandler):
                 tracer.sample_counters()
                 trace = tracer.chrome_trace()
                 # scheduler state as counter tracks under the request
-                # timeline (step ring → "C" events)
+                # timeline (step ring → "C" events), plus the history
+                # rings' rate/level series (ISSUE 20)
                 trace["traceEvents"].extend(
                     step_log.chrome_counter_events())
+                trace["traceEvents"].extend(
+                    timeseries.chrome_counter_events())
                 body = json.dumps(trace, default=str).encode()
+                ctype = "application/json"
+            elif path == "/history":
+                body = json.dumps(timeseries.history_payload(),
+                                  default=str).encode()
                 ctype = "application/json"
             elif path == "/healthz":
                 body = json.dumps({"status": "ok",
@@ -248,7 +275,7 @@ class _Handler(BaseHTTPRequestHandler):
             else:
                 self.send_error(404, "unknown endpoint (have /metrics "
                                      "/stats /steps /slo /trace "
-                                     "/healthz /readyz)")
+                                     "/history /healthz /readyz)")
                 return
         except Exception as e:  # noqa: BLE001 — a scrape never kills us
             self.send_error(500, repr(e))
@@ -276,6 +303,7 @@ class MetricsServer:
         self._thread.start()
         flight_recorder.touch()   # metrics users want the samplers running
         device_telemetry.touch()
+        timeseries.touch()
 
     @property
     def url(self) -> str:

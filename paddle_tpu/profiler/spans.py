@@ -214,12 +214,18 @@ class GenSpan:
     can split hit from miss requests. `spec_tokens` (ISSUE 14) is the
     count of accepted speculative draft tokens — it rides the instant as
     `acc=`, so offline TPOT attribution can split speculation's
-    multi-token steps from plain decode."""
+    multi-token steps from plain decode. `trace_id` (ISSUE 20) is the
+    fleet-wide 16-hex trace id — it rides the instant as `tid=` and is
+    re-emitted as cross-process-stable `fleet_request` flow events, so
+    the merged fleet timeline links router decision → this replica's
+    span → any post-restart replay span under ONE arrow chain even
+    though each incarnation allocated a fresh local rid."""
 
     __slots__ = ("rid", "engine", "slot", "stamps", "prefix_tokens",
-                 "spec_tokens", "incarnation")
+                 "spec_tokens", "incarnation", "trace_id")
 
-    def __init__(self, engine: str, incarnation: int = 0):
+    def __init__(self, engine: str, incarnation: int = 0,
+                 trace_id: Optional[str] = None):
         self.rid = next(_next_id)
         self.engine = engine
         self.slot: Optional[int] = None
@@ -230,12 +236,24 @@ class GenSpan:
         # supervised restart bumps it); rides the reqspan as `inc=` so
         # offline reports split pre- from post-restart requests
         self.incarnation = int(incarnation)
+        # fleet trace id (ISSUE 20) — None when propagation is off
+        self.trace_id = trace_id
 
     def stamp(self, phase: str, t: Optional[float] = None) -> None:
         self.stamps[phase] = time.perf_counter() if t is None else t
 
     def flow(self, ph: str) -> None:
         tracer.flow("gen_request", ph, self.rid)
+
+    def fleet_flow(self, ph: str) -> None:
+        """Emit the fleet-wide flow event for this request's trace id —
+        the flow id is derived from the 16-hex id itself, so every
+        process that handled the same request emits under the same id
+        and the merged timeline draws one chain."""
+        if self.trace_id is None:
+            return
+        from . import trace_context
+        tracer.flow("fleet_request", ph, trace_context.flow_id(self.trace_id))
 
     def finish(self, n_tokens: int,
                prefix_tokens: Optional[int] = None,
@@ -268,12 +286,14 @@ class GenSpan:
         # separated head keeps its field count — downstream parsers
         # split on ":", and each appended value is regex-optional so
         # older traces (and older parsers) keep working both ways
+        tid = f",tid={self.trace_id}" if self.trace_id else ""
         tracer.instant(
             f"reqspan:{self.rid}:{self.engine}:slot{self.slot}:"
             f"n={n_tokens}:ttft={ttft:.3f},tpot={tpot:.3f},e={e2e:.3f},"
             f"pfx={self.prefix_tokens},acc={self.spec_tokens},"
-            f"inc={self.incarnation}",
+            f"inc={self.incarnation}{tid}",
             t=s.get("resolved", last))
+        self.fleet_flow("f")
 
     def to_dict(self) -> dict:
         now = time.perf_counter()
@@ -283,12 +303,19 @@ class GenSpan:
                 if "queued" in self.stamps else None}
 
 
-def start_gen(engine: str, incarnation: int = 0) -> Optional[GenSpan]:
+def start_gen(engine: str, incarnation: int = 0,
+              trace_id: Optional[str] = None,
+              trace_root: bool = True) -> Optional[GenSpan]:
     """GenSpan for one accepted generative request (None when spans are
-    off — same FLAGS_serving_spans gate as the serving pipeline)."""
+    off — same FLAGS_serving_spans gate as the serving pipeline).
+
+    `trace_root=False` means an upstream hop (the Router) already
+    opened the fleet flow chain for `trace_id`, so admission emits a
+    flow STEP ("t"); a locally-minted id opens the chain here ("s")."""
     if not enabled():
         return None
-    span = GenSpan(engine, incarnation)
+    span = GenSpan(engine, incarnation, trace_id=trace_id)
     span.stamp("queued")
     span.flow("s")
+    span.fleet_flow("s" if trace_root else "t")
     return span

@@ -46,6 +46,12 @@ oldest overwritten — the same bounding discipline as the trace rings):
                   prefix-cache pages demoted to / promoted back from
                   the host-RAM tier THIS iteration (ISSUE 18 — the
                   cross-tier traffic signal)
+    attr_admit_ms / attr_promote_ms / attr_bookkeep_ms / attr_idle_ms /
+    attr_wall_ms  per-iteration goodput attribution (ISSUE 20): with
+                  prefill_ms and decode_ms these six buckets tile the
+                  step thread's mark-to-mark wall EXACTLY (bookkeeping
+                  is the remainder of the rounded siblings), feeding
+                  the STAT_gen_step_attr_* histogram family
 
 The ring is exported three ways: `/steps` JSON
 (`steps_payload()` — per-engine records + audit-log tail, the input of
@@ -89,7 +95,19 @@ _FIELDS = ("it", "step", "t", "live", "admitted", "completed", "expired",
            # ISSUE 19: the engine's tensor-parallel degree (mesh-slice
            # width; 1 = single-chip lane) — constant per incarnation,
            # recorded so mixed-fleet step rings are self-describing
-           "tp")
+           "tp",
+           # ISSUE 20: per-iteration goodput attribution. Six buckets —
+           # attr_admit_ms (scheduler work net of nested device calls),
+           # prefill_ms (above), attr_promote_ms (tier re-upload),
+           # decode_ms (above), attr_bookkeep_ms (host bookkeeping:
+           # record/flush/slice — computed as the remainder of the
+           # ROUNDED siblings, so the stored buckets sum EXACTLY to
+           # attr_wall_ms), attr_idle_ms (cv waits) — tile the step
+           # thread's mark-to-mark iteration wall. attr_wall_ms == 0
+           # marks a record from before this era (or the abort-path
+           # flush record, which never owned a full iteration)
+           "attr_admit_ms", "attr_promote_ms", "attr_bookkeep_ms",
+           "attr_idle_ms", "attr_wall_ms")
 
 
 def enabled() -> bool:
@@ -111,6 +129,7 @@ class StepRecord:
 
 _hists_lock = threading.Lock()
 _hists = None
+_attr_hists = None
 
 
 def _step_hists():
@@ -122,6 +141,22 @@ def _step_hists():
                 _hists = (monitor.histogram("engine_step_ms"),
                           monitor.histogram("gen_queue_age_ms"))
     return _hists
+
+
+def _step_attr_hists():
+    global _attr_hists
+    if _attr_hists is None:
+        with _hists_lock:
+            if _attr_hists is None:
+                # literal names: the check_stats lint reads these
+                _attr_hists = (
+                    monitor.histogram("STAT_gen_step_attr_admit_ms"),
+                    monitor.histogram("STAT_gen_step_attr_prefill_ms"),
+                    monitor.histogram("STAT_gen_step_attr_promote_ms"),
+                    monitor.histogram("STAT_gen_step_attr_decode_ms"),
+                    monitor.histogram("STAT_gen_step_attr_bookkeep_ms"),
+                    monitor.histogram("STAT_gen_step_attr_idle_ms"))
+    return _attr_hists
 
 
 class StepLog:
@@ -146,6 +181,15 @@ class StepLog:
             step_h.observe(rec.decode_ms)
         if rec.queue_depth:
             age_h.observe(max(0.0, rec.oldest_age_ms))
+        if rec.attr_wall_ms > 0:
+            # goodput attribution (ISSUE 20): one observe per bucket
+            # per iteration — "where did this replica's ms go" as a
+            # fleet-scrapeable histogram family
+            for h, v in zip(_step_attr_hists(),
+                            (rec.attr_admit_ms, rec.prefill_ms,
+                             rec.attr_promote_ms, rec.decode_ms,
+                             rec.attr_bookkeep_ms, rec.attr_idle_ms)):
+                h.observe(max(0.0, v))
         if len(self._buf) < self.cap:
             self._buf.append(rec)
         else:

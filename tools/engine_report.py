@@ -25,11 +25,15 @@ ENGINE_RESTART/REPLAY_ADMIT audit events between them), the engine's
 mesh-slice width (`tp` — ISSUE 19: a tensor-parallel lane records its
 degree every iteration so mixed-fleet rings are self-describing;
 records predating the field read as single-chip), and
-prefill-vs-decode wall — then the audit tail with reason codes (per
-request: ADMIT_PREFIX_HIT carries prefix_tokens, COW_SPLIT the split
-pages), so "why did this request wait/die" reads straight off the
-artifact. Records predating ISSUE 14/15 parse unchanged: every field
-reads by name with a zero default.
+prefill-vs-decode wall, and the per-iteration goodput attribution
+(ISSUE 20: idle/wall columns plus a per-incarnation "where did the
+milliseconds go" rollup — admit / prefill / promote / decode /
+bookkeep / idle tile each iteration's wall exactly) — then the audit
+tail with reason codes (per request: ADMIT_PREFIX_HIT carries
+prefix_tokens, COW_SPLIT the split pages), so "why did this request
+wait/die" reads straight off the artifact. Records predating
+ISSUE 14/15/20 parse unchanged: every field reads by name with a zero
+default.
 
 `--json` emits the parsed + summarized structure for scripting.
 """
@@ -112,7 +116,40 @@ def summarize(records: List[dict]) -> dict:
                                       for r in records), 3),
         "decode_ms_total": round(sum(r.get("decode_ms", 0.0)
                                      for r in records), 3),
+        "goodput": goodput(records),
     }
+
+
+# goodput-attribution buckets (ISSUE 20): label -> StepRecord field.
+# The six tile each iteration's attr_wall_ms exactly (bookkeeping is
+# the remainder of the rounded siblings, computed engine-side).
+ATTR_BUCKETS = (("admit", "attr_admit_ms"), ("prefill", "prefill_ms"),
+                ("promote", "attr_promote_ms"), ("decode", "decode_ms"),
+                ("bookkeep", "attr_bookkeep_ms"),
+                ("idle", "attr_idle_ms"))
+
+
+def goodput(records: List[dict]) -> dict:
+    """Per-incarnation 'where did the milliseconds go' rollup over the
+    records carrying attribution (attr_wall_ms > 0; older-era records
+    simply don't contribute). {} when no record has attribution."""
+    by_inc: dict = {}
+    for r in records:
+        wall = r.get("attr_wall_ms", 0) or 0
+        if wall <= 0:
+            continue
+        d = by_inc.setdefault(r.get("incarnation", 0),
+                              {label: 0.0 for label, _ in ATTR_BUCKETS})
+        d["wall_ms"] = d.get("wall_ms", 0.0) + wall
+        for label, key in ATTR_BUCKETS:
+            d[label] += r.get(key, 0.0) or 0.0
+    for d in by_inc.values():
+        for k in list(d):
+            d[k] = round(d[k], 3)
+    return {"by_incarnation": by_inc,
+            "wall_ms": round(sum(d.get("wall_ms", 0.0)
+                                 for d in by_inc.values()), 3)}\
+        if by_inc else {}
 
 
 def _bar(n: int, peak: int, width: int = 8) -> str:
@@ -174,6 +211,17 @@ def render(name: str, eng: dict, last: int = 0,
               f"{summ['spec_accepted']}/{summ['spec_drafted']} drafts "
               f"accepted, {summ['prefill_chunks']} prefill chunks)",
               file=out)
+        # goodput attribution (ISSUE 20): where did this replica's
+        # milliseconds go, per incarnation — buckets tile the wall
+        gp = summ.get("goodput") or {}
+        for inc in sorted(gp.get("by_incarnation", {})):
+            d = gp["by_incarnation"][inc]
+            wall = max(d.get("wall_ms", 0.0), 1e-9)
+            pct = " ".join(
+                f"{label} {100.0 * d.get(label, 0.0) / wall:.1f}%"
+                for label, _ in ATTR_BUCKETS)
+            print(f"   goodput inc {inc}: wall "
+                  f"{d.get('wall_ms', 0.0):.1f}ms — {pct}", file=out)
         hdr = (f"   {'inc':>3} {'tp':>2} {'it':>6} {'step':>6} "
                f"{'slots':<10} "
                f"{'adm':>3} "
@@ -181,7 +229,8 @@ def render(name: str, eng: dict, last: int = 0,
                f"{'queue':>5} {'age_ms':>8} {'pages':>5} {'free':>5} "
                f"{'pfx':>4} {'cow':>3} {'dem':>3} {'pro':>3} "
                f"{'tok':>4} {'acc':>4} "
-               f"{'chk':>3} {'prefill':>8} {'decode':>8}")
+               f"{'chk':>3} {'prefill':>8} {'decode':>8} "
+               f"{'idle':>8} {'wall':>8}")
         print(hdr, file=out)
         for r in records:
             print(f"   {r.get('incarnation', 0):>3} "
@@ -205,7 +254,10 @@ def render(name: str, eng: dict, last: int = 0,
                   f"{r.get('spec_accepted', 0):>4} "
                   f"{r.get('prefill_chunks', 0):>3} "
                   f"{r.get('prefill_ms', 0.0):>7.1f}ms "
-                  f"{r.get('decode_ms', 0.0):>7.1f}ms", file=out)
+                  f"{r.get('decode_ms', 0.0):>7.1f}ms "
+                  f"{r.get('attr_idle_ms', 0.0) or 0.0:>7.1f}ms "
+                  f"{r.get('attr_wall_ms', 0.0) or 0.0:>7.1f}ms",
+                  file=out)
     audit = eng.get("audit", [])
     if last > 0:
         audit = audit[-last:]

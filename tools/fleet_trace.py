@@ -1,0 +1,177 @@
+#!/usr/bin/env python
+"""Merge N replicas' chrome-trace exports into ONE fleet timeline
+(ISSUE 20).
+
+Each engine process exports its own `/trace` (or
+`profiler.export_chrome_tracing` file): real thread tracks, request
+scopes, `fleet_request` flow events and `reqspan:` instants. One fleet
+= N such exports — this tool merges them so chrome://tracing (or
+Perfetto) renders routing, prefill/decode, and post-restart replay as
+ONE arrow chain per request:
+
+- the Router's placement emits the flow START (`ph:"s"`) under the
+  request's trace id,
+- each replica incarnation that admits the request emits a STEP
+  (`ph:"t"`),
+- the resolving span emits the FINISH (`ph:"f"`),
+
+and because the flow id is derived from the 16-hex trace id itself
+(`profiler/trace_context.flow_id` — cross-process-stable), the arrows
+connect across files without any rid coordination.
+
+Merging details: exact duplicate events are dropped (two scrapes of the
+same process overlap; same-process replicas share rings), `--pid-offset`
+separates genuinely distinct processes that happen to collide on pid,
+and each source file gets a `process_name` metadata row naming its
+origin. The tool then VERIFIES the flow chains: every fleet_request id
+must carry >= 1 start and >= 1 finish — an unresolved chain means a
+request's trace got cut (a replica died without replay, or a file is
+missing from the merge) and is reported, mapped back to its 16-hex
+trace id via the reqspan `tid=` fields when present.
+
+Usage:  python tools/fleet_trace.py replica1.json replica2.json ...
+            [--out fleet.json] [--pid-offset 100000] [--json]
+
+Exit code 1 when any chain fails to resolve (bench's router-mode merge
+smoke gates on this).
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import re
+import sys
+
+_TID = re.compile(r",tid=(?P<tid>[0-9a-f]{16})\b")
+_FLOW_MASK = 0x7FFFFFFFFFFFFFFF
+
+
+def _flow_id(tid: str) -> int:
+    # mirrors profiler/trace_context.flow_id — duplicated so the tool
+    # stays a dependency-free script usable on any machine
+    return int(tid, 16) & _FLOW_MASK
+
+
+def _load_events(path):
+    with open(path, encoding="utf-8") as f:
+        data = json.load(f)
+    return data.get("traceEvents", data if isinstance(data, list) else [])
+
+
+def merge(sources, pid_offset: int = 0):
+    """Merge trace sources into `(trace, report)`.
+
+    `sources` is a list of `(label, events)` pairs or file paths.
+    `pid_offset` > 0 shifts file i's pids by `i * pid_offset` so
+    distinct processes that collide on pid get separate track groups;
+    0 (default) keeps pids verbatim, which also makes overlapping
+    scrapes of the SAME process dedup cleanly."""
+    merged = []
+    seen = set()
+    labeled = []
+    for i, src in enumerate(sources):
+        if isinstance(src, tuple):
+            label, events = src
+        else:
+            label, events = str(src), _load_events(src)
+        labeled.append(label)
+        shift = i * pid_offset
+        pids = set()
+        for ev in events:
+            if shift and "pid" in ev:
+                ev = dict(ev, pid=ev["pid"] + shift)
+            key = (ev.get("name"), ev.get("ph"), ev.get("pid"),
+                   ev.get("tid"), ev.get("ts"), ev.get("id"))
+            if key in seen:
+                continue
+            seen.add(key)
+            pids.add(ev.get("pid"))
+            merged.append(ev)
+        for pid in sorted(p for p in pids if p is not None):
+            merged.append({"ph": "M", "name": "process_name",
+                           "pid": pid, "tid": 0,
+                           "args": {"name": f"{label} (pid {pid})"}})
+
+    # flow-chain verification: every fleet_request id needs >= 1 start
+    # and >= 1 finish; steps are optional (a direct engine submit has
+    # no router hop)
+    chains = {}
+    tid_by_flow = {}
+    for ev in merged:
+        if (ev.get("name") == "fleet_request"
+                and ev.get("ph") in ("s", "t", "f")):
+            c = chains.setdefault(int(ev["id"]), {"s": 0, "t": 0, "f": 0})
+            c[ev["ph"]] += 1
+        m = _TID.search(str(ev.get("name", "")))
+        if m:
+            tid = m.group("tid")
+            tid_by_flow[_flow_id(tid)] = tid
+
+    def name_of(fid):
+        return tid_by_flow.get(fid, f"flow#{fid}")
+
+    unresolved = sorted(name_of(fid) for fid, c in chains.items()
+                        if not (c["s"] and c["f"]))
+    report = {
+        "sources": labeled,
+        "events": len(merged),
+        "chains": len(chains),
+        "resolved": sum(1 for c in chains.values()
+                        if c["s"] and c["f"]),
+        "multi_hop": sum(1 for c in chains.values()
+                         if c["s"] and c["f"] and c["t"] > 0),
+        "replayed": sum(1 for c in chains.values() if c["t"] > 1),
+        "unresolved": unresolved,
+        "trace_ids": sorted(tid_by_flow.values()),
+    }
+    trace = {"traceEvents": merged,
+             "displayTimeUnit": "ms",
+             "otherData": {"producer": "paddle_tpu.tools.fleet_trace",
+                           "sources": labeled}}
+    return trace, report
+
+
+def render(report, file=sys.stdout):
+    print(f"merged {len(report['sources'])} trace(s), "
+          f"{report['events']} events", file=file)
+    print(f"fleet_request chains: {report['chains']} total, "
+          f"{report['resolved']} resolved end-to-end, "
+          f"{report['multi_hop']} multi-hop (router or replay), "
+          f"{report['replayed']} spanning >1 incarnation/replica",
+          file=file)
+    if report["unresolved"]:
+        print(f"UNRESOLVED chains ({len(report['unresolved'])}):",
+              file=file)
+        for tid in report["unresolved"]:
+            print(f"  {tid}", file=file)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("traces", nargs="+",
+                    help="chrome trace json files (curl /trace per "
+                         "replica, or export_chrome_tracing)")
+    ap.add_argument("--out", default=None,
+                    help="write the merged chrome trace here")
+    ap.add_argument("--pid-offset", type=int, default=0,
+                    help="shift file i's pids by i*OFFSET (separate "
+                         "track groups for distinct processes that "
+                         "collide on pid; default 0 = keep verbatim)")
+    ap.add_argument("--json", action="store_true",
+                    help="emit the chain report as JSON")
+    args = ap.parse_args(argv)
+    trace, report = merge(args.traces, pid_offset=args.pid_offset)
+    if args.out:
+        with open(args.out, "w", encoding="utf-8") as f:
+            json.dump(trace, f)
+        report["out"] = args.out
+    if args.json:
+        json.dump(report, sys.stdout, indent=2)
+        print()
+    else:
+        render(report)
+    return 1 if report["unresolved"] else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -20,16 +20,20 @@ The continuous-batching GenerationEngine emits a second, slot-flavored
 reqspan shape per resolved request (profiler/spans.py GenSpan):
 
     reqspan:<rid>:<engine>:slot<slot>:n=<tokens>:ttft=…,tpot=…,e=…
-                                          [,pfx=…][,acc=…][,inc=…]
+                                  [,pfx=…][,acc=…][,inc=…][,tid=…]
 
 with TTFT (queue + prefill to first token), TPOT (steady decode cadence
 per output token) and end-to-end milliseconds; `pfx` (ISSUE 12) counts
 prompt tokens served from the prefix cache, `acc` (ISSUE 14) the
 speculative draft tokens accepted, `inc` (ISSUE 15) the engine
 incarnation that resolved the request (>0 = served after a supervised
-restart) — all optional, so traces from any era parse. Both shapes are parsed; whichever is present gets its own
-report section (phase percentiles + top-N slowest, plus a
-tokens-per-step summary for generation spans).
+restart), `tid` (ISSUE 20) the fleet-wide 16-hex trace id — all
+optional, so traces from any era parse. Both shapes are parsed;
+whichever is present gets its own report section (phase percentiles +
+top-N slowest, plus a tokens-per-step summary for generation spans).
+When trace ids are present the report also groups reqspans BY REQUEST:
+one row per trace id across incarnations and replicas, so a replayed
+or re-routed request reads as one logical request, not two.
 
 Usage:  python tools/latency_report.py trace.json [--top 10]
                                        [--engine NAME] [--json]
@@ -52,7 +56,7 @@ _GENSPAN = re.compile(
     r"n=(?P<n>\d+):"
     r"ttft=(?P<ttft>[0-9.]+),tpot=(?P<tpot>[0-9.]+),e=(?P<e>[0-9.]+)"
     r"(?:,pfx=(?P<pfx>\d+))?(?:,acc=(?P<acc>\d+))?"
-    r"(?:,inc=(?P<inc>\d+))?$")
+    r"(?:,inc=(?P<inc>\d+))?(?:,tid=(?P<tid>[0-9a-f]+))?$")
 
 PHASES = (("queue", "q"), ("pad", "p"), ("device", "d"), ("resolve", "r"))
 GEN_PHASES = (("ttft", "ttft"), ("tpot", "tpot"))
@@ -102,9 +106,36 @@ def parse_gen_trace(path, events=None):
                     "pfx": int(g["pfx"] or 0),
                     "acc": int(g["acc"] or 0),
                     "inc": int(g["inc"] or 0),
+                    "tid": g["tid"],
                     "ttft": float(g["ttft"]), "tpot": float(g["tpot"]),
                     "e": float(g["e"]), "ts_us": ev.get("ts", 0.0)})
     return out
+
+
+def group_by_trace(gens):
+    """One row per fleet trace id (ISSUE 20): a request replayed after
+    a restart (or re-routed across replicas) resolves several reqspans
+    under the SAME tid — fold them into one logical request carrying
+    every engine/incarnation it touched. Spans without a tid (older
+    traces, propagation off) are left out — they already render one
+    row each in the per-span sections."""
+    by_tid = {}
+    for g in gens:
+        if g.get("tid"):
+            by_tid.setdefault(g["tid"], []).append(g)
+    rows = []
+    for tid, spans in by_tid.items():
+        spans = sorted(spans, key=lambda g: g["ts_us"])
+        rows.append({"tid": tid,
+                     "spans": len(spans),
+                     "rids": [g["rid"] for g in spans],
+                     "engines": sorted({g["engine"] for g in spans}),
+                     "incarnations": sorted({g["inc"] for g in spans}),
+                     "n": spans[-1]["n"],
+                     "e": round(max(g["e"] for g in spans), 3),
+                     "ttft": spans[0]["ttft"]})
+    rows.sort(key=lambda r: -r["e"])
+    return rows
 
 
 def _pctl(sorted_vals, p):
@@ -177,6 +208,10 @@ def gen_report(gens, top=10):
             "incarnations": sorted({g["inc"] for g in gens}),
             "post_restart_requests": sum(1 for g in gens
                                          if g["inc"] > 0),
+            # fleet trace grouping (ISSUE 20): one logical-request row
+            # per trace id, across incarnations and replicas
+            "by_trace": group_by_trace(gens)[:top],
+            "traced_requests": sum(1 for g in gens if g.get("tid")),
             "slowest": sorted(gens, key=lambda g: -g["e"])[:top]}
 
 
@@ -210,6 +245,18 @@ def render_gen(rep, file=sys.stdout):
                   f"{g['n']:>6}{g['pfx']:>5}{g['acc']:>5}"
                   f"{g['e']:>10.3f}"
                   f"{g['ttft']:>9.3f}{g['tpot']:>9.3f}", file=file)
+    if rep.get("by_trace"):
+        print(f"\nby trace id ({rep['traced_requests']} traced "
+              f"span(s), one row per request across "
+              f"incarnations/replicas):", file=file)
+        print(f"{'trace':<18}{'spans':>6}{'toks':>6}{'e2e(ms)':>10}"
+              f"{'ttft':>9}  engines (incarnations)", file=file)
+        for r in rep["by_trace"]:
+            engines = ",".join(r["engines"])
+            incs = ",".join(str(i) for i in r["incarnations"])
+            print(f"{r['tid']:<18}{r['spans']:>6}{r['n']:>6}"
+                  f"{r['e']:>10.3f}{r['ttft']:>9.3f}  "
+                  f"{engines} ({incs})", file=file)
 
 
 def render(rep, file=sys.stdout):

@@ -20,6 +20,11 @@ own verdict, ROUTE_REROUTE with the typed failure that moved the
 request) — so "why did this request land THERE" reads straight off the
 artifact, same contract as tools/engine_report.py gives one engine.
 
+`--history history.json` additionally renders sparkline columns from a
+`/history` payload (ISSUE 20, profiler/timeseries.py): one row per
+per-replica pressure series plus the busiest rate/level series — the
+trend view a point-in-time `/stats` snapshot cannot give.
+
 `--json` emits the parsed + summarized structure for scripting.
 """
 from __future__ import annotations
@@ -30,6 +35,60 @@ import sys
 from typing import Dict
 
 from engine_report import _bar  # noqa: E402 — shared table machinery
+
+_SPARKS = " ▁▂▃▄▅▆▇█"
+
+
+def _spark(values, width: int = 48) -> str:
+    """Unicode sparkline of the LAST `width` values, scaled to the
+    series' own max (a flat-zero series renders as spaces)."""
+    vals = [float(v) for v in values][-width:]
+    if not vals:
+        return ""
+    hi = max(vals)
+    if hi <= 0:
+        return " " * len(vals)
+    return "".join(
+        _SPARKS[min(len(_SPARKS) - 1,
+                    int(round(v / hi * (len(_SPARKS) - 1))))]
+        for v in vals)
+
+
+def render_history(history: dict, last: int = 0, file=None) -> None:
+    """Sparkline section from a `/history` payload: every per-replica
+    pressure series, then the busiest non-constant rate/level series
+    (capped — a fleet registers hundreds of stats; the trend view is
+    for the ones that MOVE)."""
+    out = file or sys.stdout
+    series = history.get("series", {})
+    width = last if last > 0 else 48
+    print(f"   -- history sparklines (interval "
+          f"{history.get('interval_s')}s, cap "
+          f"{history.get('samples')} samples/series, "
+          f"{len(series)} series) --", file=out)
+
+    def row(name, s):
+        vals = [v for _, v in s.get("points", [])]
+        if not vals:
+            return
+        print(f"   {name:<44} {_spark(vals, width)} "
+              f"(last {vals[-1]:g}, max {max(map(float, vals)):g}, "
+              f"{s.get('kind')})", file=out)
+
+    pressure = sorted(n for n in series if n.startswith("pressure:"))
+    for name in pressure:
+        row(name, series[name])
+    movers = sorted(
+        (n for n, s in series.items()
+         if not n.startswith("pressure:")
+         and len({float(v) for _, v in s.get("points", [])}) > 1),
+        key=lambda n: -max((float(v) for _, v in
+                            series[n].get("points", [])), default=0.0))
+    for name in movers[:12]:
+        row(name, series[name])
+    if not pressure and not movers:
+        print("   (no moving series yet — is the sampler on? "
+              "FLAGS_metrics_history_interval_s)", file=out)
 
 
 def load_routers(path: str) -> Dict[str, dict]:
@@ -162,11 +221,18 @@ def main(argv=None) -> int:
     p.add_argument("--last", type=int, default=0,
                    help="only the last N timeline ticks / audit events "
                         "(default: all)")
+    p.add_argument("--history", default=None,
+                   help="a /history payload (profiler/timeseries.py) "
+                        "to render as sparkline columns")
     p.add_argument("--json", action="store_true",
                    help="emit parsed snapshot + summary as JSON")
     args = p.parse_args(argv)
 
     routers = load_routers(args.path)
+    history = None
+    if args.history is not None:
+        with open(args.history) as f:
+            history = json.load(f)
     if args.router is not None:
         if args.router not in routers:
             print(f"router {args.router!r} not in {sorted(routers)}",
@@ -183,11 +249,15 @@ def main(argv=None) -> int:
                 ticks, audit = ticks[-args.last:], audit[-args.last:]
             out[name] = {"summary": summarize(snap),
                          "pressure_timeline": ticks, "audit": audit}
+        if history is not None:
+            out["history"] = history
         print(json.dumps(out, indent=2))
         return 0
 
     for name, snap in sorted(routers.items()):
         render(name, snap, last=args.last)
+    if history is not None:
+        render_history(history, last=args.last)
     return 0
 
 
